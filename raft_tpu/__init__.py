@@ -1,0 +1,37 @@
+"""raft_tpu — TPU-native reusable accelerated functions and tools.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of RAPIDS RAFT
+(reference: /root/reference, branch-22.12 era): dense & sparse linear algebra,
+pairwise distances, k-NN / ANN indexes (brute-force, IVF-Flat, IVF-PQ),
+clustering, solvers, statistics, random generation, and multi-host
+communicator infrastructure — built TPU-first:
+
+  * MXU-shaped matmul formulations for the expanded distance family
+  * Pallas kernels for fused epilogues (fused L2 argmin/top-k)
+  * ``jax.sharding.Mesh`` + XLA collectives instead of NCCL/UCX
+  * functional, jit-compatible APIs with static shapes
+
+Layout mirrors the reference's area map (SURVEY.md §2):
+
+  core/      handle/resources, mdarray-shaped views, logger, errors  (§2.1)
+  comms/     communicator iface over XLA collectives                 (§2.2)
+  distance/  20 pairwise metrics, fused L2 NN, gram kernels          (§2.3)
+  linalg/    BLAS/solver wrappers, elementwise & reduction framework (§2.4)
+  matrix/    gather, sort, slicing, math utilities                   (§2.5)
+  sparse/    COO/CSR, convert/op/linalg/distance/neighbors/solver    (§2.6)
+  neighbors/ brute-force & ANN indexes, top-k selection              (§2.7)
+  cluster/   kmeans, balanced kmeans, single-linkage                 (§2.8)
+  spectral/, solver/, label/, stats/, random/                        (§2.9)
+  ops/       Pallas kernel tier
+  parallel/  mesh utilities + multi-node-multi-device algorithms
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import Resources, DeviceResources
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "__version__",
+]

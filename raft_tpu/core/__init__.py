@@ -1,0 +1,47 @@
+"""Core runtime: resources/handle, array views, errors, logging, tracing.
+
+TPU-native analogue of the reference's L0/L1 layer
+(``cpp/include/raft/core``, see SURVEY.md §2.1).
+"""
+
+from raft_tpu.core.resources import Resources, DeviceResources, default_resources
+from raft_tpu.core.error import (
+    RaftError,
+    LogicError,
+    expects,
+    fail,
+)
+from raft_tpu.core.logger import logger, set_level, set_callback
+from raft_tpu.core.mdarray import (
+    device_matrix_view,
+    device_vector_view,
+    make_device_matrix,
+    make_device_vector,
+    flatten,
+    reshape,
+)
+from raft_tpu.core.kvp import KeyValuePair
+from raft_tpu.core.interruptible import interruptible, synchronize, cancel
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "default_resources",
+    "RaftError",
+    "LogicError",
+    "expects",
+    "fail",
+    "logger",
+    "set_level",
+    "set_callback",
+    "device_matrix_view",
+    "device_vector_view",
+    "make_device_matrix",
+    "make_device_vector",
+    "flatten",
+    "reshape",
+    "KeyValuePair",
+    "interruptible",
+    "synchronize",
+    "cancel",
+]

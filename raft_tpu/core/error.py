@@ -1,0 +1,36 @@
+"""Error types and check macros.
+
+TPU-native analogue of the reference error machinery
+(``cpp/include/raft/core/error.hpp:91,154,170``): ``raft::exception`` with a
+captured backtrace, ``logic_error``, and the ``RAFT_EXPECTS``/``RAFT_FAIL``
+check macros. On the Python side the backtrace capture is native; we keep the
+class hierarchy and the check helpers so call sites read the same.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RaftError(RuntimeError):
+    """Base exception; captures the instantiation backtrace like
+    ``raft::exception`` (reference ``core/error.hpp:91``)."""
+
+    def __init__(self, message: str):
+        self.trace = "".join(traceback.format_stack()[:-1])
+        super().__init__(message)
+
+
+class LogicError(RaftError):
+    """Invalid (logic) argument or state (reference ``core/error.hpp:154``)."""
+
+
+def expects(cond: bool, fmt: str, *args) -> None:
+    """``RAFT_EXPECTS(cond, fmt, ...)`` (reference ``core/error.hpp:170``)."""
+    if not cond:
+        raise LogicError(fmt % args if args else fmt)
+
+
+def fail(fmt: str, *args) -> None:
+    """``RAFT_FAIL(fmt, ...)``."""
+    raise LogicError(fmt % args if args else fmt)

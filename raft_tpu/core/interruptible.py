@@ -1,0 +1,97 @@
+"""Cooperative cancellation of blocking sync points.
+
+TPU-native analogue of ``raft::interruptible`` (reference
+``cpp/include/raft/core/interruptible.hpp:66-163``): a thread-local token
+registry; ``synchronize`` polls for completion while calling ``yield_``,
+which raises if another thread has flagged this thread via ``cancel``.
+
+The reference polls ``cudaStreamQuery``; here we poll ``jax.Array``
+readiness (``is_ready()``) so a hung device program can be abandoned by the
+waiting host thread. Exposed to users as the ``interruptible`` context
+manager, mirroring ``pylibraft.common.interruptible.cuda_interruptible``
+(reference ``python/pylibraft/pylibraft/common/interruptible.pyx:32-77``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+import jax
+
+
+class InterruptedException(RuntimeError):
+    """Raised inside a thread whose sync point was cancelled."""
+
+
+class _Token:
+    __slots__ = ("flag",)
+
+    def __init__(self):
+        self.flag = threading.Event()
+
+
+_registry: Dict[int, _Token] = {}
+_registry_lock = threading.Lock()
+_tls = threading.local()
+
+
+def _get_token(thread_id: int | None = None) -> _Token:
+    """Per-thread token (reference interruptible::get_token :66)."""
+    tid = threading.get_ident() if thread_id is None else thread_id
+    with _registry_lock:
+        tok = _registry.get(tid)
+        if tok is None:
+            tok = _Token()
+            _registry[tid] = tok
+        return tok
+
+
+def yield_() -> None:
+    """Check the current thread's cancellation flag; raise if set
+    (reference interruptible::yield :99)."""
+    tok = _get_token()
+    if tok.flag.is_set():
+        tok.flag.clear()
+        raise InterruptedException("interruptible::yield: cancelled")
+
+
+def yield_no_throw() -> bool:
+    """Non-throwing check; returns True if cancelled (reference :107)."""
+    tok = _get_token()
+    if tok.flag.is_set():
+        tok.flag.clear()
+        return True
+    return False
+
+
+def cancel(thread_id: int) -> None:
+    """Flag the given thread's next yield to raise (reference :135)."""
+    _get_token(thread_id).flag.set()
+
+
+def synchronize(*arrays, poll_interval: float = 0.001) -> None:
+    """Interruptible blocking wait for array readiness (reference :84:
+    loop { query; if done return; yield(); })."""
+    leaves = [x for x in jax.tree_util.tree_leaves(arrays)
+              if isinstance(x, jax.Array)]
+    while True:
+        if all(x.is_ready() for x in leaves):
+            return
+        yield_()
+        time.sleep(poll_interval)
+
+
+@contextlib.contextmanager
+def interruptible():
+    """Context manager marking a scope whose sync points may be cancelled
+    from another thread via :func:`cancel` (pylibraft
+    ``cuda_interruptible`` equivalent)."""
+    _get_token()  # ensure registration
+    try:
+        yield
+    finally:
+        # Drop any unconsumed cancellation so it cannot leak into later scopes
+        _get_token().flag.clear()

@@ -1,0 +1,22 @@
+"""Key-value pair used by argmin-style reductions.
+
+Analogue of ``raft::KeyValuePair`` (reference ``core/kvp.hpp``). On TPU the
+pair is represented structurally as two arrays (keys, values) since XLA has
+no struct type; this NamedTuple is the host-side container and pytree leaf
+pair returned by e.g. :func:`raft_tpu.distance.fused_l2_nn_argmin`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+
+
+class KeyValuePair(NamedTuple):
+    """(key, value) pair-of-arrays; key is typically an index array and
+    value a distance array of the same shape. NamedTuples are native JAX
+    pytrees, so this flows through jit/vmap/scan unchanged."""
+
+    key: jax.Array
+    value: jax.Array

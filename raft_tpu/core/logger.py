@@ -1,0 +1,128 @@
+"""Logger with settable level/pattern/callback sink.
+
+TPU-native analogue of the spdlog-backed singleton logger of the reference
+(``cpp/include/raft/core/logger.hpp:118-251``; callback sink
+``core/detail/callback_sink.hpp``). The callback sink exists so host tools
+can capture framework logs; levels mirror the reference's
+``RAFT_LEVEL_*`` set (``logger.hpp:27-40``).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Callable, Optional
+
+# Level values mirror reference core/logger.hpp:27-40 (spdlog ordering).
+OFF = 0
+CRITICAL = 1
+ERROR = 2
+WARN = 3
+INFO = 4
+DEBUG = 5
+TRACE = 6
+
+_LEVEL_TO_PY = {
+    OFF: logging.CRITICAL + 10,
+    CRITICAL: logging.CRITICAL,
+    ERROR: logging.ERROR,
+    WARN: logging.WARNING,
+    INFO: logging.INFO,
+    DEBUG: logging.DEBUG,
+    TRACE: logging.DEBUG - 5,
+}
+
+
+class _CallbackHandler(logging.Handler):
+    """Routes records to a user callback (reference callback_sink)."""
+
+    def __init__(self, callback: Callable[[int, str], None],
+                 flush: Optional[Callable[[], None]] = None):
+        super().__init__()
+        self._callback = callback
+        self._flush = flush
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self._callback(record.levelno, self.format(record))
+
+    def flush(self) -> None:
+        if self._flush is not None:
+            self._flush()
+
+
+class Logger:
+    """Singleton-style logger (reference ``class logger``, logger.hpp:118)."""
+
+    def __init__(self, name: str = "raft_tpu"):
+        self._logger = logging.getLogger(name)
+        self._level = INFO
+        self._pattern = "[%(asctime)s] [%(levelname)s] %(message)s"
+        self._default_handler = logging.StreamHandler(sys.stderr)
+        self._callback_handler: Optional[_CallbackHandler] = None
+        self._logger.addHandler(self._default_handler)
+        self._logger.propagate = False
+        self.set_level(INFO)
+        self.set_pattern(self._pattern)
+
+    def set_level(self, level: int) -> None:
+        self._level = level
+        self._logger.setLevel(_LEVEL_TO_PY[level])
+
+    def get_level(self) -> int:
+        return self._level
+
+    def should_log_for(self, level: int) -> bool:
+        return level <= self._level
+
+    def set_pattern(self, pattern: str) -> None:
+        self._pattern = pattern
+        fmt = logging.Formatter(pattern)
+        self._default_handler.setFormatter(fmt)
+        if self._callback_handler is not None:
+            self._callback_handler.setFormatter(fmt)
+
+    def get_pattern(self) -> str:
+        return self._pattern
+
+    def set_callback(self, callback: Optional[Callable[[int, str], None]],
+                     flush: Optional[Callable[[], None]] = None) -> None:
+        """Install a callback sink; pass None to restore stderr output
+        (reference ``logger.hpp:177`` / pylibraft log-capture path)."""
+        if self._callback_handler is not None:
+            self._logger.removeHandler(self._callback_handler)
+            self._callback_handler = None
+        if callback is not None:
+            self._logger.removeHandler(self._default_handler)
+            self._callback_handler = _CallbackHandler(callback, flush)
+            self._callback_handler.setFormatter(logging.Formatter(self._pattern))
+            self._logger.addHandler(self._callback_handler)
+        elif self._default_handler not in self._logger.handlers:
+            self._logger.addHandler(self._default_handler)
+
+    def flush(self) -> None:
+        for h in self._logger.handlers:
+            h.flush()
+
+    # RAFT_LOG_* macro equivalents (logger.hpp:260-320)
+    def trace(self, msg, *a): self._log(TRACE, msg, *a)
+    def debug(self, msg, *a): self._log(DEBUG, msg, *a)
+    def info(self, msg, *a): self._log(INFO, msg, *a)
+    def warn(self, msg, *a): self._log(WARN, msg, *a)
+    def error(self, msg, *a): self._log(ERROR, msg, *a)
+    def critical(self, msg, *a): self._log(CRITICAL, msg, *a)
+
+    def _log(self, level: int, msg: str, *a) -> None:
+        if self.should_log_for(level):
+            self._logger.log(_LEVEL_TO_PY[level], msg % a if a else msg)
+
+
+logger = Logger()
+
+
+def set_level(level: int) -> None:
+    logger.set_level(level)
+
+
+def set_callback(callback, flush=None) -> None:
+    logger.set_callback(callback, flush)

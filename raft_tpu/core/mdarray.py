@@ -1,0 +1,124 @@
+"""mdspan/mdarray-shaped views over ``jax.Array``.
+
+TPU-native analogue of the reference's mdspan/mdarray layer
+(``core/mdarray.hpp:125``, ``core/device_mdspan.hpp:37``, factories
+``core/device_mdarray.hpp:132``). On TPU, ``jax.Array`` already is an
+owning, device-resident, shape/dtype-carrying container, so this layer is
+deliberately thin: *views* validate rank/dtype/layout expectations at API
+boundaries (the role mdspan plays in the reference's public APIs) and carry
+a declared layout tag; *factories* allocate zero-initialised arrays in HBM.
+
+Layout note: XLA chooses physical tiling on TPU; ``row_major``/``col_major``
+here describe the *logical* index order contract of the API (reference
+``layout_c_contiguous``/``layout_f_contiguous``), which matters for
+
+  * I/O with numpy/dlpack buffers, and
+  * column-major emulation: a col-major view of shape (m, n) is stored as
+    its (n, m) transpose; ``resolve()`` returns the row-major array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+ROW_MAJOR = "row_major"
+COL_MAJOR = "col_major"
+
+
+@dataclass(frozen=True)
+class mdspan_view:
+    """Non-owning typed view: array + declared layout."""
+
+    array: jax.Array
+    layout: str = ROW_MAJOR
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.array.shape
+
+    @property
+    def dtype(self):
+        return self.array.dtype
+
+    @property
+    def extents(self) -> Tuple[int, ...]:
+        return self.array.shape
+
+    def extent(self, i: int) -> int:
+        return self.array.shape[i]
+
+    def resolve(self) -> jax.Array:
+        """Row-major logical array (transposes col-major storage)."""
+        if self.layout == COL_MAJOR and self.array.ndim == 2:
+            return self.array.T
+        return self.array
+
+
+def device_matrix_view(a, layout: str = ROW_MAJOR,
+                       dtype=None) -> mdspan_view:
+    """Validated rank-2 view (reference make_device_matrix_view,
+    ``core/device_mdspan.hpp:210``)."""
+    arr = jnp.asarray(a)
+    expects(arr.ndim == 2, "device_matrix_view: expected rank-2, got rank-%d", arr.ndim)
+    if dtype is not None:
+        expects(arr.dtype == jnp.dtype(dtype),
+                "device_matrix_view: expected dtype %s, got %s", dtype, arr.dtype)
+    return mdspan_view(arr, layout)
+
+
+def device_vector_view(a, dtype=None) -> mdspan_view:
+    """Validated rank-1 view."""
+    arr = jnp.asarray(a)
+    expects(arr.ndim == 1, "device_vector_view: expected rank-1, got rank-%d", arr.ndim)
+    if dtype is not None:
+        expects(arr.dtype == jnp.dtype(dtype),
+                "device_vector_view: expected dtype %s, got %s", dtype, arr.dtype)
+    return mdspan_view(arr, ROW_MAJOR)
+
+
+def make_device_matrix(res, m: int, n: int, dtype=jnp.float32,
+                       layout: str = ROW_MAJOR) -> jax.Array:
+    """Owning zero-init device matrix (reference make_device_matrix,
+    ``core/device_mdarray.hpp:132``). ``res`` picks the target device."""
+    arr = jnp.zeros((m, n) if layout == ROW_MAJOR else (n, m), dtype=dtype)
+    if res is not None:
+        arr = jax.device_put(arr, res.device)
+    return arr
+
+
+def make_device_vector(res, n: int, dtype=jnp.float32) -> jax.Array:
+    arr = jnp.zeros((n,), dtype=dtype)
+    if res is not None:
+        arr = jax.device_put(arr, res.device)
+    return arr
+
+
+def flatten(view) -> jax.Array:
+    """Rank-collapsing view (reference ``core/mdarray.hpp:348``)."""
+    arr = view.resolve() if isinstance(view, mdspan_view) else jnp.asarray(view)
+    return arr.reshape(-1)
+
+
+def reshape(view, shape: Tuple[int, ...]) -> jax.Array:
+    """Reshape of a contiguous view (reference ``core/mdarray.hpp:368``)."""
+    arr = view.resolve() if isinstance(view, mdspan_view) else jnp.asarray(view)
+    return arr.reshape(shape)
+
+
+def as_array(x) -> jax.Array:
+    """Accept jax arrays, numpy arrays, mdspan_view, or anything exposing
+    ``__dlpack__`` (the TPU-side replacement for the reference's
+    ``__cuda_array_interface__`` ingestion)."""
+    if isinstance(x, mdspan_view):
+        return x.resolve()
+    if isinstance(x, jax.Array):
+        return x
+    if hasattr(x, "__dlpack__") and not hasattr(x, "__array__"):
+        return jnp.from_dlpack(x)
+    return jnp.asarray(x)

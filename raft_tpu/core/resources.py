@@ -1,0 +1,167 @@
+"""Resources: the framework's ambient context object.
+
+TPU-native analogue of ``raft::handle_t`` (reference
+``cpp/include/raft/core/handle.hpp:54-316``). The reference handle carries:
+a main CUDA stream + optional stream pool, lazily-created vendor-library
+handles, the device id/properties, and a communicator slot with named
+subcommunicators (``handle.hpp:239-264``).
+
+On TPU the mapping is:
+
+  * streams / stream pool  -> nothing to hold: XLA orders execution. We keep
+    the *synchronization points* (``sync``) which block until all submitted
+    work on this context's arrays is done, mirroring ``sync_stream``.
+  * vendor handles          -> the jax backend/client for the chosen platform.
+  * device id/properties    -> ``device`` (a ``jax.Device``) + queries.
+  * comms slot + subcomms   -> ``comms`` property + ``set_comms`` /
+    ``get_subcomm``/``set_subcomm`` keyed by name (handle.hpp:247-264).
+  * mesh                    -> the ``jax.sharding.Mesh`` used by distributed
+    algorithms; single-device resources have a 1-device mesh available.
+
+Every public algorithm in raft_tpu accepts ``res: Resources | None`` as its
+first argument (mirroring the reference convention that every API takes
+``const raft::handle_t&`` first); ``None`` means "use the process-default
+resources", which keeps the functional JAX style ergonomic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+
+class Resources:
+    """Execution context: device(s), mesh, RNG stream, comms slot.
+
+    Unlike the reference handle there are no lazily-created cuBLAS/cuSOLVER
+    handles (XLA owns the libraries); the lazily-created piece here is the
+    default 1-D mesh. A ``Resources`` is cheap; algorithms never mutate it
+    except through ``set_comms``/``set_subcomm``/RNG advancement.
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: int = 0,
+        n_streams: int = 0,
+    ):
+        # n_streams kept for API parity with pylibraft's Handle(n_streams);
+        # it has no effect on TPU (XLA schedules concurrency).
+        self._device = device if device is not None else jax.devices()[0]
+        self._devices = list(devices) if devices is not None else [self._device]
+        self._mesh = mesh
+        self._comms = None
+        self._subcomms: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._key = jax.random.key(seed)
+        self._n_streams = n_streams
+        self._sync_tokens: list = []
+
+    # -- device / properties (handle.hpp:131-156) ---------------------------
+    @property
+    def device(self) -> jax.Device:
+        return self._device
+
+    @property
+    def devices(self) -> Sequence[jax.Device]:
+        return self._devices
+
+    def get_device_id(self) -> int:
+        return self._device.id
+
+    def get_device_properties(self) -> dict:
+        d = self._device
+        return {
+            "id": d.id,
+            "platform": d.platform,
+            "device_kind": d.device_kind,
+            "process_index": d.process_index,
+            "memory_stats": (d.memory_stats() if hasattr(d, "memory_stats") else None),
+        }
+
+    # -- mesh ---------------------------------------------------------------
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        """The device mesh; lazily a 1-D mesh over ``devices``."""
+        with self._lock:
+            if self._mesh is None:
+                self._mesh = jax.sharding.Mesh(
+                    np.asarray(self._devices), axis_names=("data",)
+                )
+            return self._mesh
+
+    def set_mesh(self, mesh: jax.sharding.Mesh) -> None:
+        with self._lock:
+            self._mesh = mesh
+
+    # -- synchronization (handle.hpp sync_stream / stream_syncer) -----------
+    def sync(self, *arrays) -> None:
+        """Block until given arrays (or all tracked work) are materialized.
+
+        Mirrors ``handle.sync_stream()``: the reference polls the stream; we
+        block on array readiness, which is the XLA-level equivalent.
+        """
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            jax.effects_barrier()
+
+    # pylibraft Handle API parity
+    sync_stream = sync
+
+    # -- RNG stream ---------------------------------------------------------
+    def next_key(self) -> jax.Array:
+        """Split and return a fresh PRNG key (thread-safe)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    # -- comms slot (handle.hpp:239-264) ------------------------------------
+    def set_comms(self, comms) -> None:
+        self._comms = comms
+
+    def get_comms(self):
+        expects(self._comms is not None, "ERROR: communicator was not initialized\n")
+        return self._comms
+
+    @property
+    def comms_initialized(self) -> bool:
+        return self._comms is not None
+
+    def set_subcomm(self, key: str, comms) -> None:
+        self._subcomms[key] = comms
+
+    def get_subcomm(self, key: str):
+        expects(
+            key in self._subcomms,
+            "ERROR: subcommunicator %s was not initialized\n", key,
+        )
+        return self._subcomms[key]
+
+
+# ``DeviceResources`` is the name the later reference uses for handle_t's
+# replacement; provide it as an alias so both spellings work.
+DeviceResources = Resources
+
+_default: Optional[Resources] = None
+_default_lock = threading.Lock()
+
+
+def default_resources() -> Resources:
+    """Process-default resources (created on first use)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Resources()
+        return _default
+
+
+def ensure_resources(res: Optional[Resources]) -> Resources:
+    return res if res is not None else default_resources()

@@ -1,0 +1,50 @@
+"""Profiler trace annotations.
+
+TPU-native analogue of the reference's NVTX ranges
+(``cpp/include/raft/core/nvtx.hpp:69-110``): RAII ``range`` objects +
+``push_range``/``pop_range``, compiled to no-ops when disabled. Here ranges
+map to ``jax.profiler`` trace annotations so they show up in xprof/Perfetto
+traces, and are gated by ``enable_tracing`` (reference gates on the
+``NVTX_ENABLED`` CMake flag, ``cpp/CMakeLists.txt:212``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List
+
+import jax
+
+_enabled = True
+_stack: List[object] = []
+
+
+def enable_tracing(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+@contextlib.contextmanager
+def range(fmt: str, *args):
+    """RAII-style trace range (reference ``common::nvtx::range``)."""
+    if not _enabled:
+        yield
+        return
+    name = fmt % args if args else fmt
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def push_range(fmt: str, *args) -> None:
+    if not _enabled:
+        return
+    name = fmt % args if args else fmt
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _stack.append(ann)
+
+
+def pop_range() -> None:
+    if not _enabled or not _stack:
+        return
+    _stack.pop().__exit__(None, None, None)

@@ -1,0 +1,26 @@
+"""Pairwise distance primitives (SURVEY.md §2.3).
+
+TPU-native re-design of the reference ``raft/distance`` area:
+``DistanceType`` (20 metrics, ``distance/distance_types.hpp:23-67``),
+``pairwise_distance`` (``distance/distance.cuh:293``), ``fusedL2NN``
+(``distance/fused_l2_nn.cuh:89``), and gram/kernel matrices
+(``distance/kernels.cuh``).
+"""
+
+from raft_tpu.distance.distance_types import DistanceType, DISTANCE_TYPES, SUPPORTED_DISTANCES
+from raft_tpu.distance.pairwise import pairwise_distance, distance
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn, fused_l2_nn_argmin
+from raft_tpu.distance.kernels import KernelType, KernelParams, gram_matrix
+
+__all__ = [
+    "DistanceType",
+    "DISTANCE_TYPES",
+    "SUPPORTED_DISTANCES",
+    "pairwise_distance",
+    "distance",
+    "fused_l2_nn",
+    "fused_l2_nn_argmin",
+    "KernelType",
+    "KernelParams",
+    "gram_matrix",
+]

@@ -1,0 +1,72 @@
+"""Gram / kernel matrices for SVM-style use.
+
+Reference: ``raft/distance/kernels.cuh`` + ``distance/detail/kernels/``
+(gram_matrix, kernel_factory) with ``KernelType {LINEAR, POLYNOMIAL, RBF,
+TANH}`` and ``KernelParams`` (``distance/distance_types.hpp:69-87``).
+
+Every kernel here is one MXU matmul plus a fused elementwise epilogue:
+  LINEAR      K = X Y^T
+  POLYNOMIAL  K = (gamma X Y^T + coef0)^degree
+  TANH        K = tanh(gamma X Y^T + coef0)
+  RBF         K = exp(-gamma ||x-y||^2)   (expanded-L2 formulation)
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.mdarray import as_array
+
+
+class KernelType(enum.IntEnum):
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3
+
+
+@dataclass(frozen=True)
+class KernelParams:
+    """Mirror of the reference POD struct (distance_types.hpp:80-87)."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def _dot(x, y):
+    return lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("kernel", "degree", "gamma", "coef0"))
+def _gram(x, y, kernel: KernelType, degree: int, gamma: float, coef0: float):
+    ip = _dot(x, y)
+    if kernel == KernelType.LINEAR:
+        return ip
+    if kernel == KernelType.POLYNOMIAL:
+        return (gamma * ip + coef0) ** degree
+    if kernel == KernelType.TANH:
+        return jnp.tanh(gamma * ip + coef0)
+    if kernel == KernelType.RBF:
+        xf = x.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        xx = jnp.sum(xf * xf, axis=1)
+        yy = jnp.sum(yf * yf, axis=1)
+        d2 = jnp.maximum(xx[:, None] + yy[None, :] - 2.0 * ip, 0.0)
+        return jnp.exp(-gamma * d2)
+    raise ValueError(f"unknown kernel type {kernel}")
+
+
+def gram_matrix(x, y, params: KernelParams = KernelParams(), res=None) -> jax.Array:
+    """Evaluate the (m, n) Gram matrix K(x_i, y_j)."""
+    x, y = as_array(x), as_array(y)
+    return _gram(x, y, KernelType(params.kernel), int(params.degree),
+                 float(params.gamma), float(params.coef0))

@@ -1,0 +1,43 @@
+"""Random generation (SURVEY.md §2.9, reference ``raft/random``).
+
+Distribution set and generator-state API mirror the reference
+(``random/rng.cuh:44-``, ``random/rng_state.hpp:28-52``); bit streams are
+JAX-native (threefry/rbg) rather than Philox/PCG — the reference's contract
+is the distribution set + reproducible-from-seed state, not the bits.
+"""
+
+from raft_tpu.random.rng import (
+    GeneratorType,
+    RngState,
+    uniform,
+    uniformInt,
+    normal,
+    normalInt,
+    normalTable,
+    fill,
+    bernoulli,
+    scaled_bernoulli,
+    gumbel,
+    lognormal,
+    logistic,
+    exponential,
+    rayleigh,
+    laplace,
+    discrete,
+    sample_without_replacement,
+    permute,
+)
+from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.random.make_regression import make_regression
+from raft_tpu.random.multi_variable_gaussian import multi_variable_gaussian
+from raft_tpu.random.rmat import rmat_rectangular_gen, rmat
+
+__all__ = [
+    "GeneratorType", "RngState",
+    "uniform", "uniformInt", "normal", "normalInt", "normalTable", "fill",
+    "bernoulli", "scaled_bernoulli", "gumbel", "lognormal", "logistic",
+    "exponential", "rayleigh", "laplace", "discrete",
+    "sample_without_replacement", "permute",
+    "make_blobs", "make_regression", "multi_variable_gaussian",
+    "rmat_rectangular_gen", "rmat",
+]

@@ -1,0 +1,58 @@
+"""Isotropic Gaussian blob generator.
+
+Reference: ``raft::random::make_blobs``
+(``cpp/include/raft/random/make_blobs.cuh:63,126``): n_clusters centers
+(given or uniform in a box), per-cluster or shared std, optional shuffle,
+returns (data, labels).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import KeyLike, _key
+
+
+def make_blobs(
+    n_samples: int = 100,
+    n_features: int = 2,
+    centers: Optional[object] = None,
+    cluster_std: float = 1.0,
+    shuffle: bool = True,
+    center_box_min: float = -10.0,
+    center_box_max: float = 10.0,
+    seed: KeyLike = 0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generate gaussian blobs → (X (n_samples, n_features), labels int32).
+
+    ``centers`` may be an int (number of clusters) or an array of cluster
+    centers; defaults to 5 mirroring the CUDA default ``n_clusters=5``.
+    """
+    key = _key(seed)
+    k_centers, k_assign, k_noise, k_shuffle = jax.random.split(key, 4)
+
+    if centers is None:
+        centers = 5
+    if isinstance(centers, int):
+        centers_arr = jax.random.uniform(
+            k_centers, (centers, n_features), dtype=dtype,
+            minval=center_box_min, maxval=center_box_max)
+    else:
+        centers_arr = jnp.asarray(centers, dtype=dtype)
+    n_clusters = centers_arr.shape[0]
+
+    labels = jax.random.randint(k_assign, (n_samples,), 0, n_clusters,
+                                dtype=jnp.int32)
+    std = jnp.asarray(cluster_std, dtype=dtype)
+    per_point_std = std[labels] if std.ndim == 1 else std
+    noise = jax.random.normal(k_noise, (n_samples, n_features), dtype=dtype)
+    x = centers_arr[labels] + noise * jnp.reshape(per_point_std, (-1, 1) if std.ndim == 1 else ())
+
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_samples)
+        x, labels = x[perm], labels[perm]
+    return x, labels

@@ -1,0 +1,63 @@
+"""Regression dataset generator.
+
+Reference: ``raft::random::make_regression``
+(``cpp/include/raft/random/make_regression.cuh:70``): gaussian design
+matrix with ``n_informative`` informative features through a low-rank
+design when ``effective_rank`` is set, random ground-truth coefficients,
+optional bias/noise/shuffle; returns (X, y[, coef]).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import KeyLike, _key
+
+
+def make_regression(
+    n_samples: int = 100,
+    n_features: int = 100,
+    n_informative: int = 10,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    effective_rank: Optional[int] = None,
+    tail_strength: float = 0.5,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    coef: bool = False,
+    seed: KeyLike = 0,
+    dtype=jnp.float32,
+):
+    key = _key(seed)
+    ks = jax.random.split(key, 6)
+    n_informative = min(n_features, n_informative)
+
+    if effective_rank is None:
+        x = jax.random.normal(ks[0], (n_samples, n_features), dtype=dtype)
+    else:
+        # low-rank-plus-tail singular profile (make_regression.cuh low-rank path)
+        rank = min(effective_rank, n_features, n_samples)
+        u = jax.random.normal(ks[0], (n_samples, rank), dtype=dtype)
+        v = jax.random.normal(ks[1], (rank, n_features), dtype=dtype)
+        sing = jnp.exp(-jnp.arange(rank, dtype=dtype) / (tail_strength * rank + 1e-6))
+        x = (u * sing[None, :]) @ v / jnp.sqrt(jnp.asarray(rank, dtype))
+
+    w = jnp.zeros((n_features, n_targets), dtype=dtype)
+    w_inf = 100.0 * jax.random.uniform(ks[2], (n_informative, n_targets), dtype=dtype)
+    w = w.at[:n_informative].set(w_inf)
+
+    y = x @ w + jnp.asarray(bias, dtype)
+    if noise > 0.0:
+        y = y + noise * jax.random.normal(ks[3], y.shape, dtype=dtype)
+
+    if shuffle:
+        perm = jax.random.permutation(ks[4], n_samples)
+        x, y = x[perm], y[perm]
+
+    y = y[:, 0] if n_targets == 1 else y
+    if coef:
+        return x, y, w
+    return x, y

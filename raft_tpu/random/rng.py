@@ -1,0 +1,171 @@
+"""Device RNG state and distributions.
+
+Reference surface: ``RngState`` (``random/rng_state.hpp:28-52``) with
+``GenPhilox``/``GenPC`` generator types, and the distribution set of
+``random/rng.cuh:44-``. On TPU the generator is JAX's counter-based PRNG
+(threefry2x32 by default) — like Philox, it is splittable and stateless,
+which is exactly the property the reference relies on for reproducible
+parallel streams. ``RngState`` advances functionally *and* offers an
+in-place ``advance`` for handle-style use.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class GeneratorType(enum.IntEnum):
+    """Mirrors reference GeneratorType (rng_state.hpp:28-35); both map to
+    JAX counter-based generators."""
+
+    GenPhilox = 0   # -> threefry2x32
+    GenPC = 1       # -> rbg
+
+
+class RngState:
+    """Seed + subsequence state (reference ``RngState``, rng_state.hpp:37).
+
+    Each call to :meth:`next_key` derives a fresh independent stream by
+    folding in an incrementing subsequence counter — the analogue of the
+    reference's per-call ``advance(subsequence)``.
+    """
+
+    def __init__(self, seed: int = 0,
+                 type: GeneratorType = GeneratorType.GenPhilox):
+        impl = "threefry2x32" if type == GeneratorType.GenPhilox else "rbg"
+        self.seed = int(seed)
+        self.type = GeneratorType(type)
+        self._base = jax.random.key(self.seed, impl=impl)
+        self.subsequence = 0
+
+    def advance(self, n: int = 1) -> None:
+        self.subsequence += int(n)
+
+    def next_key(self) -> jax.Array:
+        key = jax.random.fold_in(self._base, self.subsequence)
+        self.advance()
+        return key
+
+    def key_at(self, subsequence: int) -> jax.Array:
+        return jax.random.fold_in(self._base, subsequence)
+
+
+KeyLike = Union[RngState, jax.Array, int]
+
+
+def _key(rng: KeyLike) -> jax.Array:
+    if isinstance(rng, RngState):
+        return rng.next_key()
+    if isinstance(rng, int):
+        return jax.random.key(rng)
+    return rng
+
+
+# -- distributions (rng.cuh order) ------------------------------------------
+
+def uniform(rng: KeyLike, shape, start=0.0, end=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_key(rng), shape, dtype=dtype,
+                              minval=start, maxval=end)
+
+
+def uniformInt(rng: KeyLike, shape, start: int, end: int, dtype=jnp.int32):
+    return jax.random.randint(_key(rng), shape, start, end, dtype=dtype)
+
+
+def normal(rng: KeyLike, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_key(rng), shape, dtype=dtype)
+
+
+def normalInt(rng: KeyLike, shape, mu: int, sigma: int, dtype=jnp.int32):
+    return jnp.round(
+        mu + sigma * jax.random.normal(_key(rng), shape, dtype=jnp.float32)
+    ).astype(dtype)
+
+
+def normalTable(rng: KeyLike, n_rows: int, mu_vec, sigma_vec, dtype=jnp.float32):
+    """Per-column mu/sigma gaussian table (rng.cuh normalTable)."""
+    mu_vec = jnp.asarray(mu_vec, dtype=dtype)
+    sigma_vec = jnp.asarray(sigma_vec, dtype=dtype)
+    n_cols = mu_vec.shape[0]
+    z = jax.random.normal(_key(rng), (n_rows, n_cols), dtype=dtype)
+    return mu_vec[None, :] + sigma_vec[None, :] * z
+
+
+def fill(rng: KeyLike, shape, val, dtype=jnp.float32):
+    return jnp.full(shape, val, dtype=dtype)
+
+
+def bernoulli(rng: KeyLike, shape, prob: float, dtype=jnp.bool_):
+    return jax.random.bernoulli(_key(rng), prob, shape).astype(dtype)
+
+
+def scaled_bernoulli(rng: KeyLike, shape, prob: float, scale: float,
+                     dtype=jnp.float32):
+    """±scale with P(keep)=prob → reference scaled_bernoulli: val<prob ?
+    -scale : scale."""
+    u = jax.random.uniform(_key(rng), shape, dtype=dtype)
+    return jnp.where(u < prob, -scale, scale).astype(dtype)
+
+
+def gumbel(rng: KeyLike, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_key(rng), shape, dtype=dtype)
+
+
+def lognormal(rng: KeyLike, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(rng, shape, mu, sigma, dtype))
+
+
+def logistic(rng: KeyLike, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(_key(rng), shape, dtype=dtype)
+
+
+def exponential(rng: KeyLike, shape, lambda_=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_key(rng), shape, dtype=dtype) / lambda_
+
+
+def rayleigh(rng: KeyLike, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_key(rng), shape, dtype=dtype, minval=1e-7, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(rng: KeyLike, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(_key(rng), shape, dtype=dtype)
+
+
+def discrete(rng: KeyLike, shape, weights):
+    """Sample indices ∝ weights (rng.cuh discrete)."""
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    logits = jnp.log(jnp.maximum(weights, 1e-37))
+    return jax.random.categorical(_key(rng), logits, shape=tuple(shape)).astype(jnp.int32)
+
+
+def sample_without_replacement(rng: KeyLike, n: int, n_samples: int,
+                               weights=None) -> jax.Array:
+    """Weighted sampling without replacement via the Gumbel top-k trick —
+    the TPU-friendly equivalent of the reference's one-pass
+    ``sampleWithoutReplacement`` (rng.cuh)."""
+    expects(n_samples <= n, "sampleWithoutReplacement: n_samples > n")
+    if weights is None:
+        scores = jax.random.uniform(_key(rng), (n,))
+    else:
+        w = jnp.maximum(jnp.asarray(weights, dtype=jnp.float32), 1e-37)
+        scores = jnp.log(w) + jax.random.gumbel(_key(rng), (n,))
+    _, idx = jax.lax.top_k(scores, n_samples)
+    return idx.astype(jnp.int32)
+
+
+def permute(rng: KeyLike, n: int = None, array=None, axis: int = 0):
+    """Random permutation: returns perm indices, or shuffled array if given
+    (reference permute writes both)."""
+    if array is not None:
+        arr = jnp.asarray(array)
+        perm = jax.random.permutation(_key(rng), arr.shape[axis])
+        return perm.astype(jnp.int32), jnp.take(arr, perm, axis=axis)
+    expects(n is not None, "permute: need n or array")
+    return jax.random.permutation(_key(rng), n).astype(jnp.int32)

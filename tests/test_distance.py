@@ -1,0 +1,209 @@
+"""Pairwise distance tests vs scipy — the reference's own Python test
+strategy (``python/pylibraft/pylibraft/test/test_distance.py:16,49``
+compares against ``scipy.spatial.distance.cdist``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from scipy.spatial import distance as scipy_dist
+
+from raft_tpu.distance import (
+    DistanceType,
+    pairwise_distance,
+    distance,
+    fused_l2_nn,
+    fused_l2_nn_argmin,
+    gram_matrix,
+    KernelParams,
+    KernelType,
+)
+from raft_tpu.random import make_blobs
+
+SCIPY_NAMES = {
+    "euclidean": "euclidean",
+    "l2": "euclidean",
+    "sqeuclidean": "sqeuclidean",
+    "l1": "cityblock",
+    "cityblock": "cityblock",
+    "chebyshev": "chebyshev",
+    "canberra": "canberra",
+    "cosine": "cosine",
+    "correlation": "correlation",
+    "hamming": "hamming",
+    "jensenshannon": "jensenshannon",
+    "russellrao": "russellrao",
+    "braycurtis": "braycurtis",
+    "minkowski": "minkowski",
+}
+
+
+def _data(rng_np, m=60, n=45, k=24, positive=False, binary=False):
+    x = rng_np.random((m, k), dtype=np.float32)
+    y = rng_np.random((n, k), dtype=np.float32)
+    if binary:
+        x = (x > 0.5).astype(np.float32)
+        y = (y > 0.5).astype(np.float32)
+    elif not positive:
+        x = x * 2 - 1
+        y = y * 2 - 1
+    return x, y
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "sqeuclidean", "l1",
+                                    "chebyshev", "canberra", "cosine",
+                                    "correlation", "braycurtis"])
+def test_vs_scipy_real(rng_np, metric):
+    x, y = _data(rng_np)
+    got = np.asarray(pairwise_distance(x, y, metric=metric))
+    want = scipy_dist.cdist(x, y, SCIPY_NAMES[metric])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("metric", ["hamming", "russellrao", "jaccard", "dice"])
+def test_vs_scipy_binary(rng_np, metric):
+    x, y = _data(rng_np, binary=True)
+    got = np.asarray(pairwise_distance(x, y, metric=metric))
+    want = scipy_dist.cdist(x, y, metric)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_minkowski(rng_np):
+    x, y = _data(rng_np)
+    got = np.asarray(pairwise_distance(x, y, metric="minkowski", p=3.0))
+    want = scipy_dist.cdist(x, y, "minkowski", p=3.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_jensenshannon_probability_rows(rng_np):
+    x, y = _data(rng_np, positive=True)
+    x /= x.sum(axis=1, keepdims=True)
+    y /= y.sum(axis=1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="jensenshannon"))
+    want = scipy_dist.cdist(x, y, "jensenshannon")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_kl_divergence(rng_np):
+    x, y = _data(rng_np, positive=True)
+    x /= x.sum(axis=1, keepdims=True)
+    y /= y.sum(axis=1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="kl_divergence"))
+    want = np.array([[np.sum(xi * np.log(xi / yj)) for yj in y] for xi in x])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_hellinger(rng_np):
+    x, y = _data(rng_np, positive=True)
+    x /= x.sum(axis=1, keepdims=True)
+    y /= y.sum(axis=1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="hellinger"))
+    want = np.sqrt(
+        np.maximum(1.0 - np.sqrt(x) @ np.sqrt(y).T, 0.0))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_inner_product(rng_np):
+    x, y = _data(rng_np)
+    got = np.asarray(pairwise_distance(x, y, metric="inner_product"))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-4, atol=1e-4)
+
+
+def test_l2_expanded_vs_unexpanded(rng_np):
+    x, y = _data(rng_np)
+    de = np.asarray(distance(x, y, DistanceType.L2Expanded))
+    du = np.asarray(distance(x, y, DistanceType.L2Unexpanded))
+    np.testing.assert_allclose(de, du, rtol=1e-3, atol=1e-3)
+
+
+def test_haversine():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1.0, 1.0, (10, 2)).astype(np.float32)
+    y = rng.uniform(-1.0, 1.0, (12, 2)).astype(np.float32)
+    got = np.asarray(distance(x, y, DistanceType.Haversine))
+
+    def hav(a, b):
+        lat1, lon1 = a
+        lat2, lon2 = b
+        h = (np.sin((lat2 - lat1) / 2) ** 2
+             + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2)
+        return 2 * np.arcsin(np.sqrt(h))
+
+    want = np.array([[hav(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_unsupported_metric_raises(rng_np):
+    x, y = _data(rng_np)
+    with pytest.raises(ValueError):
+        pairwise_distance(x, y, metric="not_a_metric")
+
+
+def test_dim_mismatch_raises(rng_np):
+    x = rng_np.random((4, 3), dtype=np.float32)
+    y = rng_np.random((4, 5), dtype=np.float32)
+    with pytest.raises(Exception):
+        pairwise_distance(x, y)
+
+
+def test_readme_example_make_blobs():
+    """The minimum end-to-end slice (SURVEY.md §7 step 2): 5000x50
+    make_blobs through pairwise_distance, matching scipy."""
+    x, _ = make_blobs(n_samples=500, n_features=50, centers=5, seed=3)
+    xn = np.asarray(x)
+    got = np.asarray(pairwise_distance(x, x, metric="euclidean"))
+    want = scipy_dist.cdist(xn, xn, "euclidean")
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_bf16_input_fp32_accum(rng_np):
+    x, y = _data(rng_np, m=32, n=16, k=64)
+    xb = jnp.asarray(x, dtype=jnp.bfloat16)
+    yb = jnp.asarray(y, dtype=jnp.bfloat16)
+    got = np.asarray(pairwise_distance(xb, yb, metric="sqeuclidean"))
+    want = scipy_dist.cdist(x, y, "sqeuclidean")
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+class TestFusedL2NN:
+    def test_matches_bruteforce(self, rng_np):
+        x, y = _data(rng_np, m=300, n=257, k=17)
+        kvp = fused_l2_nn(x, y, sqrt=False)
+        d = scipy_dist.cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(kvp.key), d.argmin(axis=1))
+        np.testing.assert_allclose(np.asarray(kvp.value), d.min(axis=1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_sqrt_mode(self, rng_np):
+        x, y = _data(rng_np, m=64, n=50, k=8)
+        kvp = fused_l2_nn(x, y, sqrt=True)
+        d = scipy_dist.cdist(x, y, "euclidean")
+        np.testing.assert_allclose(np.asarray(kvp.value), d.min(axis=1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_argmin_api(self, rng_np):
+        x, y = _data(rng_np, m=40, n=30, k=5)
+        idx = fused_l2_nn_argmin(x, y)
+        d = scipy_dist.cdist(x, y, "euclidean")
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(axis=1))
+
+
+class TestGram:
+    def test_linear(self, rng_np):
+        x, y = _data(rng_np)
+        k = np.asarray(gram_matrix(x, y))
+        np.testing.assert_allclose(k, x @ y.T, rtol=1e-4, atol=1e-4)
+
+    def test_rbf(self, rng_np):
+        x, y = _data(rng_np, m=20, n=15, k=6)
+        params = KernelParams(kernel=KernelType.RBF, gamma=0.5)
+        k = np.asarray(gram_matrix(x, y, params))
+        d2 = scipy_dist.cdist(x, y, "sqeuclidean")
+        np.testing.assert_allclose(k, np.exp(-0.5 * d2), rtol=1e-4, atol=1e-4)
+
+    def test_poly_tanh(self, rng_np):
+        x, y = _data(rng_np, m=10, n=10, k=4)
+        kp = np.asarray(gram_matrix(x, y, KernelParams(KernelType.POLYNOMIAL, 2, 1.5, 0.5)))
+        np.testing.assert_allclose(kp, (1.5 * x @ y.T + 0.5) ** 2, rtol=1e-4, atol=1e-4)
+        kt = np.asarray(gram_matrix(x, y, KernelParams(KernelType.TANH, 3, 0.1, 0.2)))
+        np.testing.assert_allclose(kt, np.tanh(0.1 * x @ y.T + 0.2), rtol=1e-4, atol=1e-4)

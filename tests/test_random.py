@@ -1,0 +1,191 @@
+"""RNG statistical tests (reference analogue: cpp/test/random/rng.cu
+moment checks; make_blobs.cu cluster mean/sigma verification)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import random as rrand
+from raft_tpu.random import (
+    RngState,
+    GeneratorType,
+    make_blobs,
+    make_regression,
+    multi_variable_gaussian,
+    rmat_rectangular_gen,
+    sample_without_replacement,
+    permute,
+)
+
+N = 20000
+
+
+def _check_moments(x, mean, std, tol=0.1):
+    x = np.asarray(x, dtype=np.float64)
+    assert abs(x.mean() - mean) < tol * max(1.0, abs(mean) + std)
+    assert abs(x.std() - std) < tol * max(1.0, std)
+
+
+class TestDistributions:
+    def test_uniform(self):
+        x = rrand.uniform(RngState(0), (N,), -2.0, 2.0)
+        _check_moments(x, 0.0, 4.0 / np.sqrt(12))
+        assert float(jnp.min(x)) >= -2.0 and float(jnp.max(x)) < 2.0
+
+    def test_uniform_int(self):
+        x = rrand.uniformInt(RngState(1), (N,), 5, 15)
+        xi = np.asarray(x)
+        assert xi.min() >= 5 and xi.max() < 15
+
+    def test_normal(self):
+        x = rrand.normal(RngState(2), (N,), mu=3.0, sigma=2.0)
+        _check_moments(x, 3.0, 2.0)
+
+    def test_lognormal(self):
+        x = rrand.lognormal(RngState(3), (N,), mu=0.0, sigma=0.25)
+        assert float(jnp.min(x)) > 0
+
+    def test_bernoulli(self):
+        x = rrand.bernoulli(RngState(4), (N,), prob=0.3)
+        p = float(jnp.mean(x.astype(jnp.float32)))
+        assert abs(p - 0.3) < 0.02
+
+    def test_scaled_bernoulli(self):
+        x = np.asarray(rrand.scaled_bernoulli(RngState(5), (N,), 0.5, 2.0))
+        assert set(np.unique(x)) <= {-2.0, 2.0}
+
+    def test_exponential(self):
+        x = rrand.exponential(RngState(6), (N,), lambda_=2.0)
+        _check_moments(x, 0.5, 0.5, tol=0.15)
+
+    def test_gumbel_logistic_laplace_rayleigh(self):
+        g = rrand.gumbel(RngState(7), (N,))
+        _check_moments(g, 0.5772, np.pi / np.sqrt(6), tol=0.15)
+        lo = rrand.logistic(RngState(8), (N,), 0.0, 1.0)
+        _check_moments(lo, 0.0, np.pi / np.sqrt(3), tol=0.15)
+        la = rrand.laplace(RngState(9), (N,))
+        _check_moments(la, 0.0, np.sqrt(2), tol=0.15)
+        ra = rrand.rayleigh(RngState(10), (N,), sigma=1.0)
+        _check_moments(ra, np.sqrt(np.pi / 2), np.sqrt(2 - np.pi / 2), tol=0.15)
+
+    def test_normal_table(self):
+        mu = jnp.asarray([0.0, 10.0, -5.0])
+        sig = jnp.asarray([1.0, 2.0, 0.5])
+        x = np.asarray(rrand.normalTable(RngState(11), N, mu, sig))
+        np.testing.assert_allclose(x.mean(axis=0), [0, 10, -5], atol=0.2)
+        np.testing.assert_allclose(x.std(axis=0), [1, 2, 0.5], rtol=0.1)
+
+    def test_discrete(self):
+        w = jnp.asarray([0.1, 0.0, 0.6, 0.3])
+        x = np.asarray(rrand.discrete(RngState(12), (N,), w))
+        counts = np.bincount(x, minlength=4) / N
+        np.testing.assert_allclose(counts, [0.1, 0.0, 0.6, 0.3], atol=0.03)
+
+    def test_fill(self):
+        x = rrand.fill(RngState(0), (7,), 3.5)
+        np.testing.assert_array_equal(np.asarray(x), np.full(7, 3.5, np.float32))
+
+
+class TestRngState:
+    def test_reproducible(self):
+        a = rrand.normal(RngState(42), (100,))
+        b = rrand.normal(RngState(42), (100,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_streams_advance(self):
+        st = RngState(42)
+        a = rrand.normal(st, (100,))
+        b = rrand.normal(st, (100,))
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_generator_types(self):
+        for t in (GeneratorType.GenPhilox, GeneratorType.GenPC):
+            x = rrand.uniform(RngState(1, type=t), (64,))
+            assert x.shape == (64,)
+
+
+class TestSampling:
+    def test_without_replacement_unique(self):
+        idx = np.asarray(sample_without_replacement(RngState(0), 100, 50))
+        assert len(np.unique(idx)) == 50
+        assert idx.min() >= 0 and idx.max() < 100
+
+    def test_weighted_without_replacement(self):
+        w = np.zeros(100, np.float32)
+        w[:10] = 1.0  # only first 10 have mass
+        idx = np.asarray(sample_without_replacement(RngState(1), 100, 10, w))
+        assert set(idx.tolist()) == set(range(10))
+
+    def test_permute(self):
+        perm = np.asarray(permute(RngState(2), 50))
+        assert sorted(perm.tolist()) == list(range(50))
+
+    def test_permute_array(self):
+        arr = jnp.arange(20)
+        perm, shuffled = permute(RngState(3), array=arr)
+        np.testing.assert_array_equal(np.asarray(arr)[np.asarray(perm)],
+                                      np.asarray(shuffled))
+
+
+class TestMakeBlobs:
+    def test_shapes_and_labels(self):
+        x, y = make_blobs(n_samples=1000, n_features=8, centers=4, seed=0)
+        assert x.shape == (1000, 8)
+        assert y.shape == (1000,)
+        assert set(np.unique(np.asarray(y))) <= set(range(4))
+
+    def test_cluster_statistics(self):
+        centers = jnp.asarray([[0.0, 0.0], [20.0, 20.0]])
+        x, y = make_blobs(n_samples=4000, n_features=2, centers=centers,
+                          cluster_std=1.0, seed=1)
+        xn, yn = np.asarray(x), np.asarray(y)
+        for c in range(2):
+            pts = xn[yn == c]
+            np.testing.assert_allclose(pts.mean(axis=0), np.asarray(centers)[c],
+                                       atol=0.2)
+            np.testing.assert_allclose(pts.std(axis=0), [1, 1], rtol=0.15)
+
+
+class TestMakeRegression:
+    def test_exact_linear_recovery(self):
+        x, y, w = make_regression(n_samples=200, n_features=10,
+                                  n_informative=5, noise=0.0, coef=True,
+                                  shuffle=False, seed=0)
+        np.testing.assert_allclose(np.asarray(x @ w)[:, 0], np.asarray(y),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_effective_rank(self):
+        x, y = make_regression(n_samples=100, n_features=50,
+                               effective_rank=5, seed=0)
+        s = np.linalg.svd(np.asarray(x), compute_uv=False)
+        assert s[6] < s[0] * 0.5  # spectrum decays
+
+
+class TestMVG:
+    def test_covariance_recovery(self):
+        cov = np.array([[2.0, 0.8], [0.8, 1.0]], np.float32)
+        mu = np.array([1.0, -1.0], np.float32)
+        for method in ("cholesky", "eig"):
+            x = np.asarray(multi_variable_gaussian(RngState(0), 20000, mu, cov,
+                                                   method=method))
+            np.testing.assert_allclose(x.mean(axis=0), mu, atol=0.05)
+            np.testing.assert_allclose(np.cov(x.T), cov, atol=0.1)
+
+
+class TestRmat:
+    def test_ranges_and_skew(self):
+        src, dst = rmat_rectangular_gen(RngState(0), [0.57, 0.19, 0.19, 0.05],
+                                        r_scale=8, c_scale=8, n_edges=20000)
+        s, d = np.asarray(src), np.asarray(dst)
+        assert s.min() >= 0 and s.max() < 256
+        assert d.min() >= 0 and d.max() < 256
+        # a=0.57 skews mass to low ids
+        assert (s < 128).mean() > 0.6
+        assert (d < 128).mean() > 0.6
+
+    def test_rectangular(self):
+        src, dst = rmat_rectangular_gen(RngState(1), [0.25, 0.25, 0.25, 0.25],
+                                        r_scale=6, c_scale=9, n_edges=5000)
+        assert np.asarray(src).max() < 64
+        assert np.asarray(dst).max() < 512
