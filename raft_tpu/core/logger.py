@@ -34,8 +34,18 @@ _LEVEL_TO_PY = {
 }
 
 
+def _py_to_raft_level(py_level: int) -> int:
+    """Map a Python logging level back onto the raft 0-6 scale so user
+    callbacks see the same level numbers the public constants use."""
+    for raft_level in (CRITICAL, ERROR, WARN, INFO, DEBUG, TRACE):
+        if py_level >= _LEVEL_TO_PY[raft_level]:
+            return raft_level
+    return TRACE
+
+
 class _CallbackHandler(logging.Handler):
-    """Routes records to a user callback (reference callback_sink)."""
+    """Routes records to a user callback (reference callback_sink).
+    Callback receives (raft_level, formatted_message)."""
 
     def __init__(self, callback: Callable[[int, str], None],
                  flush: Optional[Callable[[], None]] = None):
@@ -44,7 +54,7 @@ class _CallbackHandler(logging.Handler):
         self._flush = flush
 
     def emit(self, record: logging.LogRecord) -> None:
-        self._callback(record.levelno, self.format(record))
+        self._callback(_py_to_raft_level(record.levelno), self.format(record))
 
     def flush(self) -> None:
         if self._flush is not None:

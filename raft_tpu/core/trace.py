@@ -11,12 +11,19 @@ traces, and are gated by ``enable_tracing`` (reference gates on the
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import List
 
 import jax
 
 _enabled = True
-_stack: List[object] = []
+_tls = threading.local()
+
+
+def _stack() -> List[object]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
 
 
 def enable_tracing(on: bool = True) -> None:
@@ -37,14 +44,22 @@ def range(fmt: str, *args):
 
 def push_range(fmt: str, *args) -> None:
     if not _enabled:
+        # push a placeholder so push/pop pairs stay balanced even if
+        # tracing is toggled between them
+        _stack().append(None)
         return
     name = fmt % args if args else fmt
     ann = jax.profiler.TraceAnnotation(name)
     ann.__enter__()
-    _stack.append(ann)
+    _stack().append(ann)
 
 
 def pop_range() -> None:
-    if not _enabled or not _stack:
+    """Pops regardless of the current enable state: an annotation entered
+    while tracing was on must always be exited."""
+    stack = _stack()
+    if not stack:
         return
-    _stack.pop().__exit__(None, None, None)
+    ann = stack.pop()
+    if ann is not None:
+        ann.__exit__(None, None, None)

@@ -69,5 +69,5 @@ SUPPORTED_DISTANCES = [
     "euclidean", "l1", "cityblock", "l2", "inner_product", "chebyshev",
     "minkowski", "canberra", "kl_divergence", "correlation", "russellrao",
     "hellinger", "lp", "hamming", "jensenshannon", "cosine", "sqeuclidean",
-    "jaccard", "dice", "braycurtis",
+    "jaccard", "dice", "braycurtis", "haversine",
 ]

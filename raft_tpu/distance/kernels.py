@@ -46,8 +46,10 @@ def _dot(x, y):
                            preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("kernel", "degree", "gamma", "coef0"))
-def _gram(x, y, kernel: KernelType, degree: int, gamma: float, coef0: float):
+# gamma/coef0 are traced scalars: hyperparameter sweeps reuse one
+# compiled kernel per (kernel, degree, shape) instead of recompiling.
+@functools.partial(jax.jit, static_argnames=("kernel", "degree"))
+def _gram(x, y, gamma, coef0, kernel: KernelType, degree: int):
     ip = _dot(x, y)
     if kernel == KernelType.LINEAR:
         return ip
@@ -68,5 +70,5 @@ def _gram(x, y, kernel: KernelType, degree: int, gamma: float, coef0: float):
 def gram_matrix(x, y, params: KernelParams = KernelParams(), res=None) -> jax.Array:
     """Evaluate the (m, n) Gram matrix K(x_i, y_j)."""
     x, y = as_array(x), as_array(y)
-    return _gram(x, y, KernelType(params.kernel), int(params.degree),
-                 float(params.gamma), float(params.coef0))
+    return _gram(x, y, jnp.float32(params.gamma), jnp.float32(params.coef0),
+                 kernel=KernelType(params.kernel), degree=int(params.degree))

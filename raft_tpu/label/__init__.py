@@ -1,0 +1,6 @@
+"""Label utilities (SURVEY.md §2.9, reference ``raft/label``)."""
+
+from raft_tpu.label.classlabels import get_unique_labels, make_monotonic
+from raft_tpu.label.merge_labels import merge_labels
+
+__all__ = ["get_unique_labels", "make_monotonic", "merge_labels"]

@@ -1,0 +1,36 @@
+"""Class-label utilities.
+
+Reference: ``raft/label/classlabels.cuh`` — ``getUniquelabels`` (sorted
+distinct labels) and ``make_monotonic`` (remap arbitrary labels onto
+0..n_classes-1 by rank).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def get_unique_labels(labels, res=None) -> jax.Array:
+    """Sorted unique labels. Host-synchronizing (output size is
+    data-dependent), like the reference which returns the count."""
+    l = as_array(labels)
+    return jnp.unique(jax.device_get(l))
+
+
+def make_monotonic(labels, classes=None, res=None) -> Tuple[jax.Array, jax.Array]:
+    """Remap labels to 0..k-1 by sorted rank; returns (mapped, classes).
+
+    Jit-compatible when ``classes`` is provided (searchsorted over the
+    class table); otherwise computes the table on host first.
+    """
+    l = as_array(labels)
+    if classes is None:
+        classes = get_unique_labels(l, res)
+    classes = as_array(classes)
+    mapped = jnp.searchsorted(classes, l).astype(jnp.int32)
+    return mapped, classes

@@ -1,0 +1,68 @@
+"""Dense linear algebra (SURVEY.md §2.4, reference ``raft/linalg``).
+
+The reference's ~35 ops in four groups: BLAS wrappers (cuBLAS), solver
+wrappers (cuSOLVER), the elementwise lambda framework, and the reduction
+framework. On TPU the BLAS group is XLA-native (``jnp.dot`` hits the MXU),
+solvers use ``jnp.linalg``/``jax.scipy`` plus bespoke JAX loops where the
+reference used Jacobi/rank-1 variants, and both frameworks keep the
+reference's lambda-parameterized shape (main_op/reduce_op/final_op).
+"""
+
+from raft_tpu.linalg.blas import gemm, gemv, axpy, dot, transpose
+from raft_tpu.linalg.eig import eig_dc, eig_dc_selective, eig_jacobi
+from raft_tpu.linalg.svd import (
+    svd_qr,
+    svd_eig,
+    svd_jacobi,
+    svd_reconstruction,
+    rsvd,
+)
+from raft_tpu.linalg.qr import qr_get_q, qr_get_qr
+from raft_tpu.linalg.lstsq import lstsq_svd_qr, lstsq_svd_jacobi, lstsq_eig, lstsq_qr
+from raft_tpu.linalg.cholesky import cholesky_r1_update
+from raft_tpu.linalg.elementwise import (
+    unary_op,
+    binary_op,
+    ternary_op,
+    map_,
+    map_reduce,
+    add,
+    subtract,
+    multiply,
+    divide,
+    power,
+    sqrt,
+    eltwise_add,
+    mean_squared_error,
+    matrix_vector_op,
+    linewise_op,
+    init_arange,
+)
+from raft_tpu.linalg.reduce import (
+    Apply,
+    reduce,
+    coalesced_reduction,
+    strided_reduction,
+    norm,
+    NormType,
+    row_norm,
+    col_norm,
+    reduce_rows_by_key,
+    reduce_cols_by_key,
+    normalize_rows,
+)
+
+__all__ = [
+    "gemm", "gemv", "axpy", "dot", "transpose",
+    "eig_dc", "eig_dc_selective", "eig_jacobi",
+    "svd_qr", "svd_eig", "svd_jacobi", "svd_reconstruction", "rsvd",
+    "qr_get_q", "qr_get_qr",
+    "lstsq_svd_qr", "lstsq_svd_jacobi", "lstsq_eig", "lstsq_qr",
+    "cholesky_r1_update",
+    "unary_op", "binary_op", "ternary_op", "map_", "map_reduce",
+    "add", "subtract", "multiply", "divide", "power", "sqrt", "eltwise_add",
+    "mean_squared_error", "matrix_vector_op", "linewise_op", "init_arange",
+    "Apply", "reduce", "coalesced_reduction", "strided_reduction",
+    "norm", "NormType", "row_norm", "col_norm",
+    "reduce_rows_by_key", "reduce_cols_by_key", "normalize_rows",
+]

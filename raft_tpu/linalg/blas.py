@@ -1,0 +1,60 @@
+"""BLAS-level ops.
+
+Reference: ``raft/linalg/gemm.cuh:55`` (cuBLAS gemm with alpha/beta and
+transpose flags), ``gemv.cuh``, ``axpy.cuh``, ``transpose.cuh``
+(cublasgeam). On TPU each is one XLA op; gemm accumulates fp32 on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.mdarray import as_array
+
+
+def gemm(a, b, alpha: float = 1.0, beta: float = 0.0, c=None,
+         trans_a: bool = False, trans_b: bool = False, res=None) -> jax.Array:
+    """C = alpha * op(A) @ op(B) + beta * C (reference linalg/gemm.cuh:55)."""
+    a, b = as_array(a), as_array(b)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    out = alpha * out
+    if c is not None and beta != 0.0:
+        out = out + beta * as_array(c)
+    return out.astype(a.dtype)
+
+
+def gemv(a, x, alpha: float = 1.0, beta: float = 0.0, y=None,
+         trans: bool = False, res=None) -> jax.Array:
+    """y = alpha * op(A) @ x + beta * y (reference linalg/gemv.cuh)."""
+    a, x = as_array(a), as_array(x)
+    if trans:
+        a = a.T
+    out = alpha * (a @ x)
+    if y is not None and beta != 0.0:
+        out = out + beta * as_array(y)
+    return out
+
+
+def axpy(alpha: float, x, y, res=None) -> jax.Array:
+    """alpha * x + y (reference linalg/axpy.cuh)."""
+    return alpha * as_array(x) + as_array(y)
+
+
+def dot(x, y, res=None) -> jax.Array:
+    """<x, y> (reference linalg/dot.cuh)."""
+    return jnp.dot(as_array(x), as_array(y),
+                   preferred_element_type=jnp.float32)
+
+
+def transpose(a, res=None) -> jax.Array:
+    """Out-of-place transpose (reference linalg/transpose.cuh; XLA fuses
+    this into consumers rather than materializing, which is strictly better
+    than the cublasgeam copy)."""
+    return as_array(a).T

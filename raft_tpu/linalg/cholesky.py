@@ -1,0 +1,37 @@
+"""Rank-1 Cholesky update.
+
+Reference: ``raft/linalg/cholesky_r1_update.cuh`` — incrementally extends a
+Cholesky factor L of A[:n,:n] to cover A[:n+1,:n+1] given the new
+row/column; used by kmeans++ and GP-style workloads. The TPU formulation is
+the same algebra (one triangular solve + scalar): given lower L (n,n) and
+new column a (n+1,), compute b = L⁻¹ a[:n], d = sqrt(a[n] - bᵀb).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+
+
+def cholesky_r1_update(l_factor, new_col, eps: float = 0.0, res=None
+                       ) -> jax.Array:
+    """Extend lower-triangular ``l_factor`` (n, n) with ``new_col``
+    (n+1,) -> (n+1, n+1) factor. ``eps`` is added to the new diagonal
+    entry before sqrt for numerical safety (reference's eps parameter)."""
+    l_factor = as_array(l_factor).astype(jnp.float32)
+    new_col = as_array(new_col).astype(jnp.float32)
+    n = l_factor.shape[0]
+    expects(new_col.shape[0] == n + 1, "cholesky_r1_update: need n+1 entries")
+    if n == 0:
+        return jnp.sqrt(jnp.maximum(new_col[:1, None], eps if eps > 0 else 0.0))
+    b = jax.scipy.linalg.solve_triangular(l_factor, new_col[:n], lower=True)
+    d2 = new_col[n] - jnp.dot(b, b) + eps
+    d = jnp.sqrt(jnp.maximum(d2, 0.0))
+    top = jnp.concatenate([l_factor, jnp.zeros((n, 1), l_factor.dtype)], axis=1)
+    bottom = jnp.concatenate([b, jnp.asarray([d], l_factor.dtype)])[None, :]
+    return jnp.concatenate([top, bottom], axis=0)

@@ -1,0 +1,126 @@
+"""Elementwise lambda framework.
+
+Reference: ``raft/linalg/{unary_op,binary_op,ternary_op,map,map_reduce,
+eltwise,matrix_vector_op}.cuh`` + ``matrix/linewise_op.cuh`` — the CUDA
+versions exist to give hand-written kernels vectorized IO; under XLA every
+one of these is a fused elementwise HLO, so the framework here is a direct
+functional surface whose value is API parity and the broadcast semantics of
+``matrix_vector_op``/``linewise_op`` (Apply::ALONG_ROWS|ALONG_COLUMNS).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.linalg.reduce import Apply
+
+
+def unary_op(x, op: Callable, res=None) -> jax.Array:
+    return op(as_array(x))
+
+
+def binary_op(x, y, op: Callable, res=None) -> jax.Array:
+    return op(as_array(x), as_array(y))
+
+
+def ternary_op(x, y, z, op: Callable, res=None) -> jax.Array:
+    return op(as_array(x), as_array(y), as_array(z))
+
+
+def map_(op: Callable, *arrays, res=None) -> jax.Array:
+    """N-ary map (reference linalg/map.cuh)."""
+    return op(*[as_array(a) for a in arrays])
+
+
+def map_reduce(op: Callable, reduce_op: Callable, neutral, *arrays,
+               res=None) -> jax.Array:
+    """map_then_reduce (reference linalg/map_then_reduce.cuh): elementwise
+    ``op`` then full reduction with ``reduce_op`` starting from
+    ``neutral``."""
+    mapped = op(*[as_array(a) for a in arrays])
+    flat = mapped.reshape(-1)
+    return jax.lax.reduce(flat, jnp.asarray(neutral, flat.dtype),
+                          reduce_op, (0,))
+
+
+# -- eltwise arithmetic (linalg/{add,subtract,multiply,divide,power,sqrt}.cuh)
+def add(x, y, res=None):
+    return as_array(x) + as_array(y)
+
+
+def subtract(x, y, res=None):
+    return as_array(x) - as_array(y)
+
+
+def multiply(x, y, res=None):
+    return as_array(x) * as_array(y)
+
+
+def divide(x, y, res=None):
+    return as_array(x) / as_array(y)
+
+
+def power(x, y, res=None):
+    return as_array(x) ** as_array(y)
+
+
+def sqrt(x, res=None):
+    return jnp.sqrt(as_array(x))
+
+
+def eltwise_add(*xs, res=None):
+    out = as_array(xs[0])
+    for x in xs[1:]:
+        out = out + as_array(x)
+    return out
+
+
+def init_arange(n: int, start=0, step=1, dtype=jnp.float32, res=None):
+    """reference linalg/init.cuh (arange fill)."""
+    return start + step * jnp.arange(n, dtype=dtype)
+
+
+def mean_squared_error(a, b, weight: float = 1.0, res=None) -> jax.Array:
+    """reference linalg/mean_squared_error.cuh."""
+    a, b = as_array(a), as_array(b)
+    d = (a - b).astype(jnp.float32)
+    return weight * jnp.mean(d * d)
+
+
+def matrix_vector_op(mat, vec, op: Callable = jnp.add,
+                     apply: Apply = Apply.ALONG_ROWS,
+                     bcast_along_rows: bool = None, res=None) -> jax.Array:
+    """Broadcast a vector against every row or column of a matrix
+    (reference linalg/matrix_vector_op.cuh).
+
+    ``ALONG_ROWS``: vec has len n_cols, broadcast across rows (each row is
+    combined with the whole vector). ``ALONG_COLUMNS``: vec has len n_rows.
+    """
+    mat, vec = as_array(mat), as_array(vec)
+    if bcast_along_rows is not None:  # reference bool form
+        apply = Apply.ALONG_ROWS if bcast_along_rows else Apply.ALONG_COLUMNS
+    if apply == Apply.ALONG_ROWS:
+        expects(vec.shape[0] == mat.shape[1],
+                "matrix_vector_op: vec len %d != n_cols %d", vec.shape[0], mat.shape[1])
+        return op(mat, vec[None, :])
+    expects(vec.shape[0] == mat.shape[0],
+            "matrix_vector_op: vec len %d != n_rows %d", vec.shape[0], mat.shape[0])
+    return op(mat, vec[:, None])
+
+
+def linewise_op(mat, op: Callable, along_lines: bool, *vecs, res=None) -> jax.Array:
+    """Apply ``op(row_or_col_element, *vec_elements)`` line-wise (reference
+    matrix/linewise_op.cuh). ``along_lines=True`` means vectors run along
+    rows (length n_cols)."""
+    mat = as_array(mat)
+    vs = [as_array(v) for v in vecs]
+    if along_lines:
+        vs = [v[None, :] for v in vs]
+    else:
+        vs = [v[:, None] for v in vs]
+    return op(mat, *vs)

@@ -1,0 +1,48 @@
+"""Least squares solvers — the reference's four algorithms
+(``raft/linalg/lstsq.cuh``): lstsqSvdQR, lstsqSvdJacobi, lstsqEig
+(normal equations via eigh), lstsqQR."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def _via_svd(a, b):
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    s_inv = jnp.where(s > 1e-7 * s[0], 1.0 / s, 0.0)
+    return vt.T @ (s_inv * (u.T @ b))
+
+
+def lstsq_svd_qr(a, b, res=None) -> jax.Array:
+    """min ||Ax - b|| via SVD (reference lstsqSvdQR)."""
+    return _via_svd(as_array(a).astype(jnp.float32),
+                    as_array(b).astype(jnp.float32))
+
+
+def lstsq_svd_jacobi(a, b, res=None) -> jax.Array:
+    """Jacobi-SVD variant; same backend on TPU (reference lstsqSvdJacobi)."""
+    return _via_svd(as_array(a).astype(jnp.float32),
+                    as_array(b).astype(jnp.float32))
+
+
+def lstsq_eig(a, b, res=None) -> jax.Array:
+    """Normal-equations path: solve (AᵀA) x = Aᵀb via eigh (reference
+    lstsqEig — the fastest reference path for well-conditioned systems)."""
+    a = as_array(a).astype(jnp.float32)
+    b = as_array(b).astype(jnp.float32)
+    ata = a.T @ a
+    atb = a.T @ b
+    w, v = jnp.linalg.eigh(ata)
+    w_inv = jnp.where(w > 1e-7 * jnp.max(w), 1.0 / w, 0.0)
+    return v @ (w_inv * (v.T @ atb))
+
+
+def lstsq_qr(a, b, res=None) -> jax.Array:
+    """QR path: R x = Qᵀ b (reference lstsqQR)."""
+    a = as_array(a).astype(jnp.float32)
+    b = as_array(b).astype(jnp.float32)
+    q, r = jnp.linalg.qr(a)
+    return jax.scipy.linalg.solve_triangular(r, q.T @ b, lower=False)

@@ -1,0 +1,22 @@
+"""QR decomposition (reference ``raft/linalg/qr.cuh``: qrGetQ / qrGetQR
+over cuSOLVER geqrf/orgqr)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def qr_get_q(a, res=None) -> jax.Array:
+    a = as_array(a)
+    q, _ = jnp.linalg.qr(a)
+    return q
+
+
+def qr_get_qr(a, res=None) -> Tuple[jax.Array, jax.Array]:
+    a = as_array(a)
+    return jnp.linalg.qr(a)

@@ -1,0 +1,151 @@
+"""Reduction framework.
+
+Reference: ``raft/linalg/reduce.cuh`` with ``coalesced_reduction.cuh``
+(reduce along the contiguous dim) and ``strided_reduction.cuh`` (the
+other), all parameterized by main_op (per-element), reduce_op (pairwise),
+final_op (epilogue); plus ``norm.cuh`` (L1/L2/Linf row/col norms),
+``reduce_rows_by_key.cuh`` and ``reduce_cols_by_key.cuh``.
+
+On TPU both reduction orientations lower to the same XLA reduce (layout is
+the compiler's concern — the coalesced/strided distinction is CUDA-physical
+and intentionally collapses here); by-key reductions use segment_sum, the
+XLA-native equivalent of the reference's atomic scatter-accumulate.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+
+
+class Apply(enum.IntEnum):
+    """reference linalg_types.hpp Apply::ALONG_ROWS|ALONG_COLUMNS."""
+
+    ALONG_ROWS = 0
+    ALONG_COLUMNS = 1
+
+
+class NormType(enum.IntEnum):
+    """reference linalg/norm_types.hpp."""
+
+    L1Norm = 0
+    L2Norm = 1
+    LinfNorm = 2
+
+
+_id = lambda x: x
+
+
+def reduce(data, along_rows: bool = True,
+           main_op: Callable = _id,
+           reduce_op: str = "add",
+           final_op: Callable = _id,
+           init=None, res=None) -> jax.Array:
+    """Row- or column-wise lambda reduction (reference linalg/reduce.cuh).
+
+    ``along_rows=True`` reduces each row to a scalar (output length m).
+    ``reduce_op`` is one of {"add", "min", "max"} — the set the reference
+    kernels are instantiated with. ``init`` seeds the accumulator exactly
+    as in the reference (always combined when given); when omitted it
+    defaults to the op's neutral element (0 / +inf / -inf).
+    """
+    data = as_array(data)
+    mapped = main_op(data)
+    axis = 1 if along_rows else 0
+    if reduce_op == "add":
+        out = jnp.sum(mapped, axis=axis)
+        if init is not None:
+            out = out + init
+    elif reduce_op == "min":
+        out = jnp.min(mapped, axis=axis)
+        if init is not None:
+            out = jnp.minimum(out, init)
+    elif reduce_op == "max":
+        out = jnp.max(mapped, axis=axis)
+        if init is not None:
+            out = jnp.maximum(out, init)
+    else:
+        raise ValueError(f"unsupported reduce_op {reduce_op}")
+    return final_op(out)
+
+
+def coalesced_reduction(data, main_op: Callable = _id, reduce_op: str = "add",
+                        final_op: Callable = _id, init=None, res=None):
+    """Reduce along the contiguous (last) dim — row-wise for row-major
+    (reference coalesced_reduction.cuh)."""
+    return reduce(data, True, main_op, reduce_op, final_op, init, res)
+
+
+def strided_reduction(data, main_op: Callable = _id, reduce_op: str = "add",
+                      final_op: Callable = _id, init=None, res=None):
+    """Reduce along the strided (first) dim — column-wise for row-major
+    (reference strided_reduction.cuh)."""
+    return reduce(data, False, main_op, reduce_op, final_op, init, res)
+
+
+def norm(data, norm_type: NormType, along_rows: bool = True,
+         sqrt: bool = False, res=None) -> jax.Array:
+    """L1/L2/Linf norms per row or column (reference linalg/norm.cuh;
+    note reference L2 returns the *squared* norm unless sqrt=true)."""
+    data = as_array(data).astype(jnp.float32)
+    axis = 1 if along_rows else 0
+    if norm_type == NormType.L1Norm:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == NormType.L2Norm:
+        out = jnp.sum(data * data, axis=axis)
+    elif norm_type == NormType.LinfNorm:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    else:
+        raise ValueError(f"unknown norm type {norm_type}")
+    return jnp.sqrt(out) if sqrt else out
+
+
+def row_norm(data, norm_type: NormType = NormType.L2Norm, sqrt: bool = False,
+             res=None):
+    return norm(data, norm_type, True, sqrt, res)
+
+
+def col_norm(data, norm_type: NormType = NormType.L2Norm, sqrt: bool = False,
+             res=None):
+    return norm(data, norm_type, False, sqrt, res)
+
+
+def normalize_rows(data, res=None) -> jax.Array:
+    """Row L2-normalization (reference matrix/normalize used by cosine
+    preprocessing, spatial/knn/detail/processing.cuh)."""
+    data = as_array(data)
+    n = jnp.sqrt(jnp.sum(data.astype(jnp.float32) ** 2, axis=1, keepdims=True))
+    return (data / jnp.where(n == 0.0, 1.0, n)).astype(data.dtype)
+
+
+def reduce_rows_by_key(data, keys, n_keys: Optional[int] = None,
+                       weights=None, res=None) -> jax.Array:
+    """Sum rows sharing a key → (n_keys, n_cols) (reference
+    linalg/reduce_rows_by_key.cuh). The CUDA version scatter-adds with
+    atomics; segment_sum is the deterministic XLA equivalent."""
+    data = as_array(data)
+    keys = as_array(keys).astype(jnp.int32)
+    expects(keys.shape[0] == data.shape[0], "reduce_rows_by_key: key/row mismatch")
+    if n_keys is None:
+        n_keys = int(jax.device_get(jnp.max(keys))) + 1
+    if weights is not None:
+        data = data * as_array(weights)[:, None]
+    return jax.ops.segment_sum(data, keys, num_segments=n_keys)
+
+
+def reduce_cols_by_key(data, keys, n_keys: Optional[int] = None, res=None
+                       ) -> jax.Array:
+    """Sum columns sharing a key → (n_rows, n_keys) (reference
+    linalg/reduce_cols_by_key.cuh)."""
+    data = as_array(data)
+    keys = as_array(keys).astype(jnp.int32)
+    expects(keys.shape[0] == data.shape[1], "reduce_cols_by_key: key/col mismatch")
+    if n_keys is None:
+        n_keys = int(jax.device_get(jnp.max(keys))) + 1
+    return jax.ops.segment_sum(data.T, keys, num_segments=n_keys).T

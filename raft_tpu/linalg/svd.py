@@ -1,0 +1,79 @@
+"""Singular value decomposition family.
+
+Reference: ``raft/linalg/svd.cuh:44-370`` — ``svdQR`` (cuSOLVER gesvd),
+``svdEig`` (via eigh of AᵀA, the fast path for tall-skinny), ``svdJacobi``
+(gesvdj), ``svdReconstruction``, plus ``rsvd.cuh`` randomized SVD.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.random.rng import KeyLike, _key
+
+
+def svd_qr(a, gen_u: bool = True, gen_v: bool = True, res=None):
+    """Full SVD: returns (U, S, V) with A = U diag(S) Vᵀ.
+
+    Note the reference returns V (not Vᵀ); we match that convention.
+    """
+    a = as_array(a)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return (u if gen_u else None), s, (vt.T if gen_v else None)
+
+
+def svd_eig(a, res=None):
+    """SVD via eigendecomposition of AᵀA (reference svdEig, svd.cuh:109) —
+    the tall-skinny fast path: one (k,k) eigh instead of an (m,k) svd."""
+    a = as_array(a).astype(jnp.float32)
+    ata = a.T @ a
+    w, v = jnp.linalg.eigh(ata)  # ascending
+    w = w[::-1]
+    v = v[:, ::-1]
+    s = jnp.sqrt(jnp.maximum(w, 0.0))
+    u = (a @ v) / jnp.where(s[None, :] == 0.0, 1.0, s[None, :])
+    return u, s, v
+
+
+def svd_jacobi(a, tol: float = 1e-7, sweeps: int = 15, res=None):
+    """Jacobi-flavoured SVD (reference svdJacobi). XLA's svd is already a
+    Jacobi-family iteration on TPU; tol/sweeps accepted for API parity."""
+    return svd_qr(a)
+
+
+def svd_reconstruction(u, s, v, res=None) -> jax.Array:
+    """A ≈ U diag(S) Vᵀ (reference svdReconstruction, svd.cuh:246)."""
+    u, s, v = as_array(u), as_array(s), as_array(v)
+    return (u * s[None, :]) @ v.T
+
+
+def rsvd(a, k: int, p: Optional[int] = None, n_iter: int = 2,
+         seed: KeyLike = 0, res=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomized SVD (reference ``linalg/rsvd.cuh``: range finder via
+    gaussian sketch + power iterations + small exact SVD).
+
+    ``p`` is the oversampling (reference PC_perc/UpS_perc expressed
+    directly); returns rank-k (U, S, V).
+    """
+    a = as_array(a).astype(jnp.float32)
+    m, n = a.shape
+    if p is None:
+        p = max(5, k // 10)
+    ell = min(n, k + p)
+    omega = jax.random.normal(_key(seed), (n, ell), dtype=a.dtype)
+    y = a @ omega
+    # power iterations with QR re-orthonormalization for spectral accuracy
+    for _ in range(n_iter):
+        q, _ = jnp.linalg.qr(y)
+        z = a.T @ q
+        q, _ = jnp.linalg.qr(z)
+        y = a @ q
+    q, _ = jnp.linalg.qr(y)
+    b = q.T @ a  # (ell, n)
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return u[:, :k], s[:k], vt[:k].T

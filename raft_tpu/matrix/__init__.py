@@ -1,0 +1,39 @@
+"""Matrix utilities (SURVEY.md §2.5, reference ``raft/matrix``)."""
+
+from raft_tpu.matrix.gather import gather, gather_if
+from raft_tpu.matrix.sort import col_wise_sort, argsort_cols
+from raft_tpu.matrix.ops import (
+    copy,
+    copy_upper_triangular,
+    init as matrix_init,
+    power,
+    ratio,
+    reciprocal,
+    sqrt,
+    sign_flip,
+    zero_small_values,
+    line_power,
+    seq_root,
+    set_diagonal,
+    get_diagonal,
+    invert_diagonal,
+    slice_matrix,
+    col_right_shift,
+    argmax,
+    argmin,
+    matrix_max,
+    matrix_min,
+    sigmoid,
+    print_matrix,
+)
+
+__all__ = [
+    "gather", "gather_if", "col_wise_sort", "argsort_cols",
+    "copy", "copy_upper_triangular", "matrix_init",
+    "power", "ratio", "reciprocal", "sqrt", "sign_flip",
+    "zero_small_values", "line_power", "seq_root",
+    "set_diagonal", "get_diagonal", "invert_diagonal",
+    "slice_matrix", "col_right_shift",
+    "argmax", "argmin", "matrix_max", "matrix_min", "sigmoid",
+    "print_matrix",
+]

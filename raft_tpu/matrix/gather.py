@@ -1,0 +1,35 @@
+"""Row gather (reference ``raft/matrix/gather.cuh:43-318``): copy rows of a
+matrix selected by an index map, optionally transformed and/or predicated.
+XLA's gather is native; ``gather_if`` keeps output shape = map length with
+unselected rows zeroed (the reference compacts via stencil — we preserve the
+map-shaped output contract used by callers like kmeans sampling)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def gather(data, index_map, map_transform: Optional[Callable] = None,
+           res=None) -> jax.Array:
+    data = as_array(data)
+    idx = as_array(index_map).astype(jnp.int32)
+    if map_transform is not None:
+        idx = map_transform(idx)
+    return jnp.take(data, idx, axis=0)
+
+
+def gather_if(data, index_map, stencil, pred: Callable,
+              map_transform: Optional[Callable] = None, res=None) -> jax.Array:
+    data = as_array(data)
+    idx = as_array(index_map).astype(jnp.int32)
+    st = as_array(stencil)
+    if map_transform is not None:
+        idx = map_transform(idx)
+    rows = jnp.take(data, idx, axis=0)
+    keep = pred(st)
+    return jnp.where(keep[:, None], rows, jnp.zeros_like(rows))

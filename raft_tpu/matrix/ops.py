@@ -1,0 +1,146 @@
+"""Matrix math/manipulation helpers.
+
+Reference: ``raft/matrix/{math.cuh,matrix.cuh}`` — power/ratio/reciprocal/
+sqrt/sign_flip/threshold/sigmoid, slicing, diagonal helpers, argmax/min,
+triangular copy, column shift, print.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.mdarray import as_array
+
+
+def copy(data, res=None) -> jax.Array:
+    return jnp.array(as_array(data))
+
+
+def copy_upper_triangular(data, res=None) -> jax.Array:
+    """Extract strict upper-triangular part into a dense matrix (reference
+    matrix.cuh copyUpperTriangular)."""
+    data = as_array(data)
+    return jnp.triu(data)
+
+
+def init(m: int, n: int, value=0.0, dtype=jnp.float32, res=None) -> jax.Array:
+    return jnp.full((m, n), value, dtype=dtype)
+
+
+def power(data, scalar: float = 1.0, res=None) -> jax.Array:
+    """element = (scalar * element)^2 (reference math.cuh power semantics)."""
+    d = as_array(data)
+    return (scalar * d) * (scalar * d)
+
+
+def ratio(data, res=None) -> jax.Array:
+    """element /= sum(all elements) (reference math.cuh ratio)."""
+    d = as_array(data)
+    return d / jnp.sum(d)
+
+
+def reciprocal(data, scalar: float = 1.0, setzero: bool = False,
+               thres: float = 1e-15, res=None) -> jax.Array:
+    """element = scalar / element, optionally zeroing below-threshold
+    entries (reference math.cuh reciprocal)."""
+    d = as_array(data)
+    out = scalar / jnp.where(jnp.abs(d) <= thres, 1.0, d)
+    if setzero:
+        out = jnp.where(jnp.abs(d) <= thres, 0.0, out)
+    return out
+
+
+def sqrt(data, res=None) -> jax.Array:
+    return jnp.sqrt(as_array(data))
+
+
+def sign_flip(data, res=None) -> jax.Array:
+    """Flip sign of each column so its max-|.| element is positive —
+    deterministic eigenvector orientation (reference math.cuh signFlip)."""
+    d = as_array(data)
+    idx = jnp.argmax(jnp.abs(d), axis=0)
+    signs = jnp.sign(d[idx, jnp.arange(d.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return d * signs[None, :]
+
+
+def zero_small_values(data, thres: float = 1e-15, res=None) -> jax.Array:
+    """reference math.cuh setSmallValuesZero."""
+    d = as_array(data)
+    return jnp.where(jnp.abs(d) <= thres, 0.0, d)
+
+
+def line_power(data, vec, res=None) -> jax.Array:
+    """row-wise power: data[i,j] ** vec[j] (reference math.cuh linePowerOp)."""
+    return as_array(data) ** as_array(vec)[None, :]
+
+
+def seq_root(data, scalar: float = 1.0, res=None) -> jax.Array:
+    """sqrt(scalar * element) (reference math.cuh seqRoot)."""
+    d = as_array(data)
+    return jnp.sqrt(jnp.maximum(scalar * d, 0.0))
+
+
+def sigmoid(data, res=None) -> jax.Array:
+    return jax.nn.sigmoid(as_array(data))
+
+
+def set_diagonal(data, vec, res=None) -> jax.Array:
+    d = as_array(data)
+    v = as_array(vec)
+    n = min(d.shape)
+    return d.at[jnp.arange(n), jnp.arange(n)].set(v[:n])
+
+
+def get_diagonal(data, res=None) -> jax.Array:
+    return jnp.diagonal(as_array(data))
+
+
+def invert_diagonal(data, res=None) -> jax.Array:
+    """reference matrix.cuh getDiagonalInverseMatrix."""
+    d = as_array(data)
+    n = min(d.shape)
+    diag = jnp.diagonal(d)[:n]
+    inv = jnp.where(diag == 0.0, 0.0, 1.0 / jnp.where(diag == 0.0, 1.0, diag))
+    return d.at[jnp.arange(n), jnp.arange(n)].set(inv)
+
+
+def slice_matrix(data, x1: int, y1: int, x2: int, y2: int, res=None) -> jax.Array:
+    """Submatrix [x1:x2, y1:y2] (reference matrix.cuh sliceMatrix)."""
+    return as_array(data)[x1:x2, y1:y2]
+
+
+def col_right_shift(data, k: int = 1, res=None) -> jax.Array:
+    """Rotate columns right by k (reference matrix.cuh shift variants)."""
+    return jnp.roll(as_array(data), k, axis=1)
+
+
+def argmax(data, along_rows: bool = True, res=None) -> jax.Array:
+    """Per-row (or per-col) argmax (reference matrix/argmax.cuh)."""
+    return jnp.argmax(as_array(data), axis=1 if along_rows else 0).astype(jnp.int32)
+
+
+def argmin(data, along_rows: bool = True, res=None) -> jax.Array:
+    return jnp.argmin(as_array(data), axis=1 if along_rows else 0).astype(jnp.int32)
+
+
+def matrix_max(data, res=None) -> jax.Array:
+    return jnp.max(as_array(data))
+
+
+def matrix_min(data, res=None) -> jax.Array:
+    return jnp.min(as_array(data))
+
+
+def print_matrix(data, name: str = "", h_separator: str = " ",
+                 v_separator: str = "\n") -> str:
+    """Host-side pretty print (reference matrix.cuh print)."""
+    arr = np.asarray(jax.device_get(as_array(data)))
+    s = v_separator.join(
+        h_separator.join(f"{v:g}" for v in row) for row in np.atleast_2d(arr))
+    if name:
+        s = f"{name}:\n{s}"
+    print(s)
+    return s

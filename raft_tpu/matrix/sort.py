@@ -1,0 +1,35 @@
+"""Column-wise sort (reference ``raft/matrix/col_wise_sort.cuh``: per-column
+bitonic/cub segmented sort returning sorted keys and source indices). XLA's
+sort lowers to an efficient TPU sorting network."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def col_wise_sort(data, return_index: bool = True, res=None
+                  ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Sort each column ascending; returns (sorted, source_indices).
+
+    NOTE the reference's sort_cols_per_row actually sorts within each *row*
+    of a row-major matrix; this follows the public name's semantics
+    (columns) with ``axis=0``. Use ``argsort_cols`` for the row-wise form.
+    """
+    data = as_array(data)
+    if return_index:
+        idx = jnp.argsort(data, axis=0, stable=True)
+        return jnp.take_along_axis(data, idx, axis=0), idx.astype(jnp.int32)
+    return jnp.sort(data, axis=0), None
+
+
+def argsort_cols(data, res=None) -> Tuple[jax.Array, jax.Array]:
+    """Per-row ascending sort of the column entries (the layout the
+    reference's sort_cols_per_row kernel produces for row-major data)."""
+    data = as_array(data)
+    idx = jnp.argsort(data, axis=1, stable=True)
+    return jnp.take_along_axis(data, idx, axis=1), idx.astype(jnp.int32)

@@ -1,0 +1,50 @@
+"""Statistics primitives (SURVEY.md §2.9, reference ``raft/stats`` ~7.7k LoC)."""
+
+from raft_tpu.stats.moments import (
+    mean,
+    mean_center,
+    mean_add,
+    meanvar,
+    stddev,
+    vars_,
+    sum_,
+    cov,
+    minmax,
+    weighted_mean,
+    row_weighted_mean,
+    col_weighted_mean,
+    histogram,
+    dispersion,
+)
+from raft_tpu.stats.regression import (
+    accuracy,
+    r2_score,
+    regression_metrics,
+    mean_squared_error,
+)
+from raft_tpu.stats.clustering_metrics import (
+    contingency_matrix,
+    adjusted_rand_index,
+    rand_index,
+    mutual_info_score,
+    entropy,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+    kl_divergence,
+    silhouette_score,
+    trustworthiness_score,
+    information_criterion,
+    InformationCriterion,
+)
+
+__all__ = [
+    "mean", "mean_center", "mean_add", "meanvar", "stddev", "vars_", "sum_",
+    "cov", "minmax", "weighted_mean", "row_weighted_mean", "col_weighted_mean",
+    "histogram", "dispersion",
+    "accuracy", "r2_score", "regression_metrics", "mean_squared_error",
+    "contingency_matrix", "adjusted_rand_index", "rand_index",
+    "mutual_info_score", "entropy", "homogeneity_score",
+    "completeness_score", "v_measure", "kl_divergence", "silhouette_score",
+    "trustworthiness_score", "information_criterion", "InformationCriterion",
+]

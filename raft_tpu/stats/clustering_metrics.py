@@ -1,0 +1,228 @@
+"""Clustering-quality and information metrics.
+
+Reference: ``raft/stats/{contingency_matrix,adjusted_rand_index,rand_index,
+mutual_info_score,entropy,homogeneity_score,completeness_score,v_measure,
+kl_divergence,silhouette_score,trustworthiness_score,
+information_criterion}.cuh``. Contingency-matrix-based metrics follow the
+reference's structure: build the contingency table once (segment-sum — the
+XLA replacement for its atomic scatter kernels,
+``stats/detail/contingencyMatrix.cuh``), derive everything from it.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.pairwise import pairwise_distance
+
+
+def _as_labels(x) -> jax.Array:
+    return as_array(x).astype(jnp.int32)
+
+
+def contingency_matrix(y_true, y_pred, n_classes_true: Optional[int] = None,
+                       n_classes_pred: Optional[int] = None, res=None
+                       ) -> jax.Array:
+    """(n_true, n_pred) label co-occurrence counts (reference
+    stats/contingency_matrix.cuh). Labels must be 0-based (use
+    raft_tpu.label.make_monotonic first, as the reference requires)."""
+    t, p = _as_labels(y_true), _as_labels(y_pred)
+    if n_classes_true is None:
+        n_classes_true = int(jax.device_get(jnp.max(t))) + 1
+    if n_classes_pred is None:
+        n_classes_pred = int(jax.device_get(jnp.max(p))) + 1
+    flat = t * n_classes_pred + p
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(flat, dtype=jnp.float32), flat,
+        num_segments=n_classes_true * n_classes_pred)
+    return counts.reshape(n_classes_true, n_classes_pred)
+
+
+def _comb2(x):
+    return x * (x - 1.0) / 2.0
+
+
+def adjusted_rand_index(y_true, y_pred, res=None) -> jax.Array:
+    """ARI from the contingency table (reference
+    stats/adjusted_rand_index.cuh)."""
+    c = contingency_matrix(y_true, y_pred, res=res)
+    n = jnp.sum(c)
+    sum_comb_c = jnp.sum(_comb2(c))
+    a = jnp.sum(c, axis=1)
+    b = jnp.sum(c, axis=0)
+    sum_comb_a = jnp.sum(_comb2(a))
+    sum_comb_b = jnp.sum(_comb2(b))
+    expected = sum_comb_a * sum_comb_b / _comb2(n)
+    max_index = 0.5 * (sum_comb_a + sum_comb_b)
+    denom = max_index - expected
+    return jnp.where(denom == 0.0, 1.0, (sum_comb_c - expected) / jnp.where(denom == 0.0, 1.0, denom))
+
+
+def rand_index(y_true, y_pred, res=None) -> jax.Array:
+    """Unadjusted Rand index (reference stats/rand_index.cuh)."""
+    c = contingency_matrix(y_true, y_pred, res=res)
+    n = jnp.sum(c)
+    sum_comb = jnp.sum(_comb2(c))
+    a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
+    b = jnp.sum(_comb2(jnp.sum(c, axis=0)))
+    total = _comb2(n)
+    return (total + 2.0 * sum_comb - a - b) / total
+
+
+def entropy(labels, n_classes: Optional[int] = None, res=None) -> jax.Array:
+    """Shannon entropy (nats) of a label distribution (reference
+    stats/entropy.cuh)."""
+    l = _as_labels(labels)
+    if n_classes is None:
+        n_classes = int(jax.device_get(jnp.max(l))) + 1
+    counts = jax.ops.segment_sum(jnp.ones_like(l, dtype=jnp.float32), l,
+                                 num_segments=n_classes)
+    p = counts / jnp.sum(counts)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def mutual_info_score(y_true, y_pred, res=None) -> jax.Array:
+    """MI in nats from the contingency table (reference
+    stats/mutual_info_score.cuh)."""
+    c = contingency_matrix(y_true, y_pred, res=res)
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.where(pi * pj > 0, pi * pj, 1.0)
+    terms = jnp.where(pij > 0, pij * jnp.log(jnp.where(pij > 0, ratio, 1.0)), 0.0)
+    return jnp.sum(terms)
+
+
+def homogeneity_score(y_true, y_pred, res=None) -> jax.Array:
+    """MI / H(true) (reference stats/homogeneity_score.cuh)."""
+    mi = mutual_info_score(y_true, y_pred, res=res)
+    h = entropy(y_true, res=res)
+    return jnp.where(h == 0.0, 1.0, mi / jnp.where(h == 0.0, 1.0, h))
+
+
+def completeness_score(y_true, y_pred, res=None) -> jax.Array:
+    mi = mutual_info_score(y_true, y_pred, res=res)
+    h = entropy(y_pred, res=res)
+    return jnp.where(h == 0.0, 1.0, mi / jnp.where(h == 0.0, 1.0, h))
+
+
+def v_measure(y_true, y_pred, beta: float = 1.0, res=None) -> jax.Array:
+    """Harmonic mean of homogeneity and completeness (reference
+    stats/v_measure.cuh)."""
+    h = homogeneity_score(y_true, y_pred, res=res)
+    c = completeness_score(y_true, y_pred, res=res)
+    denom = beta * h + c
+    return jnp.where(denom == 0.0, 0.0,
+                     (1 + beta) * h * c / jnp.where(denom == 0.0, 1.0, denom))
+
+
+def kl_divergence(p, q, res=None) -> jax.Array:
+    """Σ p log(p/q) over two distributions (reference
+    stats/kl_divergence.cuh)."""
+    p = as_array(p).astype(jnp.float32)
+    q = as_array(q).astype(jnp.float32)
+    safe_p = jnp.where(p > 0, p, 1.0)
+    safe_q = jnp.where(q > 0, q, 1.0)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(safe_p / safe_q), 0.0))
+
+
+def silhouette_score(x, labels, n_clusters: Optional[int] = None,
+                     metric: str = "euclidean", chunk: int = 256,
+                     res=None) -> jax.Array:
+    """Mean silhouette coefficient (reference stats/silhouette_score.cuh;
+    the ``chunk`` parameter mirrors the batched variant
+    ``silhouette_score_batched`` which tiles the O(n²) distance work).
+
+    Computed without materializing (n, n) beyond a (chunk, n) tile: for
+    each tile, distances to all points are reduced into per-cluster sums
+    via one MXU-friendly segment one-hot matmul.
+    """
+    x = as_array(x).astype(jnp.float32)
+    lab = _as_labels(labels)
+    n = x.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jax.device_get(jnp.max(lab))) + 1
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), lab,
+                                 num_segments=n_clusters)
+    onehot = jax.nn.one_hot(lab, n_clusters, dtype=jnp.float32)  # (n, k)
+
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    n_tiles = (n + pad) // chunk
+
+    def tile_fn(i):
+        rows = jax.lax.dynamic_slice_in_dim(xp, i * chunk, chunk)
+        d = pairwise_distance(rows, x, metric=metric)  # (chunk, n)
+        # per-cluster distance sums: (chunk, n) @ (n, k)
+        sums = d @ onehot
+        return sums
+
+    sums = jax.lax.map(tile_fn, jnp.arange(n_tiles)).reshape(-1, n_clusters)[:n]
+    own = counts[lab]
+    own_sum = jnp.take_along_axis(sums, lab[:, None], axis=1)[:, 0]
+    # a(i): mean intra-cluster distance excluding self (self-dist is 0)
+    a = jnp.where(own > 1, own_sum / jnp.maximum(own - 1, 1), 0.0)
+    # b(i): min over other clusters of mean distance
+    means = sums / jnp.maximum(counts[None, :], 1)
+    means = jnp.where(counts[None, :] > 0, means, jnp.inf)
+    means = means.at[jnp.arange(n), lab].set(jnp.inf)
+    b = jnp.min(means, axis=1)
+    s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12), 0.0)
+    return jnp.mean(s)
+
+
+def trustworthiness_score(x, x_embedded, n_neighbors: int = 5,
+                          metric: str = "euclidean", res=None) -> jax.Array:
+    """Trustworthiness of a low-dim embedding (reference
+    stats/trustworthiness_score.cuh): penalizes embedded-space neighbors
+    that are far in the original space."""
+    x = as_array(x).astype(jnp.float32)
+    e = as_array(x_embedded).astype(jnp.float32)
+    n = x.shape[0]
+    d_orig = pairwise_distance(x, x, metric=metric)
+    d_emb = pairwise_distance(e, e, metric=metric)
+    big = jnp.asarray(jnp.inf, d_orig.dtype)
+    eye = jnp.eye(n, dtype=bool)
+    d_orig = jnp.where(eye, big, d_orig)
+    d_emb = jnp.where(eye, big, d_emb)
+    # rank of each j in i's original-space ordering
+    orig_order = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.float32)
+    ranks = jax.vmap(lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.float32)))(
+        ranks, orig_order)
+    emb_nn = jnp.argsort(d_emb, axis=1)[:, :n_neighbors]
+    r = jnp.take_along_axis(ranks, emb_nn, axis=1)
+    penalty = jnp.sum(jnp.maximum(r - n_neighbors + 1, 0.0))
+    norm = 2.0 / (n * n_neighbors * (2.0 * n - 3.0 * n_neighbors - 1.0))
+    return 1.0 - norm * penalty
+
+
+class InformationCriterion(enum.IntEnum):
+    """reference stats/information_criterion.cuh IC_Type."""
+
+    AIC = 0
+    AICc = 1
+    BIC = 2
+
+
+def information_criterion(log_likelihood, ic_type: InformationCriterion,
+                          n_params: int, n_samples: int, res=None) -> jax.Array:
+    """Batched IC from log-likelihoods (reference
+    stats/information_criterion.cuh)."""
+    ll = as_array(log_likelihood).astype(jnp.float32)
+    k, n = float(n_params), float(n_samples)
+    ic = -2.0 * ll
+    if ic_type == InformationCriterion.AIC:
+        return ic + 2.0 * k
+    if ic_type == InformationCriterion.AICc:
+        return ic + 2.0 * k + 2.0 * k * (k + 1.0) / jnp.maximum(n - k - 1.0, 1e-6)
+    if ic_type == InformationCriterion.BIC:
+        return ic + k * jnp.log(n)
+    raise ValueError(f"unknown IC type {ic_type}")

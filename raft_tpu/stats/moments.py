@@ -1,0 +1,149 @@
+"""Moment / summary statistics.
+
+Reference: ``raft/stats/{mean,meanvar,stddev,sum,cov,minmax,weighted_mean,
+mean_center,mean_add,histogram,dispersion}.cuh``. All are single fused XLA
+reductions on TPU; histogram uses segment_sum (the deterministic equivalent
+of the reference's multi-strategy atomic histogram kernels,
+``stats/detail/histogram.cuh``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def mean(data, along_rows: bool = False, res=None) -> jax.Array:
+    """Column means by default (reference stats/mean.cuh computes per-column
+    over the sample dim); ``along_rows=True`` gives per-row means."""
+    data = as_array(data).astype(jnp.float32)
+    return jnp.mean(data, axis=1 if along_rows else 0)
+
+
+def sum_(data, along_rows: bool = False, res=None) -> jax.Array:
+    data = as_array(data).astype(jnp.float32)
+    return jnp.sum(data, axis=1 if along_rows else 0)
+
+
+def meanvar(data, sample: bool = True, res=None) -> Tuple[jax.Array, jax.Array]:
+    """Per-column (mean, variance); ``sample`` selects the n-1 divisor
+    (reference stats/meanvar.cuh)."""
+    data = as_array(data).astype(jnp.float32)
+    mu = jnp.mean(data, axis=0)
+    ddof = 1 if sample else 0
+    var = jnp.var(data, axis=0, ddof=ddof)
+    return mu, var
+
+
+def vars_(data, mu=None, sample: bool = True, res=None) -> jax.Array:
+    data = as_array(data).astype(jnp.float32)
+    if mu is None:
+        return jnp.var(data, axis=0, ddof=1 if sample else 0)
+    mu = as_array(mu)
+    n = data.shape[0]
+    ss = jnp.sum((data - mu[None, :]) ** 2, axis=0)
+    return ss / (n - 1 if sample else n)
+
+
+def stddev(data, mu=None, sample: bool = True, res=None) -> jax.Array:
+    return jnp.sqrt(vars_(data, mu, sample, res))
+
+
+def mean_center(data, mu=None, along_rows: bool = False, res=None) -> jax.Array:
+    """Subtract per-column (or per-row) means (reference stats/mean_center.cuh)."""
+    data = as_array(data).astype(jnp.float32)
+    if mu is None:
+        mu = mean(data, along_rows)
+    mu = as_array(mu)
+    return data - (mu[:, None] if along_rows else mu[None, :])
+
+
+def mean_add(data, mu, along_rows: bool = False, res=None) -> jax.Array:
+    data = as_array(data).astype(jnp.float32)
+    mu = as_array(mu)
+    return data + (mu[:, None] if along_rows else mu[None, :])
+
+
+def cov(data, mu=None, sample: bool = True, stable: bool = True,
+        res=None) -> jax.Array:
+    """Covariance matrix of rows-as-samples (reference stats/cov.cuh; the
+    ``stable`` flag picks mean-centered two-pass vs E[xy]-E[x]E[y])."""
+    data = as_array(data).astype(jnp.float32)
+    n = data.shape[0]
+    denom = n - 1 if sample else n
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    else:
+        mu = as_array(mu)
+    if stable:
+        c = data - mu[None, :]
+        return (c.T @ c) / denom
+    return (data.T @ data - n * jnp.outer(mu, mu)) / denom
+
+
+def minmax(data, res=None) -> Tuple[jax.Array, jax.Array]:
+    """Per-column (min, max) (reference stats/minmax.cuh)."""
+    data = as_array(data)
+    return jnp.min(data, axis=0), jnp.max(data, axis=0)
+
+
+def weighted_mean(data, weights, along_rows: bool = True, res=None) -> jax.Array:
+    """Weighted mean per row (default) or per column (reference
+    stats/weighted_mean.cuh: rowWeightedMean weights run over columns)."""
+    data = as_array(data).astype(jnp.float32)
+    w = as_array(weights).astype(jnp.float32)
+    if along_rows:
+        return (data @ w) / jnp.sum(w)
+    return (w @ data) / jnp.sum(w)
+
+
+def row_weighted_mean(data, weights, res=None) -> jax.Array:
+    return weighted_mean(data, weights, True, res)
+
+
+def col_weighted_mean(data, weights, res=None) -> jax.Array:
+    return weighted_mean(data, weights, False, res)
+
+
+def histogram(data, n_bins: int, lower: Optional[float] = None,
+              upper: Optional[float] = None, res=None) -> jax.Array:
+    """Per-column histogram over [lower, upper) → (n_bins, n_cols)
+    (reference stats/histogram.cuh; column layout matches its batched
+    per-column semantics)."""
+    data = as_array(data).astype(jnp.float32)
+    if data.ndim == 1:
+        data = data[:, None]
+    if lower is None:
+        lower = jnp.min(data)
+    if upper is None:
+        hi = jnp.max(data)
+        # nudge strictly above max so the max lands in the last bin;
+        # additive epsilon also handles negative/zero maxima
+        upper = hi + 1e-6 * jnp.maximum(jnp.abs(hi), 1.0)
+    width = (upper - lower) / n_bins
+    # constant data (width == 0) deterministically falls in bin 0
+    safe_width = jnp.where(width > 0, width, 1.0)
+    bins = jnp.clip(((data - lower) / safe_width).astype(jnp.int32), 0, n_bins - 1)
+    one = jnp.ones_like(bins, dtype=jnp.int32)
+    out = jax.vmap(
+        lambda b, o: jax.ops.segment_sum(o, b, num_segments=n_bins),
+        in_axes=(1, 1), out_axes=1)(bins, one)
+    return out
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None, n_points: Optional[int] = None,
+               res=None) -> jax.Array:
+    """Weighted dispersion of cluster centroids around the global centroid
+    (reference stats/dispersion.cuh, used by information_criterion)."""
+    c = as_array(centroids).astype(jnp.float32)
+    sizes = as_array(cluster_sizes).astype(jnp.float32)
+    if n_points is None:
+        n_points = jnp.sum(sizes)
+    if global_centroid is None:
+        global_centroid = jnp.sum(c * sizes[:, None], axis=0) / n_points
+    d2 = jnp.sum((c - global_centroid[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(sizes * d2))

@@ -1,0 +1,49 @@
+"""Regression / classification metrics.
+
+Reference: ``raft/stats/{accuracy,r2_score,regression_metrics}.cuh``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def accuracy(predictions, ref_predictions, res=None) -> jax.Array:
+    """Fraction of exact matches (reference stats/accuracy.cuh)."""
+    p = as_array(predictions)
+    r = as_array(ref_predictions)
+    return jnp.mean((p == r).astype(jnp.float32))
+
+
+def r2_score(y, y_hat, res=None) -> jax.Array:
+    """Coefficient of determination (reference stats/r2_score.cuh)."""
+    y = as_array(y).astype(jnp.float32)
+    y_hat = as_array(y_hat).astype(jnp.float32)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def mean_squared_error(y, y_hat, res=None) -> jax.Array:
+    y = as_array(y).astype(jnp.float32)
+    y_hat = as_array(y_hat).astype(jnp.float32)
+    return jnp.mean((y - y_hat) ** 2)
+
+
+def regression_metrics(predictions, ref_predictions, res=None
+                       ) -> Dict[str, jax.Array]:
+    """{mean_abs_error, mean_squared_error, median_abs_error} (reference
+    stats/regression_metrics.cuh)."""
+    p = as_array(predictions).astype(jnp.float32)
+    r = as_array(ref_predictions).astype(jnp.float32)
+    err = p - r
+    return {
+        "mean_abs_error": jnp.mean(jnp.abs(err)),
+        "mean_squared_error": jnp.mean(err * err),
+        "median_abs_error": jnp.median(jnp.abs(err)),
+    }

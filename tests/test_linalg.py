@@ -1,0 +1,217 @@
+"""Linear algebra tests (reference analogue: cpp/test/linalg/*.cu —
+primitive vs naive host computation)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import linalg as rl
+from raft_tpu.linalg import Apply, NormType
+
+
+@pytest.fixture
+def mats(rng_np):
+    a = rng_np.random((24, 16), dtype=np.float32) - 0.5
+    b = rng_np.random((16, 12), dtype=np.float32) - 0.5
+    return a, b
+
+
+class TestBlas:
+    def test_gemm(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(np.asarray(rl.gemm(a, b)), a @ b,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gemm_alpha_beta_trans(self, mats):
+        a, b = mats
+        c = np.ones((16, 16), np.float32)
+        got = rl.gemm(a, a, alpha=2.0, beta=3.0, c=c, trans_a=True)
+        np.testing.assert_allclose(np.asarray(got), 2 * a.T @ a + 3 * c,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gemv_axpy_dot(self, rng_np):
+        a = rng_np.random((8, 5), dtype=np.float32)
+        x = rng_np.random(5, dtype=np.float32)
+        y = rng_np.random(8, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(rl.gemv(a, x)), a @ x, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rl.axpy(2.0, y, y)), 3 * y, rtol=1e-6)
+        np.testing.assert_allclose(float(rl.dot(x, x)), float(x @ x), rtol=1e-5)
+
+    def test_transpose(self, mats):
+        a, _ = mats
+        np.testing.assert_array_equal(np.asarray(rl.transpose(a)), a.T)
+
+
+class TestEig:
+    def _sym(self, rng_np, n=12):
+        a = rng_np.random((n, n), dtype=np.float32)
+        return (a + a.T) / 2
+
+    def test_eig_dc(self, rng_np):
+        a = self._sym(rng_np)
+        w, v = rl.eig_dc(a)
+        np.testing.assert_allclose(np.asarray(a @ v), np.asarray(v * w),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_eig_dc_selective(self, rng_np):
+        a = self._sym(rng_np)
+        w_all = np.linalg.eigvalsh(a)
+        w, v = rl.eig_dc_selective(a, 3, largest=True)
+        np.testing.assert_allclose(np.asarray(w), w_all[-3:], rtol=1e-4, atol=1e-4)
+        w, v = rl.eig_dc_selective(a, 3, largest=False)
+        np.testing.assert_allclose(np.asarray(w), w_all[:3], rtol=1e-4, atol=1e-4)
+
+    def test_eig_jacobi(self, rng_np):
+        a = self._sym(rng_np, n=8)
+        w, v = rl.eig_jacobi(a, tol=1e-6, sweeps=30)
+        w_ref = np.linalg.eigvalsh(a)
+        np.testing.assert_allclose(np.sort(np.asarray(w)), w_ref, rtol=1e-3,
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(a @ v), np.asarray(v * w),
+                                   rtol=1e-2, atol=1e-2)
+
+
+class TestSvd:
+    def test_svd_qr_reconstruction(self, mats):
+        a, _ = mats
+        u, s, v = rl.svd_qr(a)
+        rec = rl.svd_reconstruction(u, s, v)
+        np.testing.assert_allclose(np.asarray(rec), a, rtol=1e-3, atol=1e-3)
+
+    def test_svd_eig_matches(self, mats):
+        a, _ = mats
+        _, s_ref, _ = np.linalg.svd(a, full_matrices=False)
+        u, s, v = rl.svd_eig(a)
+        np.testing.assert_allclose(np.asarray(s), s_ref, rtol=1e-2, atol=1e-2)
+        rec = rl.svd_reconstruction(u, s, v)
+        np.testing.assert_allclose(np.asarray(rec), a, rtol=1e-2, atol=1e-2)
+
+    def test_rsvd_low_rank(self, rng_np):
+        # exact low-rank matrix: rsvd must recover the spectrum
+        u = rng_np.random((50, 5), dtype=np.float32)
+        v = rng_np.random((5, 30), dtype=np.float32)
+        a = u @ v
+        uu, s, vv = rl.rsvd(a, k=5, p=5, n_iter=3)
+        s_ref = np.linalg.svd(a, compute_uv=False)[:5]
+        np.testing.assert_allclose(np.asarray(s), s_ref, rtol=1e-2)
+        rec = np.asarray(rl.svd_reconstruction(uu, s, vv))
+        np.testing.assert_allclose(rec, a, rtol=1e-2, atol=1e-2 * abs(a).max())
+
+
+class TestQrLstsq:
+    def test_qr(self, mats):
+        a, _ = mats
+        q, r = rl.qr_get_qr(a)
+        np.testing.assert_allclose(np.asarray(q @ r), a, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(q.T @ q), np.eye(16), atol=1e-4)
+
+    @pytest.mark.parametrize("solver", ["lstsq_svd_qr", "lstsq_svd_jacobi",
+                                        "lstsq_eig", "lstsq_qr"])
+    def test_lstsq_all_solvers(self, rng_np, solver):
+        a = rng_np.random((40, 8), dtype=np.float32)
+        w_true = rng_np.random(8, dtype=np.float32)
+        b = a @ w_true
+        w = getattr(rl, solver)(a, b)
+        np.testing.assert_allclose(np.asarray(w), w_true, rtol=1e-2, atol=1e-2)
+
+
+class TestCholesky:
+    def test_r1_update_builds_factor(self, rng_np):
+        n = 6
+        a = rng_np.random((n, n), dtype=np.float32)
+        a = a @ a.T + n * np.eye(n, dtype=np.float32)
+        l = jnp.zeros((0, 0), jnp.float32)
+        for i in range(n):
+            l = rl.cholesky_r1_update(l, jnp.asarray(a[: i + 1, i]))
+        np.testing.assert_allclose(np.asarray(l @ l.T), a, rtol=1e-3, atol=1e-3)
+
+
+class TestElementwise:
+    def test_ops(self, rng_np):
+        x = rng_np.random((6, 4), dtype=np.float32) + 1.0
+        y = rng_np.random((6, 4), dtype=np.float32) + 1.0
+        np.testing.assert_allclose(np.asarray(rl.add(x, y)), x + y)
+        np.testing.assert_allclose(np.asarray(rl.subtract(x, y)), x - y)
+        np.testing.assert_allclose(np.asarray(rl.multiply(x, y)), x * y)
+        np.testing.assert_allclose(np.asarray(rl.divide(x, y)), x / y, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(rl.sqrt(x)), np.sqrt(x), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(rl.unary_op(x, lambda v: v * 2)), x * 2)
+        np.testing.assert_allclose(
+            np.asarray(rl.binary_op(x, y, lambda a, b: a * b + 1)), x * y + 1)
+
+    def test_map_reduce(self, rng_np):
+        x = rng_np.random(100, dtype=np.float32)
+        got = rl.map_reduce(lambda v: v * v, jnp.add, 0.0, x)
+        np.testing.assert_allclose(float(got), float((x * x).sum()), rtol=1e-4)
+
+    def test_matrix_vector_op(self, rng_np):
+        m = rng_np.random((5, 7), dtype=np.float32)
+        vr = rng_np.random(7, dtype=np.float32)
+        vc = rng_np.random(5, dtype=np.float32)
+        np.testing.assert_allclose(
+            np.asarray(rl.matrix_vector_op(m, vr, jnp.add, Apply.ALONG_ROWS)),
+            m + vr[None, :])
+        np.testing.assert_allclose(
+            np.asarray(rl.matrix_vector_op(m, vc, jnp.multiply, Apply.ALONG_COLUMNS)),
+            m * vc[:, None])
+
+    def test_mse_and_init(self, rng_np):
+        a = rng_np.random(50, dtype=np.float32)
+        b = rng_np.random(50, dtype=np.float32)
+        np.testing.assert_allclose(float(rl.mean_squared_error(a, b)),
+                                   float(((a - b) ** 2).mean()), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rl.init_arange(5, 2, 3)),
+                                   [2, 5, 8, 11, 14])
+
+
+class TestReduce:
+    def test_reduce_lambdas(self, rng_np):
+        x = rng_np.random((10, 6), dtype=np.float32)
+        got = rl.reduce(x, along_rows=True, main_op=lambda v: v * v,
+                        final_op=jnp.sqrt)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.sqrt((x * x).sum(axis=1)), rtol=1e-5)
+        got = rl.strided_reduction(x, reduce_op="max")
+        np.testing.assert_allclose(np.asarray(got), x.max(axis=0))
+
+    def test_norms(self, rng_np):
+        x = rng_np.random((8, 5), dtype=np.float32) - 0.5
+        np.testing.assert_allclose(np.asarray(rl.row_norm(x, NormType.L1Norm)),
+                                   np.abs(x).sum(axis=1), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rl.row_norm(x, NormType.L2Norm)),
+                                   (x * x).sum(axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(rl.row_norm(x, NormType.L2Norm, sqrt=True)),
+            np.linalg.norm(x, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rl.col_norm(x, NormType.LinfNorm)),
+                                   np.abs(x).max(axis=0), rtol=1e-5)
+
+    def test_reduce_rows_by_key(self, rng_np):
+        x = rng_np.random((12, 4), dtype=np.float32)
+        keys = np.array([0, 1, 2, 0, 1, 2, 0, 1, 2, 0, 1, 2], np.int32)
+        got = np.asarray(rl.reduce_rows_by_key(x, keys, 3))
+        want = np.stack([x[keys == k].sum(axis=0) for k in range(3)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_reduce_rows_by_key_weighted(self, rng_np):
+        x = rng_np.random((6, 3), dtype=np.float32)
+        keys = np.array([0, 0, 1, 1, 1, 0], np.int32)
+        w = rng_np.random(6, dtype=np.float32)
+        got = np.asarray(rl.reduce_rows_by_key(x, keys, 2, weights=w))
+        want = np.stack([(x[keys == k] * w[keys == k, None]).sum(axis=0)
+                         for k in range(2)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_reduce_cols_by_key(self, rng_np):
+        x = rng_np.random((4, 6), dtype=np.float32)
+        keys = np.array([0, 1, 0, 2, 1, 0], np.int32)
+        got = np.asarray(rl.reduce_cols_by_key(x, keys, 3))
+        want = np.stack([x[:, keys == k].sum(axis=1) for k in range(3)], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_normalize_rows(self, rng_np):
+        x = rng_np.random((7, 4), dtype=np.float32)
+        got = np.asarray(rl.normalize_rows(x))
+        np.testing.assert_allclose(np.linalg.norm(got, axis=1),
+                                   np.ones(7), rtol=1e-5)

@@ -1,0 +1,228 @@
+"""Matrix/stats/label tests (reference analogue: cpp/test/{matrix,stats,
+label}/*.cu; metric values cross-checked against sklearn where the
+reference checks against its own naive kernels)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import sklearn.metrics as skm
+
+from raft_tpu import matrix as rm
+from raft_tpu import stats as rs
+from raft_tpu.label import get_unique_labels, make_monotonic, merge_labels
+from raft_tpu.stats import InformationCriterion
+
+
+class TestMatrix:
+    def test_gather(self, rng_np):
+        x = rng_np.random((10, 4), dtype=np.float32)
+        idx = np.array([3, 1, 7], np.int32)
+        np.testing.assert_array_equal(np.asarray(rm.gather(x, idx)), x[idx])
+
+    def test_gather_if(self, rng_np):
+        x = rng_np.random((10, 4), dtype=np.float32)
+        idx = np.array([0, 1, 2, 3], np.int32)
+        stencil = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+        out = np.asarray(rm.gather_if(x, idx, stencil, lambda s: s > 0.5))
+        np.testing.assert_array_equal(out[0], x[0])
+        np.testing.assert_array_equal(out[1], np.zeros(4))
+
+    def test_col_wise_sort(self, rng_np):
+        x = rng_np.random((8, 3), dtype=np.float32)
+        srt, idx = rm.col_wise_sort(x)
+        np.testing.assert_allclose(np.asarray(srt), np.sort(x, axis=0))
+        np.testing.assert_array_equal(np.asarray(idx), np.argsort(x, axis=0))
+
+    def test_argsort_cols(self, rng_np):
+        x = rng_np.random((5, 9), dtype=np.float32)
+        srt, idx = rm.argsort_cols(x)
+        np.testing.assert_allclose(np.asarray(srt), np.sort(x, axis=1))
+
+    def test_math_helpers(self, rng_np):
+        x = rng_np.random((4, 4), dtype=np.float32) + 0.5
+        np.testing.assert_allclose(np.asarray(rm.power(x, 2.0)), (2 * x) ** 2,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rm.ratio(x)), x / x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rm.reciprocal(x)), 1 / x, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rm.seq_root(x, 2.0)),
+                                   np.sqrt(2 * x), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(rm.sigmoid(x)),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+
+    def test_sign_flip(self, rng_np):
+        x = rng_np.random((6, 3), dtype=np.float32) - 0.5
+        out = np.asarray(rm.sign_flip(x))
+        for j in range(3):
+            assert out[np.abs(out[:, j]).argmax(), j] > 0
+
+    def test_diag_slice_shift(self, rng_np):
+        x = rng_np.random((5, 5), dtype=np.float32)
+        v = np.arange(5, dtype=np.float32)
+        d = np.asarray(rm.set_diagonal(x, v))
+        np.testing.assert_array_equal(np.diag(d), v)
+        np.testing.assert_allclose(np.asarray(rm.get_diagonal(x)), np.diag(x))
+        np.testing.assert_array_equal(np.asarray(rm.slice_matrix(x, 1, 2, 4, 5)),
+                                      x[1:4, 2:5])
+        np.testing.assert_array_equal(np.asarray(rm.col_right_shift(x, 2)),
+                                      np.roll(x, 2, axis=1))
+
+    def test_argmax_argmin(self, rng_np):
+        x = rng_np.random((6, 8), dtype=np.float32)
+        np.testing.assert_array_equal(np.asarray(rm.argmax(x)), x.argmax(axis=1))
+        np.testing.assert_array_equal(np.asarray(rm.argmin(x, along_rows=False)),
+                                      x.argmin(axis=0))
+
+
+class TestStatsMoments:
+    def test_mean_var_std(self, rng_np):
+        x = rng_np.random((100, 5), dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(rs.mean(x)), x.mean(axis=0),
+                                   rtol=1e-5)
+        mu, var = rs.meanvar(x)
+        np.testing.assert_allclose(np.asarray(var), x.var(axis=0, ddof=1),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(rs.stddev(x)),
+                                   x.std(axis=0, ddof=1), rtol=1e-4)
+
+    def test_mean_center_add(self, rng_np):
+        x = rng_np.random((20, 4), dtype=np.float32)
+        c = np.asarray(rs.mean_center(x))
+        np.testing.assert_allclose(c.mean(axis=0), np.zeros(4), atol=1e-6)
+        back = np.asarray(rs.mean_add(c, rs.mean(x)))
+        np.testing.assert_allclose(back, x, rtol=1e-5)
+
+    def test_cov(self, rng_np):
+        x = rng_np.random((200, 6), dtype=np.float32)
+        want = np.cov(x.T)
+        np.testing.assert_allclose(np.asarray(rs.cov(x)), want, rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(rs.cov(x, stable=False)), want,
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_minmax_weighted_mean(self, rng_np):
+        x = rng_np.random((30, 4), dtype=np.float32)
+        lo, hi = rs.minmax(x)
+        np.testing.assert_allclose(np.asarray(lo), x.min(axis=0))
+        np.testing.assert_allclose(np.asarray(hi), x.max(axis=0))
+        w = rng_np.random(4, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(rs.row_weighted_mean(x, w)),
+                                   (x * w).sum(axis=1) / w.sum(), rtol=1e-5)
+
+    def test_histogram(self, rng_np):
+        x = rng_np.random((1000, 2), dtype=np.float32)
+        h = np.asarray(rs.histogram(x, 10, 0.0, 1.0))
+        assert h.shape == (10, 2)
+        assert h.sum(axis=0).tolist() == [1000, 1000]
+        want0 = np.histogram(x[:, 0], bins=10, range=(0, 1))[0]
+        np.testing.assert_array_equal(h[:, 0], want0)
+
+
+class TestStatsRegression:
+    def test_accuracy_r2(self, rng_np):
+        y = rng_np.integers(0, 3, 100)
+        yh = y.copy()
+        yh[:10] = (yh[:10] + 1) % 3
+        np.testing.assert_allclose(float(rs.accuracy(yh, y)), 0.9)
+        yr = rng_np.random(100).astype(np.float32)
+        yp = yr + 0.1 * rng_np.random(100).astype(np.float32)
+        np.testing.assert_allclose(float(rs.r2_score(yr, yp)),
+                                   skm.r2_score(yr, yp), rtol=1e-3)
+
+    def test_regression_metrics(self, rng_np):
+        a = rng_np.random(50).astype(np.float32)
+        b = rng_np.random(50).astype(np.float32)
+        m = rs.regression_metrics(a, b)
+        np.testing.assert_allclose(float(m["mean_abs_error"]),
+                                   np.abs(a - b).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(m["median_abs_error"]),
+                                   np.median(np.abs(a - b)), rtol=1e-5)
+
+
+class TestClusteringMetrics:
+    def _labels(self, rng_np, n=500, k=4):
+        a = rng_np.integers(0, k, n)
+        b = a.copy()
+        flip = rng_np.random(n) < 0.2
+        b[flip] = rng_np.integers(0, k, flip.sum())
+        return a.astype(np.int32), b.astype(np.int32)
+
+    def test_contingency(self, rng_np):
+        a, b = self._labels(rng_np)
+        c = np.asarray(rs.contingency_matrix(a, b))
+        assert c.sum() == len(a)
+        np.testing.assert_array_equal(
+            c, skm.cluster.contingency_matrix(a, b))
+
+    def test_ari_ri_mi(self, rng_np):
+        a, b = self._labels(rng_np)
+        np.testing.assert_allclose(float(rs.adjusted_rand_index(a, b)),
+                                   skm.adjusted_rand_score(a, b), rtol=1e-4)
+        np.testing.assert_allclose(float(rs.mutual_info_score(a, b)),
+                                   skm.mutual_info_score(a, b), rtol=1e-4)
+
+    def test_homogeneity_family(self, rng_np):
+        a, b = self._labels(rng_np)
+        np.testing.assert_allclose(float(rs.homogeneity_score(a, b)),
+                                   skm.homogeneity_score(a, b), rtol=1e-3)
+        np.testing.assert_allclose(float(rs.completeness_score(a, b)),
+                                   skm.completeness_score(a, b), rtol=1e-3)
+        np.testing.assert_allclose(float(rs.v_measure(a, b)),
+                                   skm.v_measure_score(a, b), rtol=1e-3)
+
+    def test_entropy_kl(self):
+        labels = np.array([0] * 50 + [1] * 50, np.int32)
+        np.testing.assert_allclose(float(rs.entropy(labels)), np.log(2),
+                                   rtol=1e-4)
+        p = np.array([0.5, 0.5], np.float32)
+        q = np.array([0.9, 0.1], np.float32)
+        want = (p * np.log(p / q)).sum()
+        np.testing.assert_allclose(float(rs.kl_divergence(p, q)), want,
+                                   rtol=1e-4)
+
+    def test_silhouette(self, rng_np):
+        from raft_tpu.random import make_blobs
+        x, y = make_blobs(n_samples=300, n_features=4, centers=3,
+                          cluster_std=0.5, seed=0)
+        got = float(rs.silhouette_score(x, y, chunk=64))
+        want = skm.silhouette_score(np.asarray(x), np.asarray(y))
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+    def test_trustworthiness(self, rng_np):
+        x = rng_np.random((80, 10)).astype(np.float32)
+        e = x[:, :2]  # projection: decent but lossy embedding
+        got = float(rs.trustworthiness_score(x, e, n_neighbors=5))
+        from sklearn.manifold import trustworthiness as sk_trust
+        want = sk_trust(x, e, n_neighbors=5)
+        np.testing.assert_allclose(got, want, rtol=1e-2)
+
+    def test_information_criterion(self):
+        ll = jnp.asarray([-100.0])
+        aic = float(rs.information_criterion(ll, InformationCriterion.AIC, 3, 50)[0])
+        bic = float(rs.information_criterion(ll, InformationCriterion.BIC, 3, 50)[0])
+        np.testing.assert_allclose(aic, 206.0)
+        np.testing.assert_allclose(bic, 200 + 3 * np.log(50), rtol=1e-6)
+
+    def test_dispersion(self):
+        centroids = np.array([[0.0, 0.0], [2.0, 0.0]], np.float32)
+        sizes = np.array([10, 10], np.float32)
+        # global centroid (1,0); each centroid at distance 1 -> sqrt(20)
+        np.testing.assert_allclose(float(rs.dispersion(centroids, sizes)),
+                                   np.sqrt(20.0), rtol=1e-5)
+
+
+class TestLabel:
+    def test_unique_and_monotonic(self):
+        labels = np.array([10, 5, 10, 42, 5], np.int32)
+        u = np.asarray(get_unique_labels(labels))
+        np.testing.assert_array_equal(u, [5, 10, 42])
+        mapped, classes = make_monotonic(labels)
+        np.testing.assert_array_equal(np.asarray(mapped), [1, 0, 1, 2, 0])
+
+    def test_merge_labels(self):
+        # two components in A {0,0,1,1}, B connects indices 1,2 via shared label
+        a = np.array([0, 0, 1, 1], np.int32)
+        b = np.array([0, 1, 1, 2], np.int32)
+        mask = np.array([True, True, True, True])
+        merged = np.asarray(merge_labels(a, b, mask, n_classes=4))
+        assert merged[2] == merged[1] == merged[0] == merged[3]
