@@ -1,0 +1,32 @@
+"""Clustering (SURVEY.md §2.8, reference ``raft/cluster``)."""
+
+from raft_tpu.cluster.kmeans_types import KMeansParams, InitMethod
+from raft_tpu.cluster.kmeans import (
+    fit,
+    predict,
+    fit_predict,
+    transform,
+    cluster_cost,
+    init_plus_plus,
+    sample_centroids,
+    min_cluster_distance,
+    count_samples_in_cluster,
+)
+from raft_tpu.cluster.kmeans_balanced import (
+    build_hierarchical,
+    balanced_kmeans,
+    predict as balanced_predict,
+)
+from raft_tpu.cluster.single_linkage import (
+    single_linkage,
+    LinkageDistance,
+)
+
+__all__ = [
+    "KMeansParams", "InitMethod",
+    "fit", "predict", "fit_predict", "transform", "cluster_cost",
+    "init_plus_plus", "sample_centroids", "min_cluster_distance",
+    "count_samples_in_cluster",
+    "build_hierarchical", "balanced_kmeans", "balanced_predict",
+    "single_linkage", "LinkageDistance",
+]

@@ -1,0 +1,127 @@
+"""Balanced hierarchical k-means — the ANN index trainer.
+
+Reference: ``spatial/knn/detail/ann_kmeans_balanced.cuh`` — minibatched EM
+(``predict`` :72 = norm-corrected GEMM + argmin), ``adjust_centers`` (:436:
+empty/small clusters steal points from big ones), ``balancing_em_iters``
+(:628), ``build_hierarchical`` (:848-ish: two-level — √k mesoclusters then
+per-meso fine clusters — so training never runs a huge single k).
+
+TPU design: predict is the scanned fused-L2-argmin (pure MXU);
+adjust_centers is deterministic — each under-populated cluster re-seeds to
+a point drawn from the highest-assignment-cost points, computed with one
+top_k; the EM iteration is a jit'd ``lax.fori_loop``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.fused_l2_nn import _fused_l2_nn
+
+
+def predict(x, centers, res=None) -> jax.Array:
+    """Nearest-center labels (reference ann_kmeans_balanced predict :72)."""
+    x = as_array(x).astype(jnp.float32)
+    centers = as_array(centers).astype(jnp.float32)
+    labels, _ = _fused_l2_nn(x, centers, False)
+    return labels
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters"))
+def _em(x, centers0, n_clusters: int, n_iters: int, balance_threshold: float):
+    n = x.shape[0]
+    avg = n / n_clusters
+
+    def one_iter(_, centers):
+        labels, d = _fused_l2_nn(x, centers, False)
+        counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels,
+                                     num_segments=n_clusters)
+        sums = jax.ops.segment_sum(x, labels, num_segments=n_clusters)
+        new_centers = sums / jnp.where(counts == 0.0, 1.0, counts)[:, None]
+        # adjust_centers (reference :436): clusters below threshold·avg
+        # re-seed from the globally highest-cost points
+        small = counts < balance_threshold * avg
+        _, worst = lax.top_k(d, n_clusters)
+        slot = jnp.cumsum(small.astype(jnp.int32)) - 1
+        seeds = x[worst]
+        new_centers = jnp.where(small[:, None],
+                                seeds[jnp.clip(slot, 0, n_clusters - 1)],
+                                new_centers)
+        return new_centers
+
+    return lax.fori_loop(0, n_iters, one_iter, centers0)
+
+
+def balanced_kmeans(x, n_clusters: int, n_iters: int = 20,
+                    balance_threshold: float = 0.25, seed: int = 0,
+                    res=None) -> jax.Array:
+    """Train ``n_clusters`` balanced centers (reference
+    balancing_em_iters :628). Returns (n_clusters, dim) centers."""
+    x = as_array(x).astype(jnp.float32)
+    key = jax.random.key(seed)
+    idx = jax.random.choice(key, x.shape[0], (n_clusters,), replace=False)
+    centers0 = x[idx]
+    return _em(x, centers0, n_clusters, n_iters, balance_threshold)
+
+
+def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
+                       max_train_points: int = 1 << 18, seed: int = 0,
+                       res=None) -> jax.Array:
+    """Two-level balanced trainer (reference build_hierarchical): train
+    √k mesoclusters on a subsample, partition, then train proportional
+    fine clusters per mesocluster; finish with balancing iterations over
+    the full center set."""
+    x = as_array(x).astype(jnp.float32)
+    n = x.shape[0]
+    key = jax.random.key(seed)
+
+    # subsample trainset (reference ivf builds train on a subset)
+    if n > max_train_points:
+        sel = jax.random.choice(key, n, (max_train_points,), replace=False)
+        xt = x[sel]
+    else:
+        xt = x
+    nt = xt.shape[0]
+
+    if n_clusters <= 32:
+        return balanced_kmeans(xt, n_clusters, n_iters, seed=seed, res=res)
+
+    n_meso = int(math.isqrt(n_clusters))
+    meso_centers = balanced_kmeans(xt, n_meso, n_iters, seed=seed, res=res)
+    meso_labels = predict(xt, meso_centers, res=res)
+    counts = jax.device_get(jax.ops.segment_sum(
+        jnp.ones((nt,), jnp.int32), meso_labels, num_segments=n_meso))
+
+    # proportional fine-cluster allocation (reference assigns
+    # fine-per-meso ∝ mesocluster size, at least 1)
+    alloc = [max(1, round(n_clusters * c / max(1, nt))) for c in counts]
+    # fix rounding drift
+    while sum(alloc) > n_clusters:
+        alloc[alloc.index(max(alloc))] -= 1
+    while sum(alloc) < n_clusters:
+        alloc[alloc.index(max(alloc))] += 1
+
+    meso_np = jax.device_get(meso_labels)
+    centers = []
+    for m in range(n_meso):
+        pts = xt[meso_np == m]
+        km = alloc[m]
+        if pts.shape[0] == 0:
+            centers.append(jnp.broadcast_to(meso_centers[m], (km, x.shape[1])))
+        elif pts.shape[0] <= km:
+            pad = jnp.broadcast_to(meso_centers[m],
+                                   (km - pts.shape[0], x.shape[1]))
+            centers.append(jnp.concatenate([pts, pad], axis=0))
+        else:
+            centers.append(balanced_kmeans(pts, km, max(4, n_iters // 2),
+                                           seed=seed + m + 1, res=res))
+    all_centers = jnp.concatenate(centers, axis=0)
+    # final balancing sweeps over the full center set
+    return _em(xt, all_centers, n_clusters, max(2, n_iters // 4), 0.25)

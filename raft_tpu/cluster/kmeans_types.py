@@ -1,0 +1,36 @@
+"""K-means parameter struct.
+
+Reference: ``raft/cluster/kmeans_types.hpp:23-32`` — ``KMeansParams`` with
+``InitMethod {KMeansPlusPlus, Random, Array}``, max_iter, tol,
+oversampling_factor (kmeans‖), batch_samples/batch_centroids (fusedL2NN
+tiling bounds), inertia_check.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class InitMethod(enum.IntEnum):
+    KMeansPlusPlus = 0
+    Random = 1
+    Array = 2
+
+
+@dataclass
+class KMeansParams:
+    n_clusters: int = 8
+    init: InitMethod = InitMethod.KMeansPlusPlus
+    max_iter: int = 300
+    tol: float = 1e-4
+    verbosity: int = 4
+    seed: int = 0
+    metric: int = 0  # DistanceType.L2Expanded
+    n_init: int = 1
+    oversampling_factor: float = 2.0
+    # tiling bounds for the assignment step (reference uses these to size
+    # the fusedL2NN workspace; here they bound scan tile sizes)
+    batch_samples: int = 1 << 15
+    batch_centroids: int = 0  # 0 = no batching
+    inertia_check: bool = False

@@ -1,0 +1,166 @@
+"""Single-linkage agglomerative clustering (HDBSCAN building block).
+
+Reference pipeline (``raft/cluster/single_linkage.cuh:53,90`` +
+``cluster/detail/{connectivities,mst,agglomerative}.cuh``):
+pairwise-or-kNN-graph connectivity → MST (Borůvka, with
+``connect_components`` fix-up for disconnected kNN graphs) → dendrogram
+built **on the host** (union-find over weight-sorted MST edges,
+``build_dendrogram_host`` :103) → flattened cluster extraction (:239).
+
+TPU split mirrors the reference's device/host split: distance/kNN-graph
+work runs on device (MXU); the irregular MST contraction and union-find
+run on host (numpy — the reference likewise hosts the dendrogram; a C++
+native path backs larger inputs, see native/).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import distance
+from raft_tpu.neighbors.brute_force import brute_force_knn
+from raft_tpu.sparse.solver.mst import boruvka_mst_edges
+
+
+class LinkageDistance(enum.IntEnum):
+    """reference cluster/single_linkage_types.hpp:22."""
+
+    PAIRWISE = 0
+    KNN_GRAPH = 1
+
+
+def _mst_from_knn(x_np: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """kNN-graph edges + cross-component 1-NN fix-up (reference
+    ``sparse/neighbors/connect_components.cuh``) until the graph spans."""
+    n = x_np.shape[0]
+    d, i = brute_force_knn(x_np, x_np, min(k + 1, n),
+                           DistanceType.L2SqrtExpanded)
+    d, i = np.asarray(d), np.asarray(i)
+    src = np.repeat(np.arange(n), i.shape[1])
+    dst = i.reshape(-1)
+    w = d.reshape(-1)
+    keep = src != dst
+    edges = (src[keep], dst[keep], w[keep])
+
+    while True:
+        mst_s, mst_d, mst_w, comp = boruvka_mst_edges(n, *edges)
+        n_comp = len(np.unique(comp))
+        if n_comp == 1:
+            return mst_s, mst_d, mst_w
+        # connect_components: for each component add its closest
+        # cross-component edge (FixConnectivitiesRedOp analogue). Host
+        # numpy — component counts/shapes are data-dependent, and a jitted
+        # per-component call would recompile for every shape.
+        extra_s, extra_d, extra_w = [], [], []
+        comps = np.unique(comp)
+        for c in comps:
+            mask = comp == c
+            if mask.all():
+                continue
+            a = x_np[mask]
+            b = x_np[~mask]
+            ai = np.where(mask)[0]
+            bi = np.where(~mask)[0]
+            d2 = (np.sum(a * a, 1)[:, None] + np.sum(b * b, 1)[None, :]
+                  - 2.0 * a @ b.T)
+            flat = np.argmin(d2)
+            r, cidx = divmod(flat, d2.shape[1])
+            extra_s.append(ai[r])
+            extra_d.append(bi[cidx])
+            extra_w.append(np.sqrt(max(d2[r, cidx], 0.0)))
+        edges = (np.concatenate([edges[0], np.asarray(extra_s)]),
+                 np.concatenate([edges[1], np.asarray(extra_d)]),
+                 np.concatenate([edges[2], np.asarray(extra_w, np.float32)]))
+
+
+def build_dendrogram_host(mst_src, mst_dst, mst_weight
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Union-find over weight-sorted MST edges → (children (n-1, 2),
+    heights, sizes), scipy-linkage-style (reference
+    ``build_dendrogram_host``, agglomerative.cuh:103)."""
+    order = np.argsort(mst_weight, kind="stable")
+    src, dst, w = mst_src[order], mst_dst[order], mst_weight[order]
+    n = len(src) + 1
+    parent = np.arange(2 * n - 1)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    children = np.zeros((n - 1, 2), np.int64)
+    heights = np.zeros(n - 1, np.float64)
+    sizes = np.zeros(n - 1, np.int64)
+    cluster_size = np.ones(2 * n - 1, np.int64)
+    next_label = n
+    for e in range(n - 1):
+        ra, rb = find(src[e]), find(dst[e])
+        children[e] = (ra, rb)
+        heights[e] = w[e]
+        sizes[e] = cluster_size[ra] + cluster_size[rb]
+        cluster_size[next_label] = sizes[e]
+        parent[ra] = parent[rb] = next_label
+        next_label += 1
+    return children, heights, sizes
+
+
+def _extract_flattened(children: np.ndarray, n: int, n_clusters: int
+                       ) -> np.ndarray:
+    """Cut the dendrogram at n_clusters (reference
+    extract_flattened_clusters, agglomerative.cuh:239)."""
+    parent = np.arange(2 * n - 1)
+    # apply only the first n-1-(n_clusters-1) merges
+    n_merges = n - n_clusters
+    for e in range(n_merges):
+        ra, rb = children[e]
+        parent[ra] = parent[rb] = n + e
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    roots = np.array([find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels.astype(np.int32)
+
+
+def single_linkage(
+    x,
+    n_clusters: int = 2,
+    dist_type: LinkageDistance = LinkageDistance.KNN_GRAPH,
+    c: int = 15,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Single-linkage clustering → (labels (n,), dendrogram children
+    (n-1, 2)). ``c`` controls kNN-graph degree (reference's ``c``
+    parameter, single_linkage.cuh:90: k = log(n) + c heuristic)."""
+    x = as_array(x).astype(jnp.float32)
+    n = x.shape[0]
+    expects(1 <= n_clusters <= n, "single_linkage: bad n_clusters")
+    x_np = np.asarray(jax.device_get(x))
+
+    if dist_type == LinkageDistance.PAIRWISE:
+        d = np.asarray(jax.device_get(
+            distance(x, x, DistanceType.L2SqrtExpanded, res=res)))
+        iu, ju = np.triu_indices(n, 1)
+        src, dst, w = boruvka_mst_edges(n, iu, ju, d[iu, ju])[:3]
+    else:
+        k = min(n - 1, max(2, int(np.log2(max(n, 2))) + c))
+        src, dst, w = _mst_from_knn(x_np, k)
+
+    children, heights, sizes = build_dendrogram_host(src, dst, w)
+    labels = _extract_flattened(children, n, n_clusters)
+    return jnp.asarray(labels), jnp.asarray(children)

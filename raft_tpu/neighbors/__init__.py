@@ -1,0 +1,29 @@
+"""Neighbors: brute-force and ANN indexes (SURVEY.md §2.7).
+
+Reference surface: ``raft/neighbors`` facade over ``spatial/knn/detail``:
+brute-force k-NN, fused L2 k-NN, top-k selection (warpsort/radix), IVF-Flat,
+IVF-PQ, ball cover, epsilon neighborhood.
+
+TPU re-design highlights:
+  * top-k: ``lax.top_k`` (exact) and ``lax.approx_min_k`` (the TPU-KNN
+    paper's partial-reduce op, PAPERS.md) replace warp_sort/radix_topk.
+  * brute-force: scan over DB tiles carrying a running top-k — the same
+    no-materialize property as the reference's fused_l2_knn.
+  * IVF indexes: lane-aligned padded list layouts replace the CUDA
+    32-interleaved groups; list scans are dense MXU matmuls over buckets.
+"""
+
+from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+from raft_tpu.neighbors.selection import select_k
+from raft_tpu.neighbors.brute_force import knn, brute_force_knn, knn_merge_parts, fused_l2_knn
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors_l2sq
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import ball_cover
+from raft_tpu.neighbors.refine import refine
+
+__all__ = [
+    "IndexParams", "SearchParams",
+    "select_k", "knn", "brute_force_knn", "knn_merge_parts", "fused_l2_knn",
+    "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ball_cover", "refine",
+]

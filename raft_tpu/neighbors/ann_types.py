@@ -1,0 +1,24 @@
+"""Base ANN parameter structs.
+
+Reference: ``raft/neighbors/ann_types.hpp:23-45`` — ``index_params``
+(metric, metric_arg, add_data_on_build) and ``search_params`` bases that
+IVF-Flat/IVF-PQ extend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from raft_tpu.distance.distance_types import DistanceType
+
+
+@dataclass
+class IndexParams:
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+
+
+@dataclass
+class SearchParams:
+    pass

@@ -1,0 +1,151 @@
+"""Brute-force k-NN.
+
+Reference: ``raft/neighbors/brute_force.cuh:48,102,134`` (``knn_merge_parts``,
+``knn``, ``fused_l2_knn``) over ``spatial/knn/detail/knn_brute_force_faiss.cuh``
+(FAISS bfKnn per tile + heap merge) and ``fused_l2_knn.cuh`` (single-kernel
+L2 top-k that never materializes the distance matrix).
+
+TPU design: one formulation covers both — a ``lax.scan`` over database
+tiles, each step computing an (n_queries, tile) distance block on the MXU
+and merging it into a carried (n_queries, k) running top-k. Peak memory is
+n_queries × (tile + k), independent of database size; XLA keeps the merge
+in VMEM. Metrics needing preprocessing (cosine/correlation) follow the
+reference's row-normalization trick (``spatial/knn/detail/processing.hpp``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import _pairwise
+from raft_tpu.neighbors.selection import select_k
+
+_TILE_ELEMS = 1 << 22  # per-tile f32 budget for the (n_queries, tile) block
+
+
+def _db_tile(n_queries: int, n_db: int) -> int:
+    t = max(128, min(n_db, _TILE_ELEMS // max(1, n_queries)))
+    if t >= 128:
+        t -= t % 128
+    return min(t, n_db)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "metric", "metric_arg", "tile",
+                                    "select_min"))
+def _knn_scan(queries, db, k: int, metric: DistanceType, metric_arg: float,
+              tile: int, select_min: bool = True):
+    nq = queries.shape[0]
+    n = db.shape[0]
+    pad = (-n) % tile
+    dbp = jnp.pad(db, ((0, pad), (0, 0))) if pad else db
+    n_tiles = (n + pad) // tile
+    db_tiles = dbp.reshape(n_tiles, tile, -1)
+    offs = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    sign = 1.0 if select_min else -1.0
+
+    def step(carry, inp):
+        best_d, best_i = carry  # (nq, k) each
+        dtile, off = inp
+        d = sign * _pairwise(queries, dtile, metric, metric_arg)  # (nq, tile)
+        col = jnp.arange(tile, dtype=jnp.int32)[None, :] + off
+        d = jnp.where(col < n, d, jnp.inf)
+        # two-phase: per-tile top-k first (wide select), then a narrow 2k
+        # merge with the carry — keeps every sort small (the same split as
+        # the reference's per-tile WarpSelect + merge pass)
+        td, tsel = lax.top_k(-d, min(k, tile))
+        ti = jnp.take_along_axis(jnp.broadcast_to(col, (nq, tile)), tsel, axis=1)
+        cat_d = jnp.concatenate([best_d, -td], axis=1)
+        cat_i = jnp.concatenate([best_i, ti], axis=1)
+        nd, sel = lax.top_k(-cat_d, k)
+        ni = jnp.take_along_axis(cat_i, sel, axis=1)
+        return (-nd, ni), None
+
+    init = (jnp.full((nq, k), jnp.inf, dtype=jnp.float32),
+            jnp.full((nq, k), -1, dtype=jnp.int32))
+    (d, i), _ = lax.scan(step, init, (db_tiles, offs))
+    return sign * d, i
+
+
+def brute_force_knn(
+    db,
+    queries,
+    k: int,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    metric_arg: float = 2.0,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN of ``queries`` against ``db`` → (dists, indices), both
+    (n_queries, k). Any :class:`DistanceType` (larger-is-better metrics
+    like plain InnerProduct select max via distance negation, matching the
+    reference's treatment of IP in FAISS)."""
+    db, queries = as_array(db), as_array(queries)
+    expects(db.shape[1] == queries.shape[1], "knn: dim mismatch")
+    expects(k <= db.shape[0], "knn: k > database size")
+    metric = DistanceType(metric)
+    tile = _db_tile(queries.shape[0], db.shape[0])
+    # InnerProduct is a similarity: select the k LARGEST (the reference
+    # routes IP through FAISS's max-heap select)
+    select_min = metric != DistanceType.InnerProduct
+    return _knn_scan(queries, db, k, metric, metric_arg, tile,
+                     select_min=select_min)
+
+
+def knn(
+    index: Sequence,
+    search,
+    k: int,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    metric_arg: float = 2.0,
+    translations: Optional[Sequence[int]] = None,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-part brute-force k-NN (reference ``neighbors/brute_force.cuh:102``):
+    ``index`` is a list of database parts; per-part results are merged and
+    indices translated by part offsets (or explicit ``translations``)."""
+    if not isinstance(index, (list, tuple)):
+        index = [index]
+    parts_d, parts_i = [], []
+    offset = 0
+    for p_idx, part in enumerate(index):
+        part = as_array(part)
+        d, i = brute_force_knn(part, search, min(k, part.shape[0]),
+                               metric, metric_arg, res=res)
+        base = translations[p_idx] if translations is not None else offset
+        parts_d.append(d)
+        parts_i.append(i + jnp.int32(base))
+        offset += part.shape[0]
+    if len(parts_d) == 1:
+        return parts_d[0], parts_i[0]
+    return knn_merge_parts(parts_d, parts_i, k,
+                           select_min=metric != DistanceType.InnerProduct)
+
+
+def knn_merge_parts(part_dists, part_indices, k: int, select_min: bool = True,
+                    res=None) -> Tuple[jax.Array, jax.Array]:
+    """Merge per-part top-k lists into a global top-k (reference
+    ``knn_merge_parts``, brute_force.cuh:48 — BlockSelect heap merge; here
+    one concat + top_k, which XLA fuses)."""
+    d = jnp.concatenate([as_array(x) for x in part_dists], axis=1)
+    i = jnp.concatenate([as_array(x) for x in part_indices], axis=1)
+    sign = 1.0 if select_min else -1.0
+    nd, sel = lax.top_k(-sign * d, k)
+    return sign * -nd, jnp.take_along_axis(i, sel, axis=1)
+
+
+def fused_l2_knn(db, queries, k: int, sqrt: bool = False, res=None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """L2 k-NN without materializing distances (reference
+    ``spatial/knn/detail/fused_l2_knn.cuh:947``). The scan formulation IS
+    fused on TPU; this entry point fixes the metric and exposes the
+    sqrt toggle of the reference's L2 exp/unexp variants."""
+    metric = (DistanceType.L2SqrtExpanded if sqrt else DistanceType.L2Expanded)
+    return brute_force_knn(db, queries, k, metric, res=res)

@@ -1,0 +1,28 @@
+"""Epsilon neighborhood (ball query).
+
+Reference: ``raft/neighbors/epsilon_neighborhood.cuh`` /
+``spatial/knn/detail/epsilon_neighborhood.cuh`` — boolean adjacency of
+points within eps² (squared L2) plus per-point vertex degrees.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import distance
+
+
+def eps_neighbors_l2sq(x, y, eps_sq: float, res=None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """adj[i,j] = ||x_i - y_j||² < eps², plus row degrees (vd in the
+    reference; the reference also appends the total count — derive with
+    ``jnp.sum(degrees)``)."""
+    d = distance(x, y, DistanceType.L2Expanded, res=res)
+    adj = d < eps_sq
+    degrees = jnp.sum(adj.astype(jnp.int32), axis=1)
+    return adj, degrees

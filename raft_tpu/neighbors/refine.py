@@ -1,0 +1,39 @@
+"""Candidate refinement (exact re-ranking).
+
+Counterpart of the reference's refinement step for quantized indexes
+(IVF-PQ results re-ranked with exact distances; in RAFT this landed as
+``neighbors/refine.cuh`` shortly after the snapshot — included here
+because IVF-PQ recall targets depend on it).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+
+
+def refine(dataset, queries, candidates, k: int,
+           metric: DistanceType = DistanceType.L2Expanded, res=None
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank ``candidates`` (nq, n_cand) with exact distances against
+    ``dataset`` rows; returns exact (dists, ids) top-k. Padded candidate
+    slots (-1) are ignored."""
+    x = as_array(dataset).astype(jnp.float32)
+    q = as_array(queries).astype(jnp.float32)
+    cand = as_array(candidates).astype(jnp.int32)
+    vecs = x[jnp.clip(cand, 0, x.shape[0] - 1)]       # (nq, n_cand, dim)
+    qq = jnp.sum(q * q, axis=1)
+    vv = jnp.sum(vecs * vecs, axis=2)
+    ip = jnp.einsum("qd,qcd->qc", q, vecs, preferred_element_type=jnp.float32)
+    d = jnp.maximum(qq[:, None] + vv - 2.0 * ip, 0.0)
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        d = jnp.sqrt(d)
+    d = jnp.where(cand >= 0, d, jnp.inf)
+    nd, sel = lax.top_k(-d, k)
+    return -nd, jnp.take_along_axis(cand, sel, axis=1)

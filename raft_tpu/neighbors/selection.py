@@ -1,0 +1,63 @@
+"""k-selection (top-k smallest/largest).
+
+Reference: ``spatial/knn/detail/topk.cuh:65-83`` dispatches k≤256 to
+warp-sort (``topk/warpsort_topk.cuh``) and larger k to multi-pass radix
+(``topk/radix_topk.cuh``). Neither maps to TPU (no warp shuffles, no
+atomics); the TPU-native selection kernels are:
+
+  * ``lax.top_k`` — exact, XLA's sorting-network selection; and
+  * ``lax.approx_min_k``/``approx_max_k`` — the TPU-KNN partial-reduce
+    operator (PAPERS.md: "TPU-KNN: K Nearest Neighbor Search at Peak
+    FLOP/s") with tunable ``recall_target``, fused with its producer.
+
+``select_k`` mirrors the reference dispatch with ``mode``:
+"exact" | "approx" — default exact for parity; ANN searches pass approx
+with a recall target, recovering the reference's perf-over-exactness
+tradeoff in TPU terms.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.mdarray import as_array
+
+
+def select_k(
+    values,
+    k: int,
+    select_min: bool = True,
+    input_indices=None,
+    mode: str = "exact",
+    recall_target: float = 0.95,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row k smallest (or largest) values with their indices.
+
+    values: (n_rows, n_cols); returns (dists (n_rows, k), ids (n_rows, k)
+    int32). ``input_indices`` optionally maps local columns to global ids
+    (the role of translations in the reference's select_k,
+    ``spatial/knn/knn.cuh:125``).
+    """
+    v = as_array(values)
+    if mode == "approx":
+        if select_min:
+            d, i = lax.approx_min_k(v, k, recall_target=recall_target)
+        else:
+            d, i = lax.approx_max_k(v, k, recall_target=recall_target)
+    else:
+        if select_min:
+            d, i = lax.top_k(-v, k)
+            d = -d
+        else:
+            d, i = lax.top_k(v, k)
+    i = i.astype(jnp.int32)
+    if input_indices is not None:
+        idx = as_array(input_indices).astype(jnp.int32)
+        i = jnp.take_along_axis(
+            jnp.broadcast_to(idx, (v.shape[0], idx.shape[-1])), i, axis=1)
+    return d, i

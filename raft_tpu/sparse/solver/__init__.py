@@ -1,0 +1,5 @@
+"""Sparse solvers (SURVEY.md §2.6): MST and Lanczos."""
+
+from raft_tpu.sparse.solver.mst import mst, boruvka_mst_edges
+
+__all__ = ["mst", "boruvka_mst_edges"]

@@ -1,0 +1,130 @@
+"""Cluster tests (reference analogue: cpp/test/cluster/kmeans.cu checks
+inertia + adjusted rand index; linkage.cu compares flattened clusters)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import sklearn.cluster as skc
+import sklearn.metrics as skm
+from scipy.cluster.hierarchy import fcluster, linkage as scipy_linkage
+
+from raft_tpu.cluster import (
+    KMeansParams,
+    InitMethod,
+    fit,
+    predict,
+    fit_predict,
+    transform,
+    cluster_cost,
+    init_plus_plus,
+    sample_centroids,
+    build_hierarchical,
+    balanced_kmeans,
+    balanced_predict,
+    single_linkage,
+    LinkageDistance,
+)
+from raft_tpu.random import make_blobs
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    x, y = make_blobs(n_samples=2000, n_features=8, centers=5,
+                      cluster_std=1.0, seed=3)
+    return np.asarray(x), np.asarray(y)
+
+
+class TestKMeans:
+    def test_fit_quality_vs_sklearn(self, blobs):
+        x, y = blobs
+        params = KMeansParams(n_clusters=5, max_iter=50, seed=0)
+        centroids, inertia, n_iter = fit(x, params)
+        sk = skc.KMeans(n_clusters=5, n_init=3, random_state=0).fit(x)
+        # our inertia within 10% of sklearn's
+        assert float(inertia) < sk.inertia_ * 1.1
+        labels = np.asarray(predict(x, centroids))
+        assert skm.adjusted_rand_score(y, labels) > 0.95
+
+    def test_random_init(self, blobs):
+        x, y = blobs
+        params = KMeansParams(n_clusters=5, init=InitMethod.Random,
+                              max_iter=100, seed=1)
+        _, inertia, _ = fit(x, params)
+        sk = skc.KMeans(n_clusters=5, n_init=3, random_state=0).fit(x)
+        assert float(inertia) < sk.inertia_ * 1.25
+
+    def test_array_init(self, blobs):
+        x, _ = blobs
+        c0 = x[:5]
+        params = KMeansParams(n_clusters=5, init=InitMethod.Array, max_iter=50)
+        centroids, inertia, _ = fit(x, params, init_centroids=c0)
+        assert np.isfinite(float(inertia))
+
+    def test_sample_weight(self, blobs):
+        x, _ = blobs
+        w = np.ones(len(x), np.float32)
+        w[:100] = 100.0  # upweight first cluster region
+        params = KMeansParams(n_clusters=5, max_iter=50, seed=0)
+        centroids, _, _ = fit(x, params, sample_weight=w)
+        assert centroids.shape == (5, 8)
+
+    def test_transform_and_cost(self, blobs):
+        x, _ = blobs
+        params = KMeansParams(n_clusters=5, max_iter=30, seed=0)
+        centroids, inertia, _ = fit(x, params)
+        t = np.asarray(transform(x, centroids))
+        assert t.shape == (len(x), 5)
+        cost = float(cluster_cost(x, centroids))
+        np.testing.assert_allclose(cost, float(inertia), rtol=1e-3)
+
+    def test_plus_plus_beats_random_seed_cost(self, blobs):
+        x, _ = blobs
+        cpp_c = init_plus_plus(x, 5, seed=0)
+        rnd_c = sample_centroids(x, 5, seed=0)
+        assert float(cluster_cost(x, cpp_c)) <= float(cluster_cost(x, rnd_c)) * 1.5
+
+    def test_fit_predict(self, blobs):
+        x, y = blobs
+        labels, centroids, inertia, n_iter = fit_predict(
+            x, KMeansParams(n_clusters=5, max_iter=50, seed=0))
+        assert skm.adjusted_rand_score(y, np.asarray(labels)) > 0.9
+
+
+class TestBalancedKMeans:
+    def test_balance(self, blobs):
+        x, _ = blobs
+        centers = balanced_kmeans(x, 16, n_iters=20, seed=0)
+        labels = np.asarray(balanced_predict(x, centers))
+        counts = np.bincount(labels, minlength=16)
+        # balanced: no empty clusters, max/mean bounded
+        assert counts.min() > 0
+        assert counts.max() < 6 * counts.mean()
+
+    def test_hierarchical_large_k(self):
+        x, _ = make_blobs(n_samples=5000, n_features=16, centers=50,
+                          cluster_std=1.0, seed=0)
+        centers = build_hierarchical(x, 64, n_iters=10)
+        assert centers.shape == (64, 16)
+        labels = np.asarray(balanced_predict(x, centers))
+        counts = np.bincount(labels, minlength=64)
+        assert (counts > 0).sum() > 56  # nearly all lists populated
+
+
+class TestSingleLinkage:
+    def test_vs_scipy_pairwise(self):
+        x, _ = make_blobs(n_samples=120, n_features=2, centers=3,
+                          cluster_std=0.4, seed=5)
+        xn = np.asarray(x)
+        labels, children = single_linkage(
+            x, n_clusters=3, dist_type=LinkageDistance.PAIRWISE)
+        z = scipy_linkage(xn, method="single")
+        ref = fcluster(z, 3, criterion="maxclust")
+        assert skm.adjusted_rand_score(ref, np.asarray(labels)) > 0.99
+
+    def test_knn_graph_mode(self):
+        x, y = make_blobs(n_samples=300, n_features=8, centers=4,
+                          cluster_std=0.5, seed=7)
+        labels, _ = single_linkage(x, n_clusters=4,
+                                   dist_type=LinkageDistance.KNN_GRAPH, c=10)
+        assert skm.adjusted_rand_score(np.asarray(y), np.asarray(labels)) > 0.95
