@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Headline benchmark: fused brute-force L2 k-NN throughput on one chip.
+
+Mirrors the reference's gbench flagship case (``cpp/bench/neighbors/knn.cuh
+:380-389``: {1M-2M}×128 fp32 database, 1000 queries, k=32, SEARCH scope).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: the reference repo publishes no absolute numbers
+(BASELINE.md); the declared baseline proxy is 40 ms wall for the
+1M×128×1000q×k=32 search on the reference's A100 class hardware — the
+right order for a fused brute-force scan at ~full HBM/MXU utilization.
+vs_baseline = proxy_ms / measured_ms (>1 means faster than proxy).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_DB = int(os.environ.get("BENCH_N_DB", 1_000_000))
+N_DIM = int(os.environ.get("BENCH_DIM", 128))
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", 1000))
+K = int(os.environ.get("BENCH_K", 32))
+BASELINE_PROXY_MS = 40.0
+
+
+def main():
+    import jax
+    # BENCH_PLATFORM=cpu for smoke runs: the env-var route
+    # (JAX_PLATFORMS) is overridden by the host sitecustomize, so the
+    # config API is the only reliable selector (see
+    # .claude/skills/verify/SKILL.md)
+    if "BENCH_PLATFORM" in os.environ:
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors.brute_force import _knn_scan, _db_tile
+    from raft_tpu.distance.distance_types import DistanceType
+
+    key = jax.random.key(0)
+    kq, kd = jax.random.split(key)
+    db = jax.random.normal(kd, (N_DB, N_DIM), dtype=jnp.float32)
+    q = jax.random.normal(kq, (N_QUERIES, N_DIM), dtype=jnp.float32)
+    db = jax.device_put(db)
+    q = jax.device_put(q)
+    jax.block_until_ready((db, q))
+
+    tile = _db_tile(N_QUERIES, N_DB)
+
+    def run():
+        d, i = _knn_scan(q, db, K, DistanceType.L2Expanded, 2.0, tile)
+        jax.block_until_ready((d, i))
+        return d, i
+
+    run()  # compile + warm
+    n_iters = 5
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        run()
+    wall = (time.perf_counter() - t0) / n_iters
+    ms = wall * 1e3
+    qps = N_QUERIES / wall
+    print(json.dumps({
+        "metric": f"bfknn_search_{N_DB//1000}kx{N_DIM}_q{N_QUERIES}_k{K}_qps",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(BASELINE_PROXY_MS / ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

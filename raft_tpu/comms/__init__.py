@@ -1,0 +1,37 @@
+"""Communication backend (SURVEY.md §2.2) — TPU-native comms_t.
+
+Reference: ``raft::comms`` (``core/comms.hpp:108-216`` iface; ``std_comms``
+= NCCL+UCX, ``mpi_comms`` = MPI+NCCL). Here the single implementation is
+**XLA collectives over ICI/DCN on a jax Mesh** — psum/all_gather/
+reduce_scatter/ppermute inside shard_map regions — plus the
+jax.distributed coordination service for multi-host bootstrap (the role
+NCCL rendezvous + Dask play in the reference).
+"""
+
+from raft_tpu.comms.comms import (
+    Comms,
+    ReduceOp,
+    Status,
+    build_comms,
+    inject_comms,
+)
+from raft_tpu.comms.collective_checks import (
+    test_collective_allreduce,
+    test_collective_broadcast,
+    test_collective_reduce,
+    test_collective_allgather,
+    test_collective_gather,
+    test_collective_reducescatter,
+    test_pointToPoint_simple_send_recv,
+    test_commsplit,
+)
+from raft_tpu.comms.bootstrap import Session, local_handle, initialize_distributed
+
+__all__ = [
+    "Comms", "ReduceOp", "Status", "build_comms", "inject_comms",
+    "test_collective_allreduce", "test_collective_broadcast",
+    "test_collective_reduce", "test_collective_allgather",
+    "test_collective_gather", "test_collective_reducescatter",
+    "test_pointToPoint_simple_send_recv", "test_commsplit",
+    "Session", "local_handle", "initialize_distributed",
+]

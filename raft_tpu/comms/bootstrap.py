@@ -1,0 +1,104 @@
+"""Multi-host session bootstrap.
+
+Reference: raft-dask's ``Comms`` session (``python/raft-dask/raft_dask/
+common/comms.py:37-244``): pick a root, exchange an NCCL unique id across
+Dask workers, build one handle per worker and inject a communicator; user
+algorithms then call ``local_handle(sessionId)`` from any task.
+
+TPU-native equivalent: the rendezvous artifact is the **coordination
+service address** (``jax.distributed.initialize``) instead of an
+ncclUniqueId; after init, every process sees the global device set and
+builds the same Mesh. ``Session`` owns the mesh + injected Resources and
+registers itself so ``local_handle(session_id)`` works identically to the
+reference's worker-side lookup.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import Resources
+from raft_tpu.comms.comms import Comms, build_comms, inject_comms
+
+_sessions: Dict[str, "Session"] = {}
+_lock = threading.Lock()
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Join the jax coordination service (multi-host rendezvous — the
+    NCCL-unique-id exchange analogue, reference comms.py:136-152 +
+    nccl.pyx:121). No-op on single-process."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+class Session:
+    """A comms session over a device mesh (reference raft_dask Comms).
+
+    ``init()`` builds the mesh over all visible devices (local for one
+    host, global after ``initialize_distributed``), creates the
+    communicator and a Resources with comms injected.
+    """
+
+    def __init__(self, axis_names: Tuple[str, ...] = ("data",),
+                 mesh_shape: Optional[Tuple[int, ...]] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        self.session_id = uuid.uuid4().hex[:16]
+        self._axis_names = axis_names
+        self._mesh_shape = mesh_shape
+        self._devices = devices
+        self.mesh: Optional[jax.sharding.Mesh] = None
+        self.resources: Optional[Resources] = None
+        self.comms: Optional[Comms] = None
+
+    def init(self) -> "Session":
+        devs = list(self._devices) if self._devices is not None else jax.devices()
+        if self._mesh_shape is None:
+            shape = (len(devs),) + (1,) * (len(self._axis_names) - 1)
+        else:
+            shape = self._mesh_shape
+        expects(int(np.prod(shape)) == len(devs),
+                "Session.init: mesh shape %s != %d devices", shape, len(devs))
+        self.mesh = jax.sharding.Mesh(
+            np.asarray(devs).reshape(shape), axis_names=self._axis_names)
+        self.resources = Resources(devices=devs, mesh=self.mesh)
+        self.comms = build_comms(self.mesh, self._axis_names[0])
+        inject_comms(self.resources, self.comms)
+        # named subcomms per remaining axis (reference handle subcomms)
+        for ax in self._axis_names[1:]:
+            self.resources.set_subcomm(ax, build_comms(self.mesh, ax))
+        with _lock:
+            _sessions[self.session_id] = self
+        return self
+
+    def destroy(self) -> None:
+        with _lock:
+            _sessions.pop(self.session_id, None)
+        self.mesh = None
+        self.resources = None
+        self.comms = None
+
+    def __enter__(self):
+        return self.init()
+
+    def __exit__(self, *exc):
+        self.destroy()
+
+
+def local_handle(session_id: str) -> Resources:
+    """Resources bound to a session (reference raft_dask
+    ``local_handle(sessionId)``, comms.py:247-263)."""
+    with _lock:
+        expects(session_id in _sessions, "unknown session %s", session_id)
+        return _sessions[session_id].resources

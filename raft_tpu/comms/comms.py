@@ -1,0 +1,307 @@
+"""comms_t over XLA collectives.
+
+Reference iface: ``core/comms.hpp:108-216`` — rank/size, comm_split,
+barrier, sync_stream (failure-aware), device collectives (allreduce/bcast/
+reduce/allgather/allgatherv/gather/gatherv/reducescatter), p2p
+device_send/recv/sendrecv, group_start/end; dtype/op enums at :27-28 and
+status SUCCESS/ERROR/ABORT at :33.
+
+TPU mapping — the key design decision: a RAFT communicator is *called from
+inside device algorithms*; the XLA analogue of "inside a device algorithm"
+is **inside a shard_map/pjit region over a Mesh axis**. So :class:`Comms`
+is a lightweight value object carrying (axis_name, axis_index_groups) and
+its collective methods emit ``jax.lax`` collectives that are only valid
+within such a region. Algorithms written against it look just like the
+reference's (grab comms from the handle, issue collectives); deployment
+binds the mesh (see bootstrap.py), XLA compiles the collectives onto
+ICI/DCN.
+
+``comm_split(color, key)`` → ``axis_index_groups`` (SURVEY.md hard part
+(f)): groups are computed host-side from the colors/keys of *all* ranks —
+the reference allgathers colors over the existing comm
+(std_comms.hpp:124-187); here the split table must be host-known (static
+for XLA), which matches how the reference's callers actually use it
+(deterministic color functions of rank).
+
+Failure semantics (SURVEY.md hard part (e)): XLA collectives cannot
+return ABORT mid-program — a lost participant hangs the program. The
+reference's ``sync_stream`` polling loop maps to host-side
+``sync_stream`` here: block on the result with a timeout; on timeout
+report ``Status.ABORT`` so the caller can tear down and re-form the mesh
+(the reference's "abort comm, caller recreates clique" recovery,
+comms/detail/util.hpp:130-133).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.error import expects
+
+
+class Status(enum.IntEnum):
+    """reference core/comms.hpp:33 status_t."""
+
+    SUCCESS = 0
+    ERROR = 1
+    ABORT = 2
+
+
+class ReduceOp(enum.IntEnum):
+    """reference core/comms.hpp:28 op_t."""
+
+    SUM = 0
+    PROD = 1
+    MIN = 2
+    MAX = 3
+
+
+@dataclass(frozen=True)
+class Comms:
+    """Communicator bound to a mesh axis (or axes).
+
+    ``n_ranks``/``axis_name`` describe the collective group;
+    ``axis_index_groups`` (optional) restricts collectives to subgroups —
+    the product of :meth:`comm_split`.
+    """
+
+    axis_name: str = "data"
+    n_ranks: int = 1
+    axis_index_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+    # host-side metadata for sync_stream timeout semantics
+    abort_timeout_s: float = 60.0
+
+    # -- topology ----------------------------------------------------------
+    def get_size(self) -> int:
+        if self.axis_index_groups is not None:
+            return len(self.axis_index_groups[0])
+        return self.n_ranks
+
+    def get_rank(self):
+        """Device-side rank (inside shard_map): index along the comm axis.
+        With subgroups, the rank within the subgroup."""
+        idx = lax.axis_index(self.axis_name)
+        if self.axis_index_groups is None:
+            return idx
+        # rank within subgroup = position of idx in its group
+        groups = jnp.asarray(self.axis_index_groups)  # (n_groups, group_sz)
+        member = (groups == idx[None, None]).any(axis=1)  # (n_groups,)
+        gid = jnp.argmax(member)
+        pos = jnp.argmax(groups[gid] == idx)
+        return pos
+
+    # -- split (core/comms.hpp comm_split; std_comms.hpp:124) --------------
+    def comm_split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+                   ) -> "Comms":
+        """Split into sub-communicators by color; rank order within each
+        subgroup follows ``keys`` (default: existing rank order). Colors
+        are host-known per global rank (see module docstring)."""
+        n = self.n_ranks
+        expects(len(colors) == n, "comm_split: need one color per rank")
+        if keys is None:
+            keys = list(range(n))
+        groups: Dict[int, List[int]] = {}
+        for r in range(n):
+            groups.setdefault(colors[r], []).append(r)
+        ordered = []
+        sizes = set()
+        for color in sorted(groups):
+            members = sorted(groups[color], key=lambda r: (keys[r], r))
+            ordered.append(tuple(members))
+            sizes.add(len(members))
+        expects(len(sizes) == 1,
+                "comm_split: XLA axis_index_groups require equal-size groups "
+                "(got sizes %s)", sizes)
+        return replace(self, axis_index_groups=tuple(ordered))
+
+    # -- device collectives (valid inside shard_map) -----------------------
+    #
+    # Subgroup note: shard_map's collectives don't accept
+    # axis_index_groups, so split communicators lower to full-axis
+    # gathers + host-known group tables (the group structure is static —
+    # XLA folds the masks; a ring within a subgroup uses ppermute with an
+    # explicit static pattern, which IS natively supported).
+
+    def _my_group(self):
+        """(group row of this rank, in-group rank) — device values."""
+        idx = lax.axis_index(self.axis_name)
+        groups = jnp.asarray(self.axis_index_groups)  # (n_groups, gsz)
+        member = (groups == idx[None, None]).any(axis=1)
+        gid = jnp.argmax(member)
+        row = groups[gid]
+        pos = jnp.argmax(row == idx)
+        return row, pos
+
+    def _group_reduce(self, x, op: ReduceOp):
+        g = lax.all_gather(x, self.axis_name)  # (n_ranks, ...)
+        row, _ = self._my_group()
+        mine = jnp.take(g, row, axis=0)        # (gsz, ...)
+        if op == ReduceOp.SUM:
+            return jnp.sum(mine, axis=0)
+        if op == ReduceOp.MAX:
+            return jnp.max(mine, axis=0)
+        if op == ReduceOp.MIN:
+            return jnp.min(mine, axis=0)
+        if op == ReduceOp.PROD:
+            return jnp.prod(mine, axis=0)
+        raise ValueError(f"unsupported op {op}")
+
+    def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
+        if self.axis_index_groups is not None:
+            return self._group_reduce(x, op)
+        if op == ReduceOp.SUM:
+            return lax.psum(x, self.axis_name)
+        if op == ReduceOp.MAX:
+            return lax.pmax(x, self.axis_name)
+        if op == ReduceOp.MIN:
+            return lax.pmin(x, self.axis_name)
+        if op == ReduceOp.PROD:
+            # no native pprod: gather + product (sign-safe)
+            g = lax.all_gather(x, self.axis_name)
+            return jnp.prod(g, axis=0)
+        raise ValueError(f"unsupported op {op}")
+
+    def bcast(self, x, root: int = 0):
+        """Every rank receives root's value (root is the in-group rank)."""
+        g = lax.all_gather(x, self.axis_name)
+        if self.axis_index_groups is None:
+            return g[root]
+        row, _ = self._my_group()
+        return jnp.take(g, row[root], axis=0)
+
+    def reduce(self, x, root: int = 0, op: ReduceOp = ReduceOp.SUM):
+        """Reduction valid on ``root``; other ranks receive zeros (the
+        reference leaves their buffers untouched — zeros make the contract
+        explicit under SPMD)."""
+        red = self.allreduce(x, op)
+        return jnp.where(self.get_rank() == root, red, jnp.zeros_like(red))
+
+    def allgather(self, x):
+        if self.axis_index_groups is None:
+            return lax.all_gather(x, self.axis_name)
+        g = lax.all_gather(x, self.axis_name)
+        row, _ = self._my_group()
+        return jnp.take(g, row, axis=0)
+
+    def allgatherv(self, x, counts: Sequence[int]):
+        """Variable-size allgather: ranks pad to max(counts) then gather
+        (XLA requires static shapes — same bucketing the rest of the
+        framework uses). Rows past ``counts[r]`` in shard r's slice of the
+        result are padding; the caller holds ``counts`` for unpacking."""
+        max_c = max(counts)
+        pad = max_c - x.shape[0]
+        expects(pad >= 0,
+                "allgatherv: local rows %d exceed max(counts) %d",
+                x.shape[0], max_c)
+        xp = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+        return self.allgather(xp)
+
+    def gather(self, x, root: int = 0):
+        g = self.allgather(x)
+        return jnp.where(self.get_rank() == root, g, jnp.zeros_like(g))
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0):
+        g = self.allgatherv(x, counts)
+        return jnp.where(self.get_rank() == root, g, jnp.zeros_like(g))
+
+    def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
+        """Input length must be divisible by group size; rank r receives
+        the r-th chunk of the elementwise reduction."""
+        expects(op == ReduceOp.SUM, "reducescatter: SUM only (XLA psum_scatter)")
+        if self.axis_index_groups is None:
+            return lax.psum_scatter(x, self.axis_name, tiled=True)
+        red = self._group_reduce(x, op)
+        gsz = self.get_size()
+        chunk = x.shape[0] // gsz
+        _, pos = self._my_group()
+        return lax.dynamic_slice_in_dim(red, pos * chunk, chunk)
+
+    # -- p2p (core/comms.hpp device_send/recv; ppermute is the ICI path).
+    # XLA needs the full (src, dst) pattern statically, so the tagged
+    # dynamic send/recv of the reference becomes device_send_recv(perm) /
+    # ring_permute; arbitrary host tagged p2p lives in bootstrap.Session.
+    def ring_permute(self, x, shift: int = 1):
+        """collective_permute around the ring (within each subgroup for a
+        split comm) — the merge primitive for sharded top-k (SURVEY.md §5
+        long-context slot)."""
+        if self.axis_index_groups is None:
+            n = self.get_size()
+            perm = [(i, (i + shift) % n) for i in range(n)]
+        else:
+            perm = []
+            for grp in self.axis_index_groups:
+                s = len(grp)
+                perm += [(grp[i], grp[(i + shift) % s]) for i in range(s)]
+        return lax.ppermute(x, self.axis_name, perm)
+
+    def device_send_recv(self, x, perm: Sequence[Tuple[int, int]]):
+        """Explicit (src, dst) permutation (reference device_send/recv
+        pairs; XLA requires the full pattern statically)."""
+        return lax.ppermute(x, self.axis_name, list(perm))
+
+    def alltoall(self, x):
+        """all-to-all over the leading axis (the sequence/context-parallel
+        exchange primitive). Full-axis comms only: XLA's all_to_all has no
+        subgroup form, and emulating it for split comms would silently
+        de-optimize the one op whose point is ICI bandwidth."""
+        expects(self.axis_index_groups is None,
+                "alltoall is not supported on split communicators")
+        n = self.get_size()
+        expects(x.shape[0] % n == 0,
+                "alltoall: leading dim %d not divisible by %d ranks",
+                x.shape[0], n)
+        return lax.all_to_all(x.reshape(n, -1, *x.shape[1:]),
+                              self.axis_name, 0, 0, tiled=False).reshape(
+                                  -1, *x.shape[1:])
+
+    def barrier_value(self):
+        """Device-side barrier: tiny psum every rank must reach (reference
+        std_comms barrier :189 — allreduce on a scalar)."""
+        return self.allreduce(jnp.ones((), jnp.int32))
+
+    # -- host-side sync with failure semantics -----------------------------
+    def sync_stream(self, *arrays, timeout_s: Optional[float] = None) -> Status:
+        """Block until device results materialize; ABORT on timeout
+        (reference sync_stream polling + ncclCommGetAsyncError,
+        comms/detail/util.hpp:109-143). Anything exposing ``is_ready()``
+        is polled (duck-typed, like the reference polls any stream).
+        Readiness is checked before the deadline, so already-complete work
+        never reports a false ABORT."""
+        timeout_s = timeout_s if timeout_s is not None else self.abort_timeout_s
+        leaves = [l for l in jax.tree_util.tree_leaves(
+            arrays, is_leaf=lambda v: hasattr(v, "is_ready"))
+            if hasattr(l, "is_ready")]
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                if all(a.is_ready() for a in leaves):
+                    return Status.SUCCESS
+            except Exception:
+                return Status.ERROR
+            if time.monotonic() >= deadline:
+                return Status.ABORT
+            time.sleep(0.001)
+
+
+def build_comms(mesh: jax.sharding.Mesh, axis_name: str = "data",
+                abort_timeout_s: float = 60.0) -> Comms:
+    """Create a communicator over one mesh axis (the role of
+    build_comms_nccl_only, reference comms/helper.hpp:42)."""
+    expects(axis_name in mesh.axis_names,
+            "build_comms: axis %s not in mesh %s", axis_name, mesh.axis_names)
+    n = mesh.shape[axis_name]
+    return Comms(axis_name=axis_name, n_ranks=n,
+                 abort_timeout_s=abort_timeout_s)
+
+
+def inject_comms(res, comms: Comms) -> None:
+    """Attach to a Resources (reference inject_comms_on_handle,
+    raft-dask comms_utils.pyx:240)."""
+    res.set_comms(comms)

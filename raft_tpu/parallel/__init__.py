@@ -1,0 +1,17 @@
+"""Multi-node-multi-device algorithms over a Mesh (SURVEY.md §2.12/§5).
+
+The reference builds MNMG algorithms (in cuML/cuGraph) from RAFT pieces +
+``handle.get_comms()``; this package ships them in-framework: distributed
+brute-force k-NN (sharded DB + ring top-k merge), MNMG k-means (sharded
+data + psum'd centroid statistics), and sharded IVF search.
+"""
+
+from raft_tpu.parallel.mesh import make_mesh, shard_rows, replicate
+from raft_tpu.parallel.knn import distributed_knn
+from raft_tpu.parallel.kmeans import distributed_kmeans_fit, distributed_kmeans_step
+
+__all__ = [
+    "make_mesh", "shard_rows", "replicate",
+    "distributed_knn",
+    "distributed_kmeans_fit", "distributed_kmeans_step",
+]
