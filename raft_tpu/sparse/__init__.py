@@ -1,0 +1,56 @@
+"""Sparse stack (SURVEY.md §2.6, reference ``raft/sparse`` ~13.4k LoC).
+
+Containers (COO/CSR pytrees), format conversion, structural ops, linalg
+(segment-sum formulations), pairwise distances (densified-tile MXU path),
+sparse neighbors (brute-force kNN, kNN graph, connect_components), and
+solvers (Borůvka MST, Lanczos).
+"""
+
+from raft_tpu.sparse.coo import COO
+from raft_tpu.sparse.csr import CSR
+from raft_tpu.sparse.convert import (
+    adj_to_csr,
+    coo_to_csr,
+    coo_to_dense,
+    csr_to_coo,
+    csr_to_dense,
+    dense_to_coo,
+    dense_to_csr,
+)
+from raft_tpu.sparse.op import (
+    coo_reduce,
+    coo_remove_zeros,
+    coo_sort,
+    csr_row_op,
+    csr_slice_rows,
+)
+from raft_tpu.sparse.linalg import (
+    csr_add,
+    csr_transpose,
+    degree,
+    laplacian,
+    row_normalize,
+    spmm,
+    spmv,
+    symmetrize,
+)
+from raft_tpu.sparse.distance import pairwise_distance
+from raft_tpu.sparse.neighbors import (
+    brute_force_knn,
+    connect_components,
+    cross_component_nn,
+    knn_graph,
+)
+
+__all__ = [
+    "COO", "CSR",
+    "adj_to_csr", "coo_to_csr", "coo_to_dense", "csr_to_coo",
+    "csr_to_dense", "dense_to_coo", "dense_to_csr",
+    "coo_reduce", "coo_remove_zeros", "coo_sort", "csr_row_op",
+    "csr_slice_rows",
+    "csr_add", "csr_transpose", "degree", "laplacian", "row_normalize",
+    "spmm", "spmv", "symmetrize",
+    "pairwise_distance",
+    "brute_force_knn", "connect_components", "cross_component_nn",
+    "knn_graph",
+]

@@ -1,0 +1,80 @@
+"""Sparse format conversions.
+
+Reference: ``raft/sparse/convert/{coo,csr,dense}.cuh`` — coo↔csr↔dense and
+``adj_to_csr`` (boolean adjacency → CSR).
+
+Conversions that preserve nnz are pure jax and jit-safe; ``dense_to_*``
+change nnz and therefore run eagerly (static-shape rule, see coo.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.coo import COO
+from raft_tpu.sparse.csr import CSR
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """Sort by (row, col) and build indptr via a bincount cumsum.
+
+    Reference ``convert/csr.cuh`` (sorted_coo_to_csr). Jit-safe: nnz and
+    shape are static.
+    """
+    n_rows, n_cols = coo.shape
+    # lexsort avoids a linearized row*n_cols+col key (int32 overflow at scale)
+    order = jnp.lexsort((coo.cols, coo.rows))
+    rows = coo.rows[order]
+    counts = jnp.bincount(rows, length=n_rows)
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)]
+    )
+    return CSR(indptr, coo.cols[order], coo.vals[order], coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    return COO(csr.row_ids(), csr.indices, csr.data, csr.shape)
+
+
+def coo_to_dense(coo: COO) -> jax.Array:
+    return coo.todense()
+
+
+def csr_to_dense(csr: CSR) -> jax.Array:
+    return csr.todense()
+
+
+def dense_to_coo(x) -> COO:
+    """Eager (nnz is data-dependent)."""
+    x = np.asarray(x)
+    rows, cols = np.nonzero(x)
+    return COO(
+        jnp.asarray(rows, jnp.int32),
+        jnp.asarray(cols, jnp.int32),
+        jnp.asarray(x[rows, cols]),
+        x.shape,
+    )
+
+
+def dense_to_csr(x) -> CSR:
+    return coo_to_csr(dense_to_coo(x))
+
+
+def adj_to_csr(adj) -> CSR:
+    """Boolean adjacency matrix → CSR with unit weights.
+
+    Reference ``convert/csr.cuh`` adj_to_csr.
+    """
+    adj = np.asarray(adj)
+    rows, cols = np.nonzero(adj)
+    n_rows = adj.shape[0]
+    counts = np.bincount(rows, minlength=n_rows)
+    indptr = np.concatenate([[0], np.cumsum(counts)])
+    return CSR(
+        jnp.asarray(indptr, jnp.int32),
+        jnp.asarray(cols, jnp.int32),
+        jnp.ones(len(cols), jnp.float32),
+        adj.shape,
+    )

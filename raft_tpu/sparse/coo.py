@@ -1,0 +1,61 @@
+"""COO sparse container.
+
+Reference: ``raft::sparse::COO`` (``sparse/detail/coo.cuh:46``) — a device
+COO matrix with RMM-backed ``rows``/``cols``/``vals`` buffers and
+``setSize``/``allocate`` bookkeeping.
+
+TPU design: a frozen pytree of three ``jax.Array``s with a *static* nnz —
+XLA requires static shapes, so ops that change nnz (dedupe, filter) run
+eagerly and return a new container (the reference reallocates RMM buffers
+at the same points). Being a registered pytree, a ``COO`` passes through
+``jit``/``vmap``/``lax`` transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+@jax.tree_util.register_pytree_node_class
+class COO:
+    """Coordinate-format sparse matrix: (rows, cols, vals) + dense shape."""
+
+    def __init__(self, rows, cols, vals, shape: Tuple[int, int]):
+        self.rows = jnp.asarray(rows)
+        self.cols = jnp.asarray(cols)
+        self.vals = jnp.asarray(vals)
+        expects(
+            self.rows.shape == self.cols.shape == self.vals.shape,
+            "COO rows/cols/vals must have identical shape",
+        )
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        obj = cls.__new__(cls)
+        obj.rows, obj.cols, obj.vals = children
+        obj.shape = shape
+        return obj
+
+    def todense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, dtype=self.vals.dtype)
+        return out.at[self.rows, self.cols].add(self.vals)
+
+    def __repr__(self):
+        return f"COO(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"

@@ -1,0 +1,59 @@
+"""Sparse pairwise distances.
+
+Reference: ``raft/sparse/distance/distance.cuh:68-81`` — all dense metric
+families over CSR inputs via a load-balanced generalized COO SpMV with
+smem strategies (``detail/coo_spmv.cuh``), expanded metrics via sparse
+inner products.
+
+TPU design: the CUDA strategies exist to keep irregular per-row work
+balanced across warps. On TPU the winning move is the opposite —
+**densify row tiles and ride the MXU**: a (tile, k) dense block gathered
+from CSR costs one scatter per tile and turns every metric into the
+already-optimized dense kernel from ``raft_tpu.distance.pairwise``. For
+the feature dims RAFT targets (≤ a few thousand) this is strictly faster
+than any gather-based sparse walk on TPU; the tile size bounds peak
+memory exactly like the reference's batched smem staging.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import distance as dense_distance
+from raft_tpu.sparse.csr import CSR
+
+# peak densified scratch, in f32 elements (matches pairwise's budget scale)
+_TILE_BUDGET_ELEMS = 1 << 23
+
+
+def _densify(csr: CSR) -> jax.Array:
+    return csr.todense().astype(jnp.float32)
+
+
+def pairwise_distance(
+    x: CSR,
+    y: CSR,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+    res=None,
+) -> jax.Array:
+    """All-pairs distance between CSR row sets → dense (m, n) matrix."""
+    if x.shape[1] != y.shape[1]:
+        raise ValueError("sparse pairwise: feature dim mismatch")
+    metric = DistanceType(metric)
+    m, k = x.shape
+    n = y.shape[0]
+    yd = _densify(y)
+    tile = max(1, min(m, _TILE_BUDGET_ELEMS // max(1, k)))
+    if tile >= m:
+        return dense_distance(_densify(x), yd, metric, metric_arg)
+    outs = []
+    from raft_tpu.sparse.op import csr_slice_rows
+
+    for start in range(0, m, tile):
+        stop = min(start + tile, m)
+        xt = _densify(csr_slice_rows(x, start, stop))
+        outs.append(dense_distance(xt, yd, metric, metric_arg))
+    return jnp.concatenate(outs, axis=0)

@@ -1,0 +1,170 @@
+"""Sparse neighbors: CSR brute-force k-NN, kNN-graph builder,
+connect_components.
+
+Reference: ``raft/sparse/neighbors/{brute_force,knn_graph,
+connect_components}.cuh``. ``connect_components`` is the single-linkage
+fix-up: for every connected component of a kNN graph, find the minimum
+cross-component edge (the reference fuses this into a masked 1-NN pass
+with ``FixConnectivitiesRedOp``, ``connect_components.cuh:27,66``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors.brute_force import brute_force_knn as _dense_knn
+from raft_tpu.sparse.coo import COO
+from raft_tpu.sparse.csr import CSR
+from raft_tpu.sparse.distance import pairwise_distance as sparse_pairwise
+
+
+def brute_force_knn(
+    x: CSR,
+    queries: CSR,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+    batch_size: int = 4096,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """k-NN of sparse queries against a sparse database → (dists, idx).
+
+    Reference ``sparse/neighbors/brute_force.cuh`` tiles both inputs; here
+    the sparse pairwise (densified-tile) matrix per query batch feeds
+    XLA's top-k. Batching bounds the (batch, n) distance block.
+    """
+    metric = DistanceType(metric)
+    nq = queries.shape[0]
+    from raft_tpu.sparse.op import csr_slice_rows
+
+    dists_out, idx_out = [], []
+    for start in range(0, nq, batch_size):
+        stop = min(start + batch_size, nq)
+        qt = csr_slice_rows(queries, start, stop)
+        d = sparse_pairwise(qt, x, metric, metric_arg)
+        if metric == DistanceType.InnerProduct:
+            nd, ni = jax.lax.top_k(d, k)
+        else:
+            nd, ni = jax.lax.top_k(-d, k)
+            nd = -nd
+        dists_out.append(nd)
+        idx_out.append(ni)
+    return jnp.concatenate(dists_out), jnp.concatenate(idx_out)
+
+
+def knn_graph(
+    x,
+    k: int,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    res=None,
+) -> COO:
+    """Symmetric kNN graph of dense rows ``x`` as COO.
+
+    Reference ``sparse/neighbors/knn_graph.cuh`` (knn → COO → symmetrize).
+    Self-edges are dropped.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    dists, idx = _dense_knn(x, x, min(k + 1, n), metric, res=res)
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), idx.shape[1])
+    cols = idx.reshape(-1).astype(jnp.int32)
+    vals = dists.reshape(-1)
+    keep = np.asarray(rows != cols)
+    coo = COO(
+        jnp.asarray(np.asarray(rows)[keep]),
+        jnp.asarray(np.asarray(cols)[keep]),
+        jnp.asarray(np.asarray(vals)[keep]),
+        (n, n),
+    )
+    from raft_tpu.sparse.linalg import symmetrize
+
+    return symmetrize(coo, "max")
+
+
+def cross_component_nn(
+    x, labels, res=None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """For every point: nearest neighbor carrying a *different* label.
+
+    The masked fused-1-NN at the heart of the reference's
+    ``FixConnectivitiesRedOp`` (``connect_components.cuh:27``): L2 distance
+    with same-component pairs masked to +inf, arg-min per row. Tiled so the
+    (tile, n) block stays in budget.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    labels = jnp.asarray(labels)
+    n = x.shape[0]
+    sq = jnp.sum(x * x, axis=1)
+    tile = max(1, min(n, (1 << 22) // max(1, n)))
+    n_tiles = -(-n // tile)
+
+    def one_tile(start):
+        xt = jax.lax.dynamic_slice_in_dim(x, start, tile, 0)
+        lt = jax.lax.dynamic_slice_in_dim(labels, start, tile, 0)
+        sqt = jax.lax.dynamic_slice_in_dim(sq, start, tile, 0)
+        d = sqt[:, None] - 2.0 * (xt @ x.T) + sq[None, :]
+        same = lt[:, None] == labels[None, :]
+        d = jnp.where(same, jnp.inf, jnp.maximum(d, 0.0))
+        return jnp.min(d, axis=1), jnp.argmin(d, axis=1)
+
+    pad = n_tiles * tile - n
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        # padded rows get a sentinel label equal to their own so they mask
+        labels = jnp.pad(labels, (0, pad), constant_values=-1)
+        sq = jnp.pad(sq, (0, pad))
+    starts = jnp.arange(n_tiles) * tile
+    mins, argmins = jax.lax.map(one_tile, starts)
+    return (
+        mins.reshape(-1)[:n],
+        argmins.reshape(-1)[:n].astype(jnp.int32),
+        labels[:n],
+    )
+
+
+def connect_components(x, labels, res=None) -> COO:
+    """Minimum cross-component edges making the component graph connected.
+
+    Reference ``sparse/neighbors/connect_components.cuh:66``. Returns a
+    symmetric COO over points: for each component, its cheapest edge to
+    any other component (enough for Borůvka/MST to finish connecting).
+    Distances are squared L2 (reference convention).
+    """
+    dists, nn_idx, labels = cross_component_nn(x, labels, res)
+    dists_np = np.asarray(dists)
+    nn_np = np.asarray(nn_idx)
+    lab_np = np.asarray(labels)
+    uniq = np.unique(lab_np)
+    if len(uniq) <= 1:
+        n = len(lab_np)
+        return COO(
+            jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0,), jnp.float32),
+            (n, n),
+        )
+    src, dst, w = [], [], []
+    for c in uniq:
+        mask = lab_np == c
+        if not np.any(np.isfinite(dists_np[mask])):
+            continue
+        local = np.nonzero(mask)[0]
+        best = local[np.argmin(dists_np[mask])]
+        src.append(best)
+        dst.append(nn_np[best])
+        w.append(dists_np[best])
+    n = len(lab_np)
+    coo = COO(
+        jnp.asarray(src + dst, jnp.int32),
+        jnp.asarray(dst + src, jnp.int32),
+        jnp.asarray(w + w, jnp.float32),
+        (n, n),
+    )
+    from raft_tpu.sparse.op import coo_reduce
+
+    return coo_reduce(coo, "min")

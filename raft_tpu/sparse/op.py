@@ -1,0 +1,97 @@
+"""Sparse structural ops: sort, filter, dedupe-reduce, slice, row_op.
+
+Reference: ``raft/sparse/op/{filter,reduce,row_op,slice,sort}.cuh``.
+
+Ops that shrink nnz (``coo_remove_zeros``, ``coo_reduce`` compaction) run
+eagerly; ``coo_sort`` and ``csr_row_op`` are jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.coo import COO
+from raft_tpu.sparse.csr import CSR
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort entries by (row, col). Reference ``op/sort.cuh`` coo_sort."""
+    order = jnp.lexsort((coo.cols, coo.rows))
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order], coo.shape)
+
+
+def coo_remove_zeros(coo: COO, eps: float = 0.0) -> COO:
+    """Drop entries with |val| <= eps. Reference ``op/filter.cuh``
+    coo_remove_zeros/coo_remove_scalar. Eager."""
+    vals = np.asarray(coo.vals)
+    keep = np.abs(vals) > eps
+    return COO(
+        jnp.asarray(np.asarray(coo.rows)[keep]),
+        jnp.asarray(np.asarray(coo.cols)[keep]),
+        jnp.asarray(vals[keep]),
+        coo.shape,
+    )
+
+
+def coo_reduce(coo: COO, op: str = "sum") -> COO:
+    """Merge duplicate (row, col) entries with ``sum``/``max``/``min``.
+
+    Reference ``op/reduce.cuh`` max_duplicates. Eager (output nnz is
+    data-dependent); sorted output.
+    """
+    rows = np.asarray(coo.rows, np.int64)
+    cols = np.asarray(coo.cols, np.int64)
+    vals = np.asarray(coo.vals)
+    key = rows * coo.shape[1] + cols
+    order = np.argsort(key, kind="stable")
+    key, rows, cols, vals = key[order], rows[order], cols[order], vals[order]
+    uniq, inverse = np.unique(key, return_inverse=True)
+    if op == "sum":
+        out = np.zeros(len(uniq), vals.dtype)
+        np.add.at(out, inverse, vals)
+    elif op == "max":
+        out = np.full(len(uniq), -np.inf, vals.dtype)
+        np.maximum.at(out, inverse, vals)
+    elif op == "min":
+        out = np.full(len(uniq), np.inf, vals.dtype)
+        np.minimum.at(out, inverse, vals)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+    first = np.searchsorted(inverse, np.arange(len(uniq)))
+    return COO(
+        jnp.asarray(rows[first], jnp.int32),
+        jnp.asarray(cols[first], jnp.int32),
+        jnp.asarray(out),
+        coo.shape,
+    )
+
+
+def csr_slice_rows(csr: CSR, start: int, stop: int) -> CSR:
+    """Row-range slice. Reference ``op/slice.cuh`` csr_row_slice_*.
+
+    ``start``/``stop`` must be Python ints (static) — the result's nnz is
+    shape-determining. Eager.
+    """
+    indptr = np.asarray(csr.indptr)
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    return CSR(
+        jnp.asarray(indptr[start : stop + 1] - lo),
+        csr.indices[lo:hi],
+        csr.data[lo:hi],
+        (stop - start, csr.shape[1]),
+    )
+
+
+def csr_row_op(csr: CSR, fn: Callable[[jax.Array, jax.Array], jax.Array]) -> CSR:
+    """Apply ``fn(row_ids, data) -> new_data`` across nonzeros (jit-safe).
+
+    Reference ``op/row_op.cuh`` csr_row_op applies a lambda per row; the
+    segment-id formulation gives the lambda the row of every nonzero at
+    once, which is the vectorized equivalent.
+    """
+    new_data = fn(csr.row_ids(), csr.data)
+    return CSR(csr.indptr, csr.indices, new_data, csr.shape)

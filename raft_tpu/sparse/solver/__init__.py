@@ -1,5 +1,6 @@
 """Sparse solvers (SURVEY.md §2.6): MST and Lanczos."""
 
 from raft_tpu.sparse.solver.mst import mst, boruvka_mst_edges
+from raft_tpu.sparse.solver.lanczos import lanczos_largest, lanczos_smallest
 
-__all__ = ["mst", "boruvka_mst_edges"]
+__all__ = ["mst", "boruvka_mst_edges", "lanczos_largest", "lanczos_smallest"]

@@ -1,0 +1,210 @@
+"""Sparse stack tests: primitives vs scipy/naive-numpy references.
+
+Mirrors the reference's pattern (SURVEY.md §4): compute with the
+primitive, compare against a naive host implementation with tolerance.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import raft_tpu.sparse as sp
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import distance as dense_distance
+from raft_tpu.sparse.solver import lanczos_smallest, lanczos_largest
+
+
+def _random_sparse(rng, m, n, density=0.2):
+    x = rng.random((m, n)).astype(np.float32)
+    x[rng.random((m, n)) > density] = 0.0
+    return x
+
+
+class TestContainersConvert:
+    def test_roundtrip_dense_csr_coo(self, rng_np):
+        x = _random_sparse(rng_np, 17, 23)
+        csr = sp.dense_to_csr(x)
+        np.testing.assert_allclose(np.asarray(csr.todense()), x, rtol=1e-6)
+        coo = sp.csr_to_coo(csr)
+        np.testing.assert_allclose(np.asarray(coo.todense()), x, rtol=1e-6)
+        csr2 = sp.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(csr2.todense()), x, rtol=1e-6)
+
+    def test_adj_to_csr(self, rng_np):
+        adj = rng_np.random((9, 9)) > 0.6
+        csr = sp.adj_to_csr(adj)
+        np.testing.assert_array_equal(
+            np.asarray(csr.todense()) > 0, adj
+        )
+
+    def test_row_ids(self, rng_np):
+        x = _random_sparse(rng_np, 11, 7)
+        csr = sp.dense_to_csr(x)
+        rows_ref = np.nonzero(x)[0]
+        np.testing.assert_array_equal(np.asarray(csr.row_ids()), rows_ref)
+
+
+class TestOps:
+    def test_coo_sort_and_reduce(self, rng_np):
+        # duplicate entries must merge
+        rows = np.array([2, 0, 2, 1, 2], np.int32)
+        cols = np.array([1, 0, 1, 2, 0], np.int32)
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+        coo = sp.COO(rows, cols, vals, (3, 3))
+        red = sp.coo_reduce(coo, "sum")
+        dense = np.zeros((3, 3), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        np.testing.assert_allclose(np.asarray(red.todense()), dense)
+        assert red.nnz == 4
+
+    def test_remove_zeros(self):
+        coo = sp.COO([0, 1], [0, 1], [0.0, 3.0], (2, 2))
+        out = sp.coo_remove_zeros(coo)
+        assert out.nnz == 1
+        assert float(out.vals[0]) == 3.0
+
+    def test_slice_rows(self, rng_np):
+        x = _random_sparse(rng_np, 10, 6)
+        csr = sp.dense_to_csr(x)
+        sl = sp.csr_slice_rows(csr, 3, 8)
+        np.testing.assert_allclose(np.asarray(sl.todense()), x[3:8], rtol=1e-6)
+
+
+class TestLinalg:
+    def test_spmv_spmm(self, rng_np):
+        a = _random_sparse(rng_np, 13, 9)
+        csr = sp.dense_to_csr(a)
+        v = rng_np.random(9).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sp.spmv(csr, jnp.asarray(v))), a @ v, rtol=1e-5
+        )
+        m = rng_np.random((9, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sp.spmm(csr, jnp.asarray(m))), a @ m, rtol=1e-5
+        )
+
+    def test_add_transpose(self, rng_np):
+        a = _random_sparse(rng_np, 8, 8)
+        b = _random_sparse(rng_np, 8, 8)
+        ca, cb = sp.dense_to_csr(a), sp.dense_to_csr(b)
+        np.testing.assert_allclose(
+            np.asarray(sp.csr_add(ca, cb).todense()), a + b, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(sp.csr_transpose(ca).todense()), a.T, rtol=1e-6
+        )
+
+    def test_row_normalize(self, rng_np):
+        a = _random_sparse(rng_np, 10, 5)
+        csr = sp.dense_to_csr(a)
+        out = np.asarray(sp.row_normalize(csr, "l1").todense())
+        sums = np.abs(a).sum(1, keepdims=True)
+        expect = np.divide(a, sums, out=np.zeros_like(a), where=sums > 0)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_symmetrize(self, rng_np):
+        a = _random_sparse(rng_np, 7, 7)
+        coo = sp.dense_to_coo(a)
+        out = np.asarray(sp.symmetrize(coo, "max").todense())
+        np.testing.assert_allclose(out, np.maximum(a, a.T), rtol=1e-6)
+
+    def test_laplacian(self, rng_np):
+        a = _random_sparse(rng_np, 9, 9)
+        a = np.maximum(a, a.T)
+        np.fill_diagonal(a, 0.0)
+        csr = sp.dense_to_csr(a)
+        lap = np.asarray(sp.laplacian(csr).todense())
+        expect = np.diag(a.sum(1)) - a
+        np.testing.assert_allclose(lap, expect, atol=1e-5)
+        # normalized: eigenvalues in [0, 2]
+        ln = np.asarray(sp.laplacian(csr, normalized=True).todense())
+        w = np.linalg.eigvalsh(ln)
+        assert w.min() > -1e-4 and w.max() < 2 + 1e-4
+
+    def test_degree(self, rng_np):
+        a = _random_sparse(rng_np, 6, 8)
+        coo = sp.dense_to_coo(a)
+        np.testing.assert_allclose(
+            np.asarray(sp.degree(coo)), (a != 0).sum(1), rtol=1e-6
+        )
+
+
+class TestSparseDistance:
+    @pytest.mark.parametrize(
+        "metric",
+        [
+            DistanceType.L2Expanded,
+            DistanceType.L1,
+            DistanceType.CosineExpanded,
+            DistanceType.InnerProduct,
+            DistanceType.Linf,
+        ],
+    )
+    def test_vs_dense(self, rng_np, metric):
+        x = _random_sparse(rng_np, 33, 20)
+        y = _random_sparse(rng_np, 21, 20)
+        cx, cy = sp.dense_to_csr(x), sp.dense_to_csr(y)
+        got = np.asarray(sp.pairwise_distance(cx, cy, metric))
+        expect = np.asarray(dense_distance(x, y, metric))
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestSparseNeighbors:
+    def test_brute_force_knn(self, rng_np):
+        x = _random_sparse(rng_np, 50, 16, density=0.5)
+        q = _random_sparse(rng_np, 9, 16, density=0.5)
+        cx, cq = sp.dense_to_csr(x), sp.dense_to_csr(q)
+        d, i = sp.brute_force_knn(cx, cq, k=5)
+        # naive reference
+        full = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        ref_i = np.argsort(full, axis=1)[:, :5]
+        ref_d = np.take_along_axis(full, ref_i, 1)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(d), 1), np.sort(ref_d, 1), rtol=1e-3, atol=1e-4
+        )
+
+    def test_knn_graph_symmetric(self, rng_np):
+        x = rng_np.random((30, 5)).astype(np.float32)
+        g = sp.knn_graph(x, k=4)
+        dense = np.asarray(g.todense())
+        np.testing.assert_allclose(dense, dense.T, rtol=1e-6)
+        assert np.all(np.diag(dense) == 0)
+
+    def test_connect_components(self, rng_np):
+        # two well-separated blobs with distinct labels
+        a = rng_np.normal(0, 0.1, (10, 3)).astype(np.float32)
+        b = rng_np.normal(5, 0.1, (8, 3)).astype(np.float32)
+        x = np.vstack([a, b])
+        labels = np.array([0] * 10 + [1] * 8)
+        edges = sp.connect_components(x, labels)
+        assert edges.nnz >= 2  # at least one edge each direction
+        src = np.asarray(edges.rows)
+        dst = np.asarray(edges.cols)
+        assert np.all(labels[src] != labels[dst])
+
+
+class TestLanczos:
+    def test_smallest_largest(self, rng_np):
+        n = 40
+        a = _random_sparse(rng_np, n, n, density=0.3)
+        a = (a + a.T) / 2
+        csr = sp.dense_to_csr(a)
+        w_all = np.linalg.eigvalsh(a)
+        w_small, v_small = lanczos_smallest(csr, 3)
+        np.testing.assert_allclose(np.asarray(w_small), w_all[:3], atol=2e-3)
+        # eigenvector residual ||Av - λv||
+        for j in range(3):
+            v = np.asarray(v_small[:, j])
+            resid = np.linalg.norm(a @ v - float(w_small[j]) * v)
+            assert resid < 5e-3
+        w_large, _ = lanczos_largest(csr, 2)
+        np.testing.assert_allclose(
+            np.asarray(w_large), w_all[::-1][:2], atol=2e-3
+        )
+
+    def test_implicit_matvec(self, rng_np):
+        n = 25
+        d = np.arange(1, n + 1, dtype=np.float32)
+        mv = lambda v: jnp.asarray(d) * v  # noqa: E731
+        w, _ = lanczos_smallest(None, 2, matvec=mv, n=n)
+        np.testing.assert_allclose(np.asarray(w), [1.0, 2.0], atol=1e-3)
