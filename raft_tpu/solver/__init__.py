@@ -1,0 +1,8 @@
+"""Solvers (SURVEY.md §2.9, reference ``raft/solver``)."""
+
+from raft_tpu.solver.linear_assignment import (
+    LinearAssignmentProblem,
+    linear_assignment,
+)
+
+__all__ = ["LinearAssignmentProblem", "linear_assignment"]

@@ -40,6 +40,8 @@ def spmm(csr: CSR, x: jax.Array) -> jax.Array:
 def csr_add(a: CSR, b: CSR) -> CSR:
     """C = A + B with duplicate merging. Reference ``linalg/add.cuh``
     (csr_add_calc_inds/csr_add_finalize). Eager (result nnz data-dependent)."""
+    if a.shape != b.shape:
+        raise ValueError(f"csr_add: shape mismatch {a.shape} vs {b.shape}")
     ca, cb = csr_to_coo(a), csr_to_coo(b)
     merged = COO(
         jnp.concatenate([ca.rows, cb.rows]),

@@ -109,13 +109,14 @@ def cross_component_nn(
         sqt = jax.lax.dynamic_slice_in_dim(sq, start, tile, 0)
         d = sqt[:, None] - 2.0 * (xt @ x.T) + sq[None, :]
         same = lt[:, None] == labels[None, :]
-        d = jnp.where(same, jnp.inf, jnp.maximum(d, 0.0))
+        # mask same-component pairs AND padded candidate columns
+        col_pad = jnp.arange(x.shape[0]) >= n
+        d = jnp.where(same | col_pad[None, :], jnp.inf, jnp.maximum(d, 0.0))
         return jnp.min(d, axis=1), jnp.argmin(d, axis=1)
 
     pad = n_tiles * tile - n
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
-        # padded rows get a sentinel label equal to their own so they mask
         labels = jnp.pad(labels, (0, pad), constant_values=-1)
         sq = jnp.pad(sq, (0, pad))
     starts = jnp.arange(n_tiles) * tile

@@ -50,14 +50,18 @@ def coo_reduce(coo: COO, op: str = "sum") -> COO:
     order = np.argsort(key, kind="stable")
     key, rows, cols, vals = key[order], rows[order], cols[order], vals[order]
     uniq, inverse = np.unique(key, return_inverse=True)
+    if np.issubdtype(vals.dtype, np.integer):
+        lo, hi = np.iinfo(vals.dtype).min, np.iinfo(vals.dtype).max
+    else:
+        lo, hi = -np.inf, np.inf
     if op == "sum":
         out = np.zeros(len(uniq), vals.dtype)
         np.add.at(out, inverse, vals)
     elif op == "max":
-        out = np.full(len(uniq), -np.inf, vals.dtype)
+        out = np.full(len(uniq), lo, vals.dtype)
         np.maximum.at(out, inverse, vals)
     elif op == "min":
-        out = np.full(len(uniq), np.inf, vals.dtype)
+        out = np.full(len(uniq), hi, vals.dtype)
         np.minimum.at(out, inverse, vals)
     else:
         raise ValueError(f"unknown reduce op {op!r}")

@@ -31,34 +31,51 @@ def _lanczos_basis(
     n: int,
     m: int,
     v0: jax.Array,
+    restart_pool: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """m-step Lanczos with full reorthogonalization.
 
-    Returns (V (m, n), alpha (m,), beta (m-1,)).
+    Returns (V (m, n), alpha (m,), beta (m-1,)). On breakdown (β≈0, the
+    Krylov space is exhausted) the recurrence restarts from a fresh random
+    vector from ``restart_pool`` orthogonalized against the basis, storing
+    β=0 so T stays block-tridiagonal with valid Ritz values — the
+    reference handles the same case by restarting the whole iteration
+    (linalg/detail/lanczos.cuh).
     """
     v0 = v0 / jnp.linalg.norm(v0)
+    _BREAKDOWN = 1e-6
 
-    def step(carry, _):
+    def orthogonalize(V, w):
+        # two passes of classical Gram-Schmidt ≈ modified GS numerically;
+        # rows of V not yet filled are zero, so no masking is needed
+        for _pass in range(2):
+            w = w - V.T @ (V @ w)
+        return w
+
+    def step(carry, r):
         V, v_prev, v, beta_prev, i = carry
         w = matvec(v)
         alpha = jnp.dot(w, v)
         w = w - alpha * v - beta_prev * v_prev
-        # full reorthogonalization against the basis built so far (two
-        # passes of classical Gram-Schmidt ≈ modified GS numerically)
-        for _pass in range(2):
-            mask = (jnp.arange(m) < i)[:, None]
-            coeffs = (V * mask) @ w
-            w = w - ((V * mask).T @ coeffs)
+        w = orthogonalize(V, w)
         beta = jnp.linalg.norm(w)
-        v_next = jnp.where(beta > 1e-12, w / jnp.where(beta > 0, beta, 1.0),
-                           jnp.zeros_like(w))
-        V = V.at[i].set(v)
-        return (V, v, v_next, beta, i + 1), (alpha, beta)
+        V_next = V.at[i].set(v)
+        # breakdown → continue from a random direction ⟂ basis, β := 0
+        r_orth = orthogonalize(V_next, r)
+        r_norm = jnp.linalg.norm(r_orth)
+        broke = beta <= _BREAKDOWN
+        v_next = jnp.where(
+            broke,
+            r_orth / jnp.where(r_norm > 0, r_norm, 1.0),
+            w / jnp.where(beta > 0, beta, 1.0),
+        )
+        beta_out = jnp.where(broke, 0.0, beta)
+        return (V_next, v, v_next, beta_out, i + 1), (alpha, beta_out)
 
     V0 = jnp.zeros((m, n), v0.dtype)
     init = (V0, jnp.zeros_like(v0), v0, jnp.asarray(0.0, v0.dtype), 0)
     (V, _, _, _, _), (alphas, betas) = jax.lax.scan(
-        step, init, None, length=m
+        step, init, restart_pool, length=m
     )
     return V, alphas, betas[:-1]
 
@@ -101,8 +118,10 @@ def lanczos_smallest(
     m = min(n - 1 if n > 1 else 1, max_iter or max(4 * k + 16, 32))
     m = max(m, k + 1)
     key = jax.random.key(seed)
-    v0 = jax.random.normal(key, (n,), dtype=jnp.float32)
-    V, alphas, betas = _lanczos_basis(matvec, n, m, v0)
+    k0, k1 = jax.random.split(key)
+    v0 = jax.random.normal(k0, (n,), dtype=jnp.float32)
+    pool = jax.random.normal(k1, (m, n), dtype=jnp.float32)
+    V, alphas, betas = _lanczos_basis(matvec, n, m, v0, pool)
     return _eig_from_lanczos(V, alphas, betas, k, largest=False)
 
 
@@ -123,6 +142,8 @@ def lanczos_largest(
     m = min(n - 1 if n > 1 else 1, max_iter or max(4 * k + 16, 32))
     m = max(m, k + 1)
     key = jax.random.key(seed)
-    v0 = jax.random.normal(key, (n,), dtype=jnp.float32)
-    V, alphas, betas = _lanczos_basis(matvec, n, m, v0)
+    k0, k1 = jax.random.split(key)
+    v0 = jax.random.normal(k0, (n,), dtype=jnp.float32)
+    pool = jax.random.normal(k1, (m, n), dtype=jnp.float32)
+    V, alphas, betas = _lanczos_basis(matvec, n, m, v0, pool)
     return _eig_from_lanczos(V, alphas, betas, k, largest=True)

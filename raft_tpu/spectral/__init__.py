@@ -1,0 +1,21 @@
+"""Spectral methods (SURVEY.md §2.9, reference ``raft/spectral``)."""
+
+from raft_tpu.spectral.eigen_solvers import (
+    ClusterSolverConfig,
+    EigenSolverConfig,
+    KMeansSolver,
+    LanczosSolver,
+)
+from raft_tpu.spectral.partition import (
+    analyze_modularity,
+    analyze_partition,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = [
+    "ClusterSolverConfig", "EigenSolverConfig", "KMeansSolver",
+    "LanczosSolver",
+    "analyze_modularity", "analyze_partition", "modularity_maximization",
+    "partition",
+]

@@ -1,0 +1,90 @@
+"""Pluggable eigen / cluster solvers for spectral methods.
+
+Reference: ``raft/spectral/eigen_solvers.cuh`` (``lanczos_solver_t`` with
+``eigen_solver_config_t``) and ``raft/spectral/cluster_solvers.cuh``
+(``kmeans_solver_t`` with ``cluster_solver_config_t``). Same pattern:
+small config dataclasses + callable solver objects, so `partition` /
+`modularity_maximization` can swap strategies.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+
+from raft_tpu.cluster.kmeans import fit_predict
+from raft_tpu.cluster.kmeans_types import KMeansParams
+from raft_tpu.sparse.csr import CSR
+from raft_tpu.sparse.solver.lanczos import lanczos_largest, lanczos_smallest
+
+
+@dataclass
+class EigenSolverConfig:
+    """Mirrors ``eigen_solver_config_t`` (spectral/eigen_solvers.cuh:25)."""
+
+    n_eigVecs: int
+    maxIter: int = 0  # 0 → auto (4k+16)
+    restartIter: int = 0  # unused: full-reorth Lanczos doesn't restart
+    tol: float = 1e-4
+    reorthogonalize: bool = True
+    seed: int = 1234567
+
+
+class LanczosSolver:
+    """Mirrors ``lanczos_solver_t`` — smallest/largest eigenpairs of a CSR."""
+
+    def __init__(self, config: EigenSolverConfig):
+        self.config = config
+
+    def solve_smallest_eigenvectors(
+        self, a: CSR
+    ) -> Tuple[jax.Array, jax.Array]:
+        return lanczos_smallest(
+            a,
+            self.config.n_eigVecs,
+            max_iter=self.config.maxIter or None,
+            seed=self.config.seed,
+        )
+
+    def solve_largest_eigenvectors(
+        self, a: CSR
+    ) -> Tuple[jax.Array, jax.Array]:
+        return lanczos_largest(
+            a,
+            self.config.n_eigVecs,
+            max_iter=self.config.maxIter or None,
+            seed=self.config.seed,
+        )
+
+
+@dataclass
+class ClusterSolverConfig:
+    """Mirrors ``cluster_solver_config_t`` (spectral/cluster_solvers.cuh:25)."""
+
+    n_clusters: int
+    maxIter: int = 100
+    tol: float = 1e-4
+    seed: int = 123456
+
+
+class KMeansSolver:
+    """Mirrors ``kmeans_solver_t`` — cluster rows of the embedding."""
+
+    def __init__(self, config: ClusterSolverConfig):
+        self.config = config
+
+    def solve(self, embedding: jax.Array, res=None
+              ) -> Tuple[jax.Array, jax.Array]:
+        """→ (labels, inertia)."""
+        params = KMeansParams(
+            n_clusters=self.config.n_clusters,
+            max_iter=self.config.maxIter,
+            tol=self.config.tol,
+            seed=self.config.seed,
+        )
+        labels, _centroids, inertia, _ = fit_predict(
+            embedding, params, res=res
+        )
+        return labels, inertia

@@ -63,6 +63,11 @@ class TestOps:
         assert out.nnz == 1
         assert float(out.vals[0]) == 3.0
 
+    def test_reduce_int_min(self):
+        coo = sp.COO([0, 0], [1, 1], np.array([7, 3], np.int32), (2, 2))
+        red = sp.coo_reduce(coo, "min")
+        assert int(red.vals[0]) == 3
+
     def test_slice_rows(self, rng_np):
         x = _random_sparse(rng_np, 10, 6)
         csr = sp.dense_to_csr(x)
@@ -201,6 +206,14 @@ class TestLanczos:
         np.testing.assert_allclose(
             np.asarray(w_large), w_all[::-1][:2], atol=2e-3
         )
+
+    def test_breakdown_identity(self):
+        # Krylov space of I is exhausted after one step: breakdown must
+        # restart, not pad T with spurious zero eigenvalues
+        n = 12
+        eye = sp.dense_to_csr(np.eye(n, dtype=np.float32))
+        w, _ = lanczos_smallest(eye, 3)
+        np.testing.assert_allclose(np.asarray(w), np.ones(3), atol=1e-4)
 
     def test_implicit_matvec(self, rng_np):
         n = 25

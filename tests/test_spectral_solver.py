@@ -1,0 +1,116 @@
+"""Spectral partition / modularity and LAP solver tests."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import raft_tpu.sparse as sp
+from raft_tpu.solver import LinearAssignmentProblem, linear_assignment
+from raft_tpu.spectral import (
+    analyze_modularity,
+    analyze_partition,
+    modularity_maximization,
+    partition,
+)
+
+
+def _two_cliques(rng, n_per=12, p_in=0.9, p_out=0.05):
+    """Planted-partition graph with two dense communities."""
+    n = 2 * n_per
+    truth = np.array([0] * n_per + [1] * n_per)
+    a = np.zeros((n, n), np.float32)
+    for i in range(n):
+        for j in range(i + 1, n):
+            p = p_in if truth[i] == truth[j] else p_out
+            if rng.random() < p:
+                a[i, j] = a[j, i] = 1.0
+    # ensure connectivity
+    a[n_per - 1, n_per] = a[n_per, n_per - 1] = 1.0
+    return a, truth
+
+
+def _agree(labels, truth):
+    labels = np.asarray(labels)
+    same = np.mean(labels == truth)
+    return max(same, 1.0 - same)
+
+
+class TestSpectral:
+    def test_partition_two_communities(self, rng_np):
+        a, truth = _two_cliques(rng_np)
+        csr = sp.dense_to_csr(a)
+        labels, evals, evecs = partition(csr, 2)
+        assert _agree(labels, truth) > 0.9
+        assert evecs.shape == (a.shape[0], 2)
+        # smallest normalized-Laplacian eigenvalue ≈ 0
+        assert abs(float(evals[0])) < 1e-3
+
+    def test_analyze_partition(self, rng_np):
+        a, truth = _two_cliques(rng_np)
+        csr = sp.dense_to_csr(a)
+        cut_true, _ = analyze_partition(csr, jnp.asarray(truth), 2)
+        rand = rng_np.integers(0, 2, len(truth))
+        cut_rand, _ = analyze_partition(csr, jnp.asarray(rand), 2)
+        # the planted partition cuts far fewer edges than a random split
+        assert float(cut_true) < float(cut_rand)
+        # edge_cut of the planted split = # cross-community edges
+        cross = sum(
+            a[i, j]
+            for i in range(len(truth))
+            for j in range(i + 1, len(truth))
+            if truth[i] != truth[j]
+        )
+        np.testing.assert_allclose(float(cut_true), cross, rtol=1e-4)
+
+    def test_modularity_maximization(self, rng_np):
+        a, truth = _two_cliques(rng_np)
+        csr = sp.dense_to_csr(a)
+        labels, _, _ = modularity_maximization(csr, 2)
+        assert _agree(labels, truth) > 0.9
+        q_good = float(analyze_modularity(csr, jnp.asarray(truth), 2))
+        q_rand = float(
+            analyze_modularity(
+                csr, jnp.asarray(rng_np.integers(0, 2, len(truth))), 2
+            )
+        )
+        assert q_good > q_rand
+        assert 0.2 < q_good <= 1.0
+
+
+class TestLAP:
+    @pytest.mark.parametrize("n", [4, 16, 48])
+    def test_vs_scipy(self, rng_np, n):
+        from scipy.optimize import linear_sum_assignment
+
+        cost = rng_np.random((n, n)).astype(np.float32)
+        row_assign, col_assign, obj = linear_assignment(cost)
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        # auction with ε-scaling reaches the optimum within scaling tolerance
+        np.testing.assert_allclose(float(obj), opt, rtol=1e-3, atol=1e-3)
+        # valid permutation
+        assert sorted(np.asarray(row_assign).tolist()) == list(range(n))
+        np.testing.assert_array_equal(
+            np.asarray(col_assign)[np.asarray(row_assign)], np.arange(n)
+        )
+
+    def test_maximize(self, rng_np):
+        from scipy.optimize import linear_sum_assignment
+
+        cost = rng_np.random((12, 12)).astype(np.float32)
+        _, _, obj = linear_assignment(cost, maximize=True)
+        ri, ci = linear_sum_assignment(cost, maximize=True)
+        np.testing.assert_allclose(
+            float(obj), cost[ri, ci].sum(), rtol=1e-3, atol=1e-3
+        )
+
+    def test_class_api(self, rng_np):
+        n = 8
+        cost = rng_np.random((n, n)).astype(np.float32)
+        lap = LinearAssignmentProblem(n)
+        obj = lap.solve(cost)
+        assert float(obj) == pytest.approx(
+            float(lap.get_primal_objective_value())
+        )
+        ra = np.asarray(lap.get_row_assignment_vector())
+        assert sorted(ra.tolist()) == list(range(n))
