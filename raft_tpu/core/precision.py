@@ -1,0 +1,50 @@
+"""Matmul precision policy for distance-critical MXU ops.
+
+The reference computes every distance in true fp32 FMAs (CUDA cores /
+cuBLAS default). On TPU, f32 ``dot_general`` defaults to bf16 MXU passes
+(~5e-4 relative error), which is catastrophic for *expanded* forms like
+``||x||² + ||y||² − 2x·y`` on large-norm data — the cancellation
+amplifies the matmul error far beyond f32 eps. All expanded-distance
+matmuls in this framework therefore default to
+``lax.Precision.HIGHEST`` (≈3e-7 relative error, modest MXU cost),
+matching the reference's accuracy contract.
+
+Override with ``RAFT_TPU_MATMUL_PRECISION`` = ``highest`` (default) |
+``high`` (bf16x3) | ``default`` (fastest, bf16) — the knob to trade
+exactness for throughput on workloads that tolerate it (the role of the
+reference's fp16/fp8 LUT dtypes in IVF-PQ, ``ivf_pq_types.hpp:87``).
+The variable is read ONCE, at first use: precision is baked into traced
+programs at compile time and jit caches don't key on it, so changing the
+environment mid-process would silently not apply — set it before the
+first distance call (normally: before starting Python).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from jax import lax
+
+_TABLE = {
+    "highest": lax.Precision.HIGHEST,
+    "high": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+}
+
+_resolved: Optional[lax.Precision] = None
+
+
+def matmul_precision() -> lax.Precision:
+    """The precision for distance-critical f32 matmuls (read-once)."""
+    global _resolved
+    if _resolved is None:
+        name = os.environ.get("RAFT_TPU_MATMUL_PRECISION",
+                              "highest").lower()
+        try:
+            _resolved = _TABLE[name]
+        except KeyError:
+            raise ValueError(
+                f"RAFT_TPU_MATMUL_PRECISION={name!r}: "
+                "want highest|high|default") from None
+    return _resolved

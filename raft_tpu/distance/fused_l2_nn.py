@@ -24,6 +24,7 @@ from jax import lax
 from raft_tpu.core.error import expects
 from raft_tpu.core.kvp import KeyValuePair
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
 
 # column-tile budget: tile_n such that m * tile_n stays bounded
 _TILE_ELEMS = 1 << 22  # 16 MiB f32 block
@@ -58,7 +59,9 @@ def _fused_l2_nn(x, y, sqrt: bool):
         yt, yyt, off = inp
         # (m, tile_n) block of expanded L2
         d = xx[:, None] + yyt[None, :] - 2.0 * lax.dot_general(
-            xf, yt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            xf, yt, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=matmul_precision())
         d = jnp.maximum(d, 0.0)
         col = jnp.arange(tile_n, dtype=jnp.int32)[None, :] + off
         valid = col < n
@@ -83,11 +86,18 @@ def fused_l2_nn(x, y, sqrt: bool = False, res=None) -> KeyValuePair:
     ``y`` under (squared) L2. Returns a :class:`KeyValuePair` of arrays
     ``(key: int32 (m,), value: float32 (m,))`` — the structural analogue of
     the reference's ``KeyValuePair<IdxT, DataT>`` output
-    (``fused_l2_nn.cuh:89``)."""
+    (``fused_l2_nn.cuh:89``). Routes to the Pallas kernel
+    (:mod:`raft_tpu.ops.pallas_fused_l2_nn`) on TPU backends."""
     x, y = as_array(x), as_array(y)
     expects(x.ndim == 2 and y.ndim == 2, "fused_l2_nn: inputs must be rank-2")
     expects(x.shape[1] == y.shape[1], "fused_l2_nn: dim mismatch")
-    idx, d = _fused_l2_nn(x, y, bool(sqrt))
+    from raft_tpu.ops.dispatch import pallas_enabled
+    if (pallas_enabled() and x.shape[1] <= 4096
+            and x.shape[0] > 0 and y.shape[0] > 0):
+        from raft_tpu.ops.pallas_fused_l2_nn import fused_l2_nn_pallas
+        idx, d = fused_l2_nn_pallas(x, y, sqrt=bool(sqrt))
+    else:
+        idx, d = _fused_l2_nn(x, y, bool(sqrt))
     return KeyValuePair(idx, d)
 
 

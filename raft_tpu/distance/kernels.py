@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
 
 
 class KernelType(enum.IntEnum):
@@ -43,7 +44,8 @@ class KernelParams:
 
 def _dot(x, y):
     return lax.dot_general(x, y, (((1,), (1,)), ((), ())),
-                           preferred_element_type=jnp.float32)
+                           preferred_element_type=jnp.float32,
+                           precision=matmul_precision())
 
 
 # gamma/coef0 are traced scalars: hyperparameter sweeps reuse one

@@ -38,6 +38,7 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
 from raft_tpu.distance.distance_types import (
     DISTANCE_TYPES,
     SUPPORTED_DISTANCES,
@@ -56,7 +57,8 @@ def _f32(x: jax.Array) -> jax.Array:
 def _dot(x: jax.Array, y: jax.Array) -> jax.Array:
     """x @ y.T with fp32 accumulation on the MXU."""
     return lax.dot_general(
-        x, y, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        x, y, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=matmul_precision(),
     )
 
 

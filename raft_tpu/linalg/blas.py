@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
 
 
 def gemm(a, b, alpha: float = 1.0, beta: float = 0.0, c=None,
@@ -23,7 +24,8 @@ def gemm(a, b, alpha: float = 1.0, beta: float = 0.0, c=None,
     if trans_b:
         b = b.T
     out = lax.dot_general(a, b, (((1,), (0,)), ((), ())),
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+                          precision=matmul_precision())
     out = alpha * out
     if c is not None and beta != 0.0:
         out = out + beta * as_array(c)

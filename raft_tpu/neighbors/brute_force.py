@@ -75,22 +75,50 @@ def _knn_scan(queries, db, k: int, metric: DistanceType, metric_arg: float,
     return sign * d, i
 
 
+# Only expanded-form L2 (what the fused kernel computes) and IP route to
+# Pallas; unexpanded L2 is excluded on purpose — a caller choosing it is
+# asking for the cancellation-free formulation, which the fused kernel
+# does not provide.
+_PALLAS_METRICS = {
+    DistanceType.L2Expanded: ("l2", False),
+    DistanceType.L2SqrtExpanded: ("l2", True),
+    DistanceType.InnerProduct: ("ip", False),
+}
+
+
 def brute_force_knn(
     db,
     queries,
     k: int,
     metric: DistanceType = DistanceType.L2SqrtExpanded,
     metric_arg: float = 2.0,
+    mode: str = "auto",
     res=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN of ``queries`` against ``db`` → (dists, indices), both
     (n_queries, k). Any :class:`DistanceType` (larger-is-better metrics
     like plain InnerProduct select max via distance negation, matching the
-    reference's treatment of IP in FAISS)."""
+    reference's treatment of IP in FAISS).
+
+    ``mode``: ``"auto"``/``"exact"`` run the exact XLA scan; ``"fused"``
+    routes to the Pallas fused kernel (L2/IP only, binned partial top-k —
+    the TPU-KNN recall/throughput tradeoff, near-exact at default bin
+    width). The fused kernel is the TPU analogue of the reference's
+    k ≤ 64 fusedL2Knn fast path (``knn_brute_force_faiss.cuh:281``); it
+    is opt-in here because its selection is approximate."""
     db, queries = as_array(db), as_array(queries)
     expects(db.shape[1] == queries.shape[1], "knn: dim mismatch")
     expects(k <= db.shape[0], "knn: k > database size")
+    expects(mode in ("auto", "exact", "fused"),
+            f"knn: unknown mode {mode!r} (auto|exact|fused)")
     metric = DistanceType(metric)
+    pal = _PALLAS_METRICS.get(metric)
+    if mode == "fused":
+        expects(pal is not None,
+                f"fused knn supports L2/IP metrics only, got {metric}")
+        from raft_tpu.ops.pallas_fused_knn import fused_knn_pallas
+        m_name, sq = pal
+        return fused_knn_pallas(queries, db, k, metric=m_name, sqrt=sq)
     tile = _db_tile(queries.shape[0], db.shape[0])
     # InnerProduct is a similarity: select the k LARGEST (the reference
     # routes IP through FAISS's max-heap select)

@@ -34,6 +34,7 @@ from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import _l2_expanded
 from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core.precision import matmul_precision
 
 
 @dataclass
@@ -177,7 +178,8 @@ def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
         norms = lists_norms[list_id]                # (nq, max_list)
         ids = lists_indices[list_id]                # (nq, max_list)
         ip = jnp.einsum("qd,qld->ql", queries, data,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=matmul_precision())
         d = qq[:, None] + norms - 2.0 * ip
         d = jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf)
         cat_d = jnp.concatenate([best_d, d], axis=1)

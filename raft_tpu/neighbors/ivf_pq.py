@@ -38,6 +38,7 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import _l2_expanded
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.neighbors.ivf_flat import _bucketize
+from raft_tpu.core.precision import matmul_precision
 
 
 class CodebookGen(enum.IntEnum):
@@ -140,7 +141,8 @@ def _encode(residuals_rot, pq_centers):
         # (n, pq_len) vs (n_codes, pq_len)
         vv = jnp.sum(vecs * vecs, axis=1)
         bb = jnp.sum(book * book, axis=1)
-        d = vv[:, None] + bb[None, :] - 2.0 * vecs @ book.T
+        d = (vv[:, None] + bb[None, :]
+             - 2.0 * jnp.matmul(vecs, book.T, precision=matmul_precision()))
         return jnp.argmin(d, axis=1).astype(jnp.uint8)
 
     return jax.vmap(per_subspace, in_axes=(1, 0), out_axes=1)(sub, pq_centers)
@@ -179,10 +181,11 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
 
     rot = make_rotation_matrix(dim, rot_dim, params.force_random_rotation,
                                seed=seed + 1)
-    centers_rot = centers @ rot.T
+    centers_rot = jnp.matmul(centers, rot.T, precision=matmul_precision())
 
     residuals = x - centers[labels]
-    residuals_rot = residuals @ rot.T
+    residuals_rot = jnp.matmul(residuals, rot.T,
+                               precision=matmul_precision())
 
     n_cb_train = min(n, 1 << 16)
     if n_cb_train < n:
@@ -231,7 +234,8 @@ def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
         sub = resid.reshape(nq, pq_dim, pq_len)
         # LUT[q, s, j] = ||sub(q,s) - pq_centers[s, j]||²
         ip = jnp.einsum("qsl,sjl->qsj", sub, pq_centers,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=matmul_precision())
         ss = jnp.sum(sub * sub, axis=2)
         lut = ss[:, :, None] + bb[None, :, :] - 2.0 * ip  # (nq, pq_dim, n_codes)
 

@@ -16,6 +16,7 @@ from jax import lax
 
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.core.precision import matmul_precision
 
 
 def refine(dataset, queries, candidates, k: int,
@@ -30,7 +31,8 @@ def refine(dataset, queries, candidates, k: int,
     vecs = x[jnp.clip(cand, 0, x.shape[0] - 1)]       # (nq, n_cand, dim)
     qq = jnp.sum(q * q, axis=1)
     vv = jnp.sum(vecs * vecs, axis=2)
-    ip = jnp.einsum("qd,qcd->qc", q, vecs, preferred_element_type=jnp.float32)
+    ip = jnp.einsum("qd,qcd->qc", q, vecs, preferred_element_type=jnp.float32,
+                    precision=matmul_precision())
     d = jnp.maximum(qq[:, None] + vv - 2.0 * ip, 0.0)
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         d = jnp.sqrt(d)

@@ -1,0 +1,32 @@
+"""Pallas kernel tier (SURVEY.md §7 step 3).
+
+This package holds the hand-written TPU kernels that back the hot paths of
+the XLA-first primitive layer — the TPU analogue of the reference's fused
+CUDA kernels:
+
+* :mod:`raft_tpu.ops.pallas_fused_l2_nn` — fused L2 + argmin epilogue
+  (reference ``distance/detail/fused_l2_nn.cuh:132``).
+* :mod:`raft_tpu.ops.pallas_fused_knn` — fused distance + in-kernel top-k
+  (reference ``spatial/knn/detail/fused_l2_knn.cuh:196``), using the
+  binned partial-top-k trick of TPU-KNN (PAPERS.md).
+
+Every kernel has an XLA reference formulation in the primitive layer; the
+public APIs dispatch between them via :mod:`raft_tpu.ops.dispatch`. A
+kernel only lands here if it beats the XLA tier on the bench suite.
+"""
+
+from raft_tpu.ops.dispatch import (
+    pallas_available,
+    pallas_enabled,
+    pallas_interpret,
+)
+from raft_tpu.ops.pallas_fused_l2_nn import fused_l2_nn_pallas
+from raft_tpu.ops.pallas_fused_knn import fused_knn_pallas
+
+__all__ = [
+    "pallas_available",
+    "pallas_enabled",
+    "pallas_interpret",
+    "fused_l2_nn_pallas",
+    "fused_knn_pallas",
+]

@@ -1,0 +1,13 @@
+"""Shared helpers for the Pallas kernel tier."""
+
+from __future__ import annotations
+
+# Sentinel for argmin-of-masked reductions (plain int: no backend init at
+# import). Any masked lane gets this index; real indices are < 2**30.
+BIG_I32 = 2**30
+
+
+def round_up(v: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``v`` (the Pow2 round-up of
+    reference ``util/pow2_utils.cuh:29``, for arbitrary moduli)."""
+    return -(-v // m) * m

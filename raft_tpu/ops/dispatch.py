@@ -1,0 +1,52 @@
+"""Kernel-tier dispatch: when to route a primitive to its Pallas kernel.
+
+The reference makes the equivalent choice at CMake/template-instantiation
+time (precompiled specializations vs header-only paths,
+``cpp/CMakeLists.txt:236-406``); here it is a runtime decision per call:
+
+* on a TPU backend the Pallas kernels compile natively (Mosaic);
+* elsewhere (the CPU test mesh) they can still run under the Pallas
+  interpreter for correctness tests, but are off by default because the
+  XLA formulation is faster on CPU.
+
+``RAFT_TPU_PALLAS`` overrides: ``never`` | ``auto`` (default) |
+``always`` (use Pallas even off-TPU, interpreted off-TPU — what the unit
+tests set).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def _mode() -> str:
+    return os.environ.get("RAFT_TPU_PALLAS", "auto").lower()
+
+
+def pallas_available() -> bool:
+    """True when the Pallas TPU lowering path exists for this process."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover - pallas ships with jax
+        return False
+
+
+def pallas_enabled(backend: Optional[str] = None) -> bool:
+    """Should a primitive route to its Pallas kernel?"""
+    mode = _mode()
+    if mode in ("0", "never", "off"):
+        return False
+    if mode in ("1", "always", "on"):
+        return pallas_available()
+    backend = backend or jax.default_backend()
+    return backend == "tpu" and pallas_available()
+
+
+def pallas_interpret(backend: Optional[str] = None) -> bool:
+    """Run kernels under the Pallas interpreter (non-TPU backends)."""
+    backend = backend or jax.default_backend()
+    return backend != "tpu"

@@ -1,0 +1,178 @@
+"""Pallas fused brute-force k-NN kernel (distance + in-kernel top-k).
+
+Reference: ``spatial/knn/detail/fused_l2_knn.cuh:196`` — a single CUDA
+kernel computing expanded-L2 tiles and maintaining per-warp ``WarpSelect``
+top-k heaps, so the distance matrix never hits global memory.
+
+TPU design (no warp shuffles, no heaps): the TPU-KNN partial-top-k trick
+(PAPERS.md: "TPU-KNN: K Nearest Neighbor Search at Peak FLOP/s").
+Per (query-tile, db-tile) grid cell:
+
+1. MXU matmul → transposed distance block ``d (TN, TM)`` (rows = db
+   points, cols = queries) entirely in VMEM.
+2. *Binned partial reduction*: split the TN db rows into ``L`` bins and
+   take each bin's (min, argmin) along the sublane axis → ``(L, TM)``
+   candidates. This is the approximate step: of two true top-k hits in
+   the same bin of the same tile, only the nearer survives. Recall is
+   controlled by ``L`` (quality ~ the paper's recall target; L ≥ 2k
+   default).
+3. Merge candidates with the running (k, TM) state (resident in the
+   output block across the db grid dimension) by k rounds of
+   extract-min — O(k·(k+L)) VPU work vs O(TN·K) MXU work per tile.
+
+Supports L2 (expanded, optional sqrt) and negated inner-product
+("largest" selection via negation — how the reference routes IP through
+FAISS max-heaps, ``knn_brute_force_faiss.cuh:220``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_tpu.ops.dispatch import pallas_interpret
+from raft_tpu.ops._util import BIG_I32 as _BIG_I32, round_up as _round_up
+from raft_tpu.core.precision import matmul_precision
+
+
+def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
+                k: int, l_bins: int, metric: str, sqrt: bool):
+    j = pl.program_id(1)
+    x = x_ref[:]                                         # (TM, K)
+    y = y_ref[:]                                         # (TN, K)
+    tm = x.shape[0]
+    ip = jax.lax.dot_general(
+        y, x, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=matmul_precision())
+    if metric == "l2":
+        xx = jnp.sum(x * x, axis=1, keepdims=True).T     # (1, TM)
+        yy = jnp.sum(y * y, axis=1, keepdims=True)       # (TN, 1)
+        d = jnp.maximum(yy + xx - 2.0 * ip, 0.0)
+    else:  # "ip": similarity → negate so smaller-is-better uniformly
+        d = -ip
+    row = jax.lax.broadcasted_iota(jnp.int32, (tn, tm), 0) + j * tn
+    d = jnp.where(row < n, d, jnp.inf)
+
+    # (2) binned partial top-1: (TN, TM) → (L, TM) candidates
+    b = tn // l_bins
+    db_ = d.reshape(l_bins, b, tm)
+    rb = row.reshape(l_bins, b, tm)
+    cand_d = jnp.min(db_, axis=1)                        # (L, TM)
+    cand_i = jnp.min(jnp.where(db_ == cand_d[:, None, :], rb, _BIG_I32),
+                     axis=1)                             # (L, TM)
+
+    @pl.when(j == 0)
+    def _():
+        od_ref[:] = jnp.full(od_ref.shape, jnp.inf, jnp.float32)
+        oi_ref[:] = jnp.full(oi_ref.shape, -1, jnp.int32)
+
+    # filtered merge (the role of the reference's warp_sort_filtered,
+    # topk/warpsort_topk.cuh:136): once the running top-k is warm, most
+    # tiles can't improve any query's k-th best — skip their merge.
+    kth = od_ref[0, k - 1:k, :]                          # (1, TM)
+    improves = jnp.any(cand_d < kth)
+
+    # (3) merge candidates into the running top-k: k rounds of extract-min
+    @pl.when(improves)
+    def _():
+        c_d = jnp.concatenate([od_ref[0], cand_d], axis=0)   # (k+L, TM)
+        c_i = jnp.concatenate([oi_ref[0], cand_i], axis=0)
+        ri = jax.lax.broadcasted_iota(jnp.int32, (k + l_bins, tm), 0)
+        new_d, new_i = [], []
+        for _ in range(k):
+            m_ = jnp.min(c_d, axis=0, keepdims=True)         # (1, TM)
+            first = jnp.min(jnp.where(c_d == m_, ri, _BIG_I32), axis=0,
+                            keepdims=True)
+            sel = ri == first                            # one-hot per column
+            new_d.append(m_)
+            new_i.append(jnp.sum(jnp.where(sel, c_i, 0), axis=0,
+                                 keepdims=True))
+            c_d = jnp.where(sel, jnp.inf, c_d)
+        od_ref[0] = jnp.concatenate(new_d, axis=0)       # (k, TM), sorted
+        oi_ref[0] = jnp.concatenate(new_i, axis=0)
+
+    is_last = j == gn - 1
+    if metric == "l2" and sqrt:
+        @pl.when(is_last)
+        def _():
+            od_ref[:] = jnp.sqrt(od_ref[:])
+    if metric == "ip":
+        @pl.when(is_last)
+        def _():
+            od_ref[:] = -od_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "metric", "sqrt", "tm", "tn", "l_bins", "interpret"))
+def _fused_knn_call(x, y, k: int, metric: str, sqrt: bool, tm: int, tn: int,
+                    l_bins: int, interpret: bool):
+    m, dim = x.shape
+    n = y.shape[0]
+    mp, np_ = _round_up(m, tm), _round_up(n, tn)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, 0)))
+    yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
+    gm, gn = mp // tm, np_ // tn
+    kern = functools.partial(_knn_kernel, n=n, tn=tn, gn=gn, k=k,
+                             l_bins=l_bins, metric=metric, sqrt=sqrt)
+    od, oi = pl.pallas_call(
+        kern,
+        grid=(gm, gn),
+        in_specs=[pl.BlockSpec((tm, dim), lambda i, j: (i, 0)),
+                  pl.BlockSpec((tn, dim), lambda i, j: (j, 0))],
+        out_specs=[pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0)),
+                   pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((gm, k, tm), jnp.float32),
+                   jax.ShapeDtypeStruct((gm, k, tm), jnp.int32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * np_ * dim,
+            bytes_accessed=4 * (gm * np_ * dim + gn * mp * dim
+                                + 2 * mp * k),
+            transcendentals=0),
+        interpret=interpret,
+    )(xp, yp)
+    # (gm, k, TM) → (m, k)
+    od = jnp.moveaxis(od, 1, 2).reshape(gm * tm, k)[:m]
+    oi = jnp.moveaxis(oi, 1, 2).reshape(gm * tm, k)[:m]
+    return od, oi
+
+
+def fused_knn_pallas(x, y, k: int, metric: str = "l2", sqrt: bool = False,
+                     tm: int = 0, tn: int = 0, l_bins: int = 0):
+    """Fused brute-force k-NN of queries ``x`` against database ``y``.
+
+    Returns ``(dists (m, k), idx int32 (m, k))``, rows sorted
+    best-first. ``metric``: ``"l2"`` (expanded, ``sqrt`` optional) or
+    ``"ip"`` (inner product, largest selected). ``l_bins`` controls the
+    per-tile partial-top-k width (0 → ``max(2k, 64)``); larger = higher
+    recall, more VPU work. Exact when ``l_bins == tn``.
+    """
+    m, dim = x.shape
+    n = y.shape[0]
+    if k > n:
+        raise ValueError(f"fused_knn_pallas: k={k} > n={n}")
+    if m == 0:
+        raise ValueError("fused_knn_pallas: empty query set")
+    if dim > 4096:
+        raise ValueError(
+            f"fused_knn_pallas: dim={dim} > 4096 exceeds the VMEM tile "
+            "budget; use the exact scan path")
+    if tm <= 0 or tn <= 0:
+        # VMEM heuristic: (tm+tn)·dim·4 input blocks + tn·tm·4 block
+        if dim <= 512:
+            tm, tn = 256, 512
+        elif dim <= 2048:
+            tm, tn = 256, 256
+        else:
+            tm, tn = 128, 256
+    tm = min(tm, _round_up(m, 8))
+    tn = min(tn, _round_up(n, 8))
+    if l_bins <= 0:
+        l_bins = max(2 * k, 64)
+    l_bins = min(l_bins, tn)
+    while tn % l_bins:  # terminates: tn % tn == 0
+        l_bins += 1
+    return _fused_knn_call(x, y, int(k), metric, bool(sqrt), tm, tn,
+                           l_bins, pallas_interpret())

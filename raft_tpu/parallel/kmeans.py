@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.cluster.kmeans import _plus_plus, sample_centroids
+from raft_tpu.core.precision import matmul_precision
 
 
 def distributed_kmeans_step(x_shard, centroids, valid, n_clusters: int,
@@ -35,7 +36,7 @@ def distributed_kmeans_step(x_shard, centroids, valid, n_clusters: int,
     cc = jnp.sum(centroids * centroids, axis=1)
     d = xx[:, None] + cc[None, :] - 2.0 * lax.dot_general(
         x_shard, centroids, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32, precision=matmul_precision())
     labels = jnp.argmin(d, axis=1)
     mind = jnp.min(d, axis=1)
     w = valid.astype(jnp.float32)

@@ -21,6 +21,7 @@ from raft_tpu.neighbors.brute_force import brute_force_knn as _dense_knn
 from raft_tpu.sparse.coo import COO
 from raft_tpu.sparse.csr import CSR
 from raft_tpu.sparse.distance import pairwise_distance as sparse_pairwise
+from raft_tpu.core.precision import matmul_precision
 
 
 def brute_force_knn(
@@ -107,7 +108,8 @@ def cross_component_nn(
         xt = jax.lax.dynamic_slice_in_dim(x, start, tile, 0)
         lt = jax.lax.dynamic_slice_in_dim(labels, start, tile, 0)
         sqt = jax.lax.dynamic_slice_in_dim(sq, start, tile, 0)
-        d = sqt[:, None] - 2.0 * (xt @ x.T) + sq[None, :]
+        d = (sqt[:, None] + sq[None, :]
+             - 2.0 * jnp.matmul(xt, x.T, precision=matmul_precision()))
         same = lt[:, None] == labels[None, :]
         # mask same-component pairs AND padded candidate columns
         col_pad = jnp.arange(x.shape[0]) >= n
