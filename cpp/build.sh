@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Build the native host runtime → raft_tpu/_lib/libraft_tpu_host.so
+# (the TPU framework's counterpart of the reference's compiled host-side
+# C++; see cpp/raft_tpu_host.cpp).
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p ../raft_tpu/_lib
+exec g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra \
+    -o ../raft_tpu/_lib/libraft_tpu_host.so raft_tpu_host.cpp

@@ -1,0 +1,173 @@
+// raft_tpu native host runtime.
+//
+// The reference keeps its host-side runtime in C++ (logger:
+// cpp/include/raft/core/logger.hpp:118; dendrogram union-find:
+// cpp/include/raft/cluster/detail/agglomerative.cuh:103 — explicitly a
+// *host* algorithm in a CUDA library). This library is the TPU framework's
+// equivalent: the irregular host-side algorithms and the logging core live
+// in C++ behind a plain C ABI, consumed from Python via ctypes
+// (raft_tpu/core/native.py). Device compute stays in XLA/Pallas.
+//
+// Build: cpp/build.sh → raft_tpu/_lib/libraft_tpu_host.so
+
+#include <algorithm>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Version
+// ---------------------------------------------------------------------------
+
+int rth_abi_version() { return 1; }
+
+// ---------------------------------------------------------------------------
+// Logging core (reference core/logger.hpp:118-251: level gating + callback
+// sink so Python can capture; default sink is stderr).
+// ---------------------------------------------------------------------------
+
+// Levels mirror the reference's RAFT_LEVEL_* (logger.hpp macros): 0=off,
+// 1=critical, 2=error, 3=warn, 4=info, 5=debug, 6=trace.
+typedef void (*rth_log_callback)(int level, const char* msg);
+
+namespace {
+std::mutex g_log_mutex;
+int g_log_level = 4;
+rth_log_callback g_log_cb = nullptr;
+
+void default_sink(int level, const char* msg) {
+  static const char* names[] = {"OFF",  "CRITICAL", "ERROR", "WARN",
+                                "INFO", "DEBUG",    "TRACE"};
+  int idx = (level < 0 || level > 6) ? 0 : level;
+  std::fprintf(stderr, "[raft_tpu][%s] %s\n", names[idx], msg);
+}
+}  // namespace
+
+void rth_log_set_level(int level) {
+  std::lock_guard<std::mutex> lk(g_log_mutex);
+  g_log_level = level;
+}
+
+int rth_log_get_level() {
+  std::lock_guard<std::mutex> lk(g_log_mutex);
+  return g_log_level;
+}
+
+void rth_log_set_callback(rth_log_callback cb) {
+  std::lock_guard<std::mutex> lk(g_log_mutex);
+  g_log_cb = cb;
+}
+
+int rth_log_should_log(int level) {
+  std::lock_guard<std::mutex> lk(g_log_mutex);
+  return level <= g_log_level && g_log_level > 0;
+}
+
+void rth_log(int level, const char* msg) {
+  rth_log_callback cb;
+  {
+    std::lock_guard<std::mutex> lk(g_log_mutex);
+    if (level > g_log_level || g_log_level <= 0) return;
+    cb = g_log_cb;
+  }
+  if (cb)
+    cb(level, msg);
+  else
+    default_sink(level, msg);
+}
+
+// ---------------------------------------------------------------------------
+// Dendrogram union-find (reference build_dendrogram_host,
+// cluster/detail/agglomerative.cuh:103): merge weight-sorted MST edges;
+// emit scipy-linkage-style (children, heights, sizes).
+// ---------------------------------------------------------------------------
+
+// Inputs: n_edges MST edges sorted ascending by weight (src/dst in
+// [0, n_edges], weights). Outputs: children (n_edges*2), heights
+// (n_edges), sizes (n_edges). Returns 0, or -1 if the edges do not form
+// a tree (a merge saw both endpoints already connected).
+int rth_build_dendrogram(int64_t n_edges, const int64_t* src,
+                         const int64_t* dst, const double* weight,
+                         int64_t* children, double* heights,
+                         int64_t* sizes) {
+  const int64_t n = n_edges + 1;
+  std::vector<int64_t> parent(2 * n - 1);
+  std::iota(parent.begin(), parent.end(), int64_t{0});
+  std::vector<int64_t> csize(2 * n - 1, 1);
+
+  auto find = [&parent](int64_t a) {
+    int64_t root = a;
+    while (parent[root] != root) root = parent[root];
+    while (parent[a] != root) {
+      int64_t next = parent[a];
+      parent[a] = root;
+      a = next;
+    }
+    return root;
+  };
+
+  int64_t next_label = n;
+  for (int64_t e = 0; e < n_edges; ++e) {
+    if (src[e] < 0 || src[e] >= n || dst[e] < 0 || dst[e] >= n) return -2;
+    const int64_t ra = find(src[e]);
+    const int64_t rb = find(dst[e]);
+    if (ra == rb) return -1;
+    children[2 * e] = ra;
+    children[2 * e + 1] = rb;
+    heights[e] = weight[e];
+    sizes[e] = csize[ra] + csize[rb];
+    csize[next_label] = sizes[e];
+    parent[ra] = next_label;
+    parent[rb] = next_label;
+    ++next_label;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Flattened-cluster extraction (reference extract_flattened_clusters,
+// cluster/detail/agglomerative.cuh:239): apply the first n_merges merges,
+// then label each point by its root, with labels numbered by ascending
+// root id (matching numpy.unique(..., return_inverse=True)).
+// ---------------------------------------------------------------------------
+
+int rth_extract_flattened(int64_t n, const int64_t* children,
+                          int64_t n_merges, int32_t* labels) {
+  if (n <= 0 || n_merges < 0 || n_merges > n - 1) return -2;
+  std::vector<int64_t> parent(2 * n - 1);
+  std::iota(parent.begin(), parent.end(), int64_t{0});
+  for (int64_t e = 0; e < n_merges; ++e) {
+    const int64_t ra = children[2 * e];
+    const int64_t rb = children[2 * e + 1];
+    if (ra < 0 || ra >= 2 * n - 1 || rb < 0 || rb >= 2 * n - 1) return -2;
+    parent[ra] = n + e;
+    parent[rb] = n + e;
+  }
+
+  auto find = [&parent](int64_t a) {
+    while (parent[a] != a) {
+      parent[a] = parent[parent[a]];
+      a = parent[a];
+    }
+    return a;
+  };
+
+  std::vector<int64_t> roots(n);
+  for (int64_t i = 0; i < n; ++i) roots[i] = find(i);
+  std::vector<int64_t> uniq(roots);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  for (int64_t i = 0; i < n; ++i) {
+    const auto it = std::lower_bound(uniq.begin(), uniq.end(), roots[i]);
+    labels[i] = static_cast<int32_t>(it - uniq.begin());
+  }
+  return static_cast<int>(uniq.size());
+}
+
+}  // extern "C"

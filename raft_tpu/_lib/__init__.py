@@ -1,0 +1,1 @@
+# Build-output directory for the native host runtime (cpp/build.sh).

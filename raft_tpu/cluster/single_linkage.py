@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core import native
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -85,9 +86,14 @@ def build_dendrogram_host(mst_src, mst_dst, mst_weight
                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Union-find over weight-sorted MST edges → (children (n-1, 2),
     heights, sizes), scipy-linkage-style (reference
-    ``build_dendrogram_host``, agglomerative.cuh:103)."""
+    ``build_dendrogram_host``, agglomerative.cuh:103). Runs in the
+    native C++ host runtime when available (cpp/raft_tpu_host.cpp — the
+    reference hosts this in C++ too); numpy fallback below."""
     order = np.argsort(mst_weight, kind="stable")
     src, dst, w = mst_src[order], mst_dst[order], mst_weight[order]
+    nat = native.build_dendrogram(src, dst, w)
+    if nat is not None:
+        return nat
     n = len(src) + 1
     parent = np.arange(2 * n - 1)
 
@@ -105,7 +111,11 @@ def build_dendrogram_host(mst_src, mst_dst, mst_weight
     cluster_size = np.ones(2 * n - 1, np.int64)
     next_label = n
     for e in range(n - 1):
+        if not (0 <= src[e] < n and 0 <= dst[e] < n):
+            raise ValueError("build_dendrogram: invalid MST edges (rc=-2)")
         ra, rb = find(src[e]), find(dst[e])
+        if ra == rb:
+            raise ValueError("build_dendrogram: invalid MST edges (rc=-1)")
         children[e] = (ra, rb)
         heights[e] = w[e]
         sizes[e] = cluster_size[ra] + cluster_size[rb]
@@ -119,9 +129,12 @@ def _extract_flattened(children: np.ndarray, n: int, n_clusters: int
                        ) -> np.ndarray:
     """Cut the dendrogram at n_clusters (reference
     extract_flattened_clusters, agglomerative.cuh:239)."""
-    parent = np.arange(2 * n - 1)
     # apply only the first n-1-(n_clusters-1) merges
     n_merges = n - n_clusters
+    nat = native.extract_flattened(children, n, n_merges)
+    if nat is not None:
+        return nat
+    parent = np.arange(2 * n - 1)
     for e in range(n_merges):
         ra, rb = children[e]
         parent[ra] = parent[rb] = n + e

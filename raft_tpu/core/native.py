@@ -1,0 +1,179 @@
+"""Loader for the native host runtime (``cpp/raft_tpu_host.cpp``).
+
+The reference's host-side runtime (logger core, dendrogram union-find,
+…) is C++; this module loads our C++ equivalent via ctypes. If the
+shared library is missing it is built on first use with g++ (sub-second,
+no deps); if that fails (no compiler at deploy time) every caller falls
+back to its pure-Python formulation — the C++ path is a performance/
+parity tier, not a hard dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LIB_NAME = "libraft_tpu_host.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_LOG_CB_TYPE = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p)
+_log_cb_keepalive = None  # the registered callback must outlive the lib
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "_lib", _LIB_NAME)
+
+
+def _cpp_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "cpp")
+
+
+def _try_build() -> bool:
+    script = os.path.join(_cpp_dir(), "build.sh")
+    if not os.path.exists(script):
+        return False
+    try:
+        subprocess.run(["bash", script], check=True, capture_output=True,
+                       timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.rth_abi_version.restype = ctypes.c_int
+    lib.rth_log.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rth_log_set_level.argtypes = [ctypes.c_int]
+    lib.rth_log_get_level.restype = ctypes.c_int
+    lib.rth_log_should_log.argtypes = [ctypes.c_int]
+    lib.rth_log_should_log.restype = ctypes.c_int
+    lib.rth_log_set_callback.argtypes = [_LOG_CB_TYPE]
+    lib.rth_build_dendrogram.restype = ctypes.c_int
+    lib.rth_build_dendrogram.argtypes = [
+        ctypes.c_int64, i64p, i64p, f64p, i64p, f64p, i64p]
+    lib.rth_extract_flattened.restype = ctypes.c_int
+    lib.rth_extract_flattened.argtypes = [
+        ctypes.c_int64, i64p, ctypes.c_int64, i32p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None (disabled via RAFT_TPU_NATIVE=0,
+    unbuildable, or ABI mismatch)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("RAFT_TPU_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path) and not _try_build():
+            _load_failed = True
+            return None
+        try:
+            lib = _configure(ctypes.CDLL(path))
+            if lib.rth_abi_version() != 1:
+                _load_failed = True
+                return None
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Typed wrappers
+# ---------------------------------------------------------------------------
+
+def build_dendrogram(src, dst, weight):
+    """Native build_dendrogram_host over weight-sorted MST edges →
+    (children (n-1, 2) i64, heights (n-1,) f64, sizes (n-1,) i64), or
+    None when the native lib is unavailable. Raises ValueError on
+    non-tree input (cycle)."""
+    lib = load()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    weight = np.ascontiguousarray(weight, np.float64)
+    n_edges = src.shape[0]
+    children = np.empty(2 * n_edges, np.int64)
+    heights = np.empty(n_edges, np.float64)
+    sizes = np.empty(n_edges, np.int64)
+    rc = lib.rth_build_dendrogram(n_edges, src, dst, weight, children,
+                                  heights, sizes)
+    if rc != 0:
+        raise ValueError(f"build_dendrogram: invalid MST edges (rc={rc})")
+    return children.reshape(n_edges, 2), heights, sizes
+
+
+def extract_flattened(children, n: int, n_merges: int):
+    """Native extract_flattened_clusters → labels (n,) i32, or None when
+    the native lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    children = np.ascontiguousarray(np.asarray(children).reshape(-1),
+                                    np.int64)
+    labels = np.empty(n, np.int32)
+    rc = lib.rth_extract_flattened(n, children, n_merges, labels)
+    if rc < 0:
+        raise ValueError(f"extract_flattened: invalid input (rc={rc})")
+    return labels
+
+
+def log(level: int, msg: str) -> bool:
+    """Emit through the native logging core; False if unavailable."""
+    lib = load()
+    if lib is None:
+        return False
+    lib.rth_log(int(level), msg.encode())
+    return True
+
+
+def log_set_level(level: int) -> bool:
+    lib = load()
+    if lib is None:
+        return False
+    lib.rth_log_set_level(int(level))
+    return True
+
+
+def log_set_callback(fn) -> bool:
+    """Install a Python callback as the native sink (the reference's
+    callback-sink pattern, core/detail/callback_sink.hpp). Pass None to
+    restore the default stderr sink."""
+    global _log_cb_keepalive
+    lib = load()
+    if lib is None:
+        return False
+    if fn is None:
+        cb = _LOG_CB_TYPE(0)
+    else:
+        def _trampoline(level, msg):
+            try:
+                fn(int(level), msg.decode(errors="replace"))
+            except Exception:
+                pass  # never propagate through the C boundary
+        cb = _LOG_CB_TYPE(_trampoline)
+    lib.rth_log_set_callback(cb)
+    _log_cb_keepalive = cb
+    return True

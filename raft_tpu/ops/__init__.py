@@ -13,6 +13,10 @@ CUDA kernels:
 Every kernel has an XLA reference formulation in the primitive layer; the
 public APIs dispatch between them via :mod:`raft_tpu.ops.dispatch`. A
 kernel only lands here if it beats the XLA tier on the bench suite.
+
+Kernel symbols load lazily (PEP 562) so that importing the dispatch
+module — which the primitive layer does on every public call — works
+even on jax builds without ``jax.experimental.pallas``.
 """
 
 from raft_tpu.ops.dispatch import (
@@ -20,8 +24,6 @@ from raft_tpu.ops.dispatch import (
     pallas_enabled,
     pallas_interpret,
 )
-from raft_tpu.ops.pallas_fused_l2_nn import fused_l2_nn_pallas
-from raft_tpu.ops.pallas_fused_knn import fused_knn_pallas
 
 __all__ = [
     "pallas_available",
@@ -30,3 +32,16 @@ __all__ = [
     "fused_l2_nn_pallas",
     "fused_knn_pallas",
 ]
+
+_LAZY = {
+    "fused_l2_nn_pallas": "raft_tpu.ops.pallas_fused_l2_nn",
+    "fused_knn_pallas": "raft_tpu.ops.pallas_fused_knn",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)

@@ -22,8 +22,15 @@ from typing import Optional
 import jax
 
 
+_MODES = ("auto", "0", "never", "off", "1", "always", "on")
+
+
 def _mode() -> str:
-    return os.environ.get("RAFT_TPU_PALLAS", "auto").lower()
+    mode = os.environ.get("RAFT_TPU_PALLAS", "auto").lower()
+    if mode not in _MODES:
+        raise ValueError(
+            f"RAFT_TPU_PALLAS={mode!r}: want auto|never|always")
+    return mode
 
 
 def pallas_available() -> bool:

@@ -149,6 +149,8 @@ def fused_knn_pallas(x, y, k: int, metric: str = "l2", sqrt: bool = False,
     per-tile partial-top-k width (0 → ``max(2k, 64)``); larger = higher
     recall, more VPU work. Exact when ``l_bins == tn``.
     """
+    if metric not in ("l2", "ip"):
+        raise ValueError(f"fused_knn_pallas: metric={metric!r}: want l2|ip")
     m, dim = x.shape
     n = y.shape[0]
     if k > n:

@@ -1,0 +1,105 @@
+"""Native C++ host runtime (cpp/raft_tpu_host.cpp) vs Python fallbacks.
+
+The reference tests its host-side C++ directly (gtest); here the native
+path is asserted to agree exactly with the pure-Python formulation —
+the naive-reference-vs-primitive pattern of SURVEY.md §4.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+
+def _force_python(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", True)
+
+
+def _random_tree(n, rng):
+    src = np.arange(1, n, dtype=np.int64)
+    dst = np.array([rng.integers(0, i) for i in range(1, n)], np.int64)
+    w = rng.random(n - 1)
+    return src, dst, w
+
+
+class TestDendrogramNative:
+    def test_parity_with_python(self, monkeypatch):
+        from raft_tpu.cluster.single_linkage import build_dendrogram_host
+        rng = np.random.default_rng(1)
+        src, dst, w = _random_tree(500, rng)
+        cn, hn, sn = build_dendrogram_host(src, dst, w)
+        _force_python(monkeypatch)
+        cp, hp, sp = build_dendrogram_host(src, dst, w)
+        np.testing.assert_array_equal(cn, cp)
+        np.testing.assert_allclose(hn, hp)
+        np.testing.assert_array_equal(sn, sp)
+
+    def test_extract_parity(self, monkeypatch):
+        from raft_tpu.cluster.single_linkage import (
+            _extract_flattened, build_dendrogram_host)
+        rng = np.random.default_rng(2)
+        n = 300
+        src, dst, w = _random_tree(n, rng)
+        children, _, _ = build_dendrogram_host(src, dst, w)
+        for n_clusters in (1, 2, 7, n):
+            ln = _extract_flattened(children, n, n_clusters)
+            assert len(np.unique(ln)) == n_clusters
+            _force_python(monkeypatch)
+            lp = _extract_flattened(children, n, n_clusters)
+            monkeypatch.undo()
+            np.testing.assert_array_equal(ln, lp)
+
+    def test_cycle_rejected(self):
+        # edges with a cycle are not an MST: native path must raise
+        src = np.array([0, 1, 0], np.int64)
+        dst = np.array([1, 2, 2], np.int64)
+        w = np.array([0.1, 0.2, 0.3])
+        with pytest.raises(ValueError):
+            native.build_dendrogram(src, dst, w)
+
+    def test_out_of_range_rejected(self):
+        src = np.array([0, 5], np.int64)  # 5 out of range for n=3
+        dst = np.array([1, 2], np.int64)
+        w = np.array([0.1, 0.2])
+        with pytest.raises(ValueError):
+            native.build_dendrogram(src, dst, w)
+
+
+class TestNativeLogging:
+    def test_callback_sink_and_level_gate(self):
+        seen = []
+        assert native.log_set_callback(lambda lvl, msg: seen.append((lvl, msg)))
+        try:
+            assert native.log_set_level(4)  # info
+            native.log(4, "hello")
+            native.log(5, "gated-out debug")
+            assert seen == [(4, "hello")]
+            assert native.log_set_level(5)
+            native.log(5, "debug now visible")
+            assert seen[-1] == (5, "debug now visible")
+        finally:
+            native.log_set_callback(None)
+            native.log_set_level(4)
+
+
+class TestSingleLinkageEndToEnd:
+    def test_native_path_used_in_single_linkage(self):
+        # three well-separated blobs → 3 clusters, via the native path
+        from raft_tpu.cluster.single_linkage import single_linkage
+        rng = np.random.default_rng(3)
+        pts = np.concatenate([
+            rng.normal(0, 0.1, (40, 2)),
+            rng.normal(5, 0.1, (40, 2)),
+            rng.normal((0, 8), 0.1, (40, 2)),
+        ]).astype(np.float32)
+        labels, children = single_linkage(pts, n_clusters=3)
+        labels = np.asarray(labels)
+        assert len(np.unique(labels)) == 3
+        # each blob uniform
+        for s in (slice(0, 40), slice(40, 80), slice(80, 120)):
+            assert len(np.unique(labels[s])) == 1
