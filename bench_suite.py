@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Extended benchmark table mirroring the reference's gbench suite
+(SURVEY.md §6: cpp/bench/{distance,neighbors,cluster,linalg,random,
+sparse}). Each case reports wall-time (and a domain rate) as a dict;
+run as a script to print one JSON line per case.
+
+Sync note: timings fetch a scalar from each result — on the tunneled
+axon platform ``block_until_ready`` returns early, a host transfer is
+the only reliable completion barrier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _sync(tree):
+    import jax
+    for leaf in jax.tree.leaves(tree):
+        np.asarray(leaf.ravel()[:1])
+
+
+def _time(fn, reps=5):
+    _sync(fn())  # warm/compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn()
+    _sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_pairwise_distance(results):
+    # cpp/bench/distance/distance_common.cuh:72-87 — 16384² blocks
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.distance.pairwise import _pairwise
+    from raft_tpu.distance.distance_types import DistanceType
+    key = jax.random.key(0)
+    m = n = 8192
+    for d in (64, 256):
+        x = jax.random.normal(jax.random.fold_in(key, 1), (m, d))
+        y = jax.random.normal(jax.random.fold_in(key, 2), (n, d))
+        for metric in (DistanceType.L2Expanded, DistanceType.CosineExpanded,
+                       DistanceType.L1):
+            t = _time(lambda: _pairwise(x, y, metric, 2.0))
+            results.append({
+                "metric": f"pairwise_{metric.name}_{m}x{n}x{d}_ms",
+                "value": round(t * 1e3, 3), "unit": "ms",
+                "rate": round(2 * m * n * d / t / 1e9, 1),
+                "rate_unit": "GFLOP/s"})
+
+
+def bench_fused_l2_nn(results):
+    # cpp/bench/neighbors/fused_l2_nn.cu
+    import jax
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+    key = jax.random.key(1)
+    m, n, d = 100_000, 1024, 128
+    x = jax.random.normal(jax.random.fold_in(key, 1), (m, d))
+    y = jax.random.normal(jax.random.fold_in(key, 2), (n, d))
+    t = _time(lambda: tuple(fused_l2_nn(x, y)))
+    results.append({
+        "metric": f"fused_l2_nn_{m//1000}kx{n}x{d}_ms",
+        "value": round(t * 1e3, 3), "unit": "ms",
+        "rate": round(2 * m * n * d / t / 1e9, 1), "rate_unit": "GFLOP/s"})
+
+
+def bench_select_k(results):
+    # cpp/bench/neighbors/selection.cu
+    import jax
+    from raft_tpu.neighbors.selection import select_k
+    key = jax.random.key(2)
+    v = jax.random.normal(key, (1000, 4096))  # sort width capped ~4k: larger first-compiles can wedge the tunnel
+    for k in (32, 256):
+        t = _time(lambda: select_k(v, k))
+        results.append({
+            "metric": f"select_k_1000x4096_k{k}_ms",
+            "value": round(t * 1e3, 3), "unit": "ms"})
+
+
+def bench_kmeans(results):
+    # cpp/bench/cluster/kmeans.cu — 1M points
+    import jax
+    from raft_tpu.cluster.kmeans import fit as kmeans_fit
+    from raft_tpu.cluster.kmeans_types import KMeansParams, InitMethod
+    key = jax.random.key(3)
+    n, d, k = 500_000, 64, 256
+    x = jax.random.normal(key, (n, d))
+    params = KMeansParams(n_clusters=k, max_iter=5,
+                          init=InitMethod.Random, seed=0)
+    t = _time(lambda: tuple(kmeans_fit(x, params)), reps=2)
+    results.append({
+        "metric": f"kmeans_{n//1000}kx{d}_k{k}_5iter_ms",
+        "value": round(t * 1e3, 1), "unit": "ms"})
+
+
+def bench_ivf_flat(results):
+    # cpp/bench/neighbors/knn/ivf_flat_*.cu — SEARCH scope
+    import jax
+    from raft_tpu.neighbors import ivf_flat
+    key = jax.random.key(4)
+    n, d, nq, k = 500_000, 128, 1000, 32
+    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    t_build0 = time.perf_counter()
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
+    _sync(index.centers)
+    t_build = time.perf_counter() - t_build0
+    sp = ivf_flat.SearchParams(n_probes=64)
+    t = _time(lambda: ivf_flat.search(index, q, k, sp), reps=3)
+    results.append({
+        "metric": f"ivf_flat_search_{n//1000}kx{d}_q{nq}_k{k}_p64_qps",
+        "value": round(nq / t, 1), "unit": "queries/s",
+        "build_s": round(t_build, 2)})
+
+
+def bench_ivf_pq(results):
+    import jax
+    from raft_tpu.neighbors import ivf_pq
+    key = jax.random.key(5)
+    n, d, nq, k = 500_000, 128, 1000, 32
+    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    t_build0 = time.perf_counter()
+    index = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024))
+    _sync(index.centers)
+    t_build = time.perf_counter() - t_build0
+    sp = ivf_pq.SearchParams(n_probes=64)
+    t = _time(lambda: ivf_pq.search(index, q, k, sp), reps=3)
+    results.append({
+        "metric": f"ivf_pq_search_{n//1000}kx{d}_q{nq}_k{k}_p64_qps",
+        "value": round(nq / t, 1), "unit": "queries/s",
+        "build_s": round(t_build, 2)})
+
+
+def bench_linalg_random(results):
+    # cpp/bench/linalg/*.cu, cpp/bench/random/*.cu
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.linalg.reduce import reduce as reduce_fn
+    from raft_tpu.random.make_blobs import make_blobs
+    key = jax.random.key(6)
+    x = jax.random.normal(key, (16384, 1024))
+    t = _time(lambda: reduce_fn(x, along_rows=True))
+    results.append({"metric": "reduce_rows_16384x1024_ms",
+                    "value": round(t * 1e3, 3), "unit": "ms"})
+    t = _time(lambda: make_blobs(n_samples=1_000_000, n_features=64,
+                                 centers=10, seed=0)[0])
+    results.append({"metric": "make_blobs_1Mx64_ms",
+                    "value": round(t * 1e3, 1), "unit": "ms"})
+
+
+_CASES = [bench_pairwise_distance, bench_fused_l2_nn, bench_select_k,
+          bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_linalg_random]
+
+
+def run_all(cases=None):
+    import jax
+    if "BENCH_PLATFORM" in os.environ:
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    results = []
+    selected = _CASES if not cases else [
+        c for c in _CASES if c.__name__.removeprefix("bench_") in cases]
+    for case in selected:
+        try:
+            case(results)
+        except Exception as e:  # a failing case must not kill the table
+            results.append({"metric": case.__name__, "error": repr(e)})
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+    for r in run_all(sys.argv[1:] or None):
+        print(json.dumps(r))

@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # Build the native host runtime → raft_tpu/_lib/libraft_tpu_host.so
+# (sources live package-internal so installed wheels can build them;
+#  repo-root cpp/ is a symlink here)
 # (the TPU framework's counterpart of the reference's compiled host-side
 # C++; see cpp/raft_tpu_host.cpp).
 set -euo pipefail
 cd "$(dirname "$0")"
-mkdir -p ../raft_tpu/_lib
+mkdir -p ../_lib
 exec g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra \
-    -o ../raft_tpu/_lib/libraft_tpu_host.so raft_tpu_host.cpp
+    -o ../_lib/libraft_tpu_host.so raft_tpu_host.cpp

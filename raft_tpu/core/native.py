@@ -33,8 +33,7 @@ def _lib_path() -> str:
 
 
 def _cpp_dir() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "cpp")
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "_cpp")
 
 
 def _try_build() -> bool:
@@ -115,6 +114,8 @@ def build_dendrogram(src, dst, weight):
     dst = np.ascontiguousarray(dst, np.int64)
     weight = np.ascontiguousarray(weight, np.float64)
     n_edges = src.shape[0]
+    if dst.shape != (n_edges,) or weight.shape != (n_edges,):
+        raise ValueError("build_dendrogram: src/dst/weight length mismatch")
     children = np.empty(2 * n_edges, np.int64)
     heights = np.empty(n_edges, np.float64)
     sizes = np.empty(n_edges, np.int64)
@@ -133,6 +134,10 @@ def extract_flattened(children, n: int, n_merges: int):
         return None
     children = np.ascontiguousarray(np.asarray(children).reshape(-1),
                                     np.int64)
+    if n <= 0 or n_merges < 0 or n_merges > n - 1:
+        raise ValueError("extract_flattened: bad n/n_merges")
+    if children.shape[0] < 2 * n_merges:
+        raise ValueError("extract_flattened: children shorter than n_merges")
     labels = np.empty(n, np.int32)
     rc = lib.rth_extract_flattened(n, children, n_merges, labels)
     if rc < 0:

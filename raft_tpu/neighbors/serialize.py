@@ -1,0 +1,126 @@
+"""ANN index serialization (save/load).
+
+The reference snapshot has NO index serialization — indexes are rebuilt
+per process (SURVEY.md §5 "Checkpoint/resume: none"; serialize arrived
+in later RAFT). This module is the explicit improvement called for
+there: IVF-Flat and IVF-PQ indexes round-trip through a single ``.npz``
+file (array payloads + a JSON metadata record), so a multi-hour build
+of a 100M-vector index is paid once.
+
+Format: numpy ``.npz`` with key ``__meta__`` holding a JSON object
+{format, version, fields...}; every jax.Array field is stored as its
+host numpy value and restored with ``jnp.asarray`` (device placement
+follows the caller's default device / sharding context).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+
+_VERSION = 1
+
+
+def _pack(path: str, fmt: str, meta: dict, arrays: dict) -> None:
+    meta = dict(meta, format=fmt, version=_VERSION)
+    np.savez(path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **{
+            k: np.asarray(v) for k, v in arrays.items()})
+    if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+        os.replace(path + ".npz", path)  # np.savez appends .npz; honor the
+        # exact path the caller asked for so load(path) round-trips
+
+
+def _unpack(path: str, fmt: str):
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        expects(meta.get("format") == fmt,
+                f"serialize: {path} holds {meta.get('format')!r}, "
+                f"expected {fmt!r}")
+        expects(meta.get("version") == _VERSION,
+                f"serialize: unsupported version {meta.get('version')}")
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    return meta, arrays
+
+
+def save_ivf_flat(index, path: str) -> None:
+    """Write an :class:`raft_tpu.neighbors.ivf_flat.Index` to ``path``."""
+    _pack(path, "ivf_flat",
+          {"metric": int(index.metric), "size": int(index.size)},
+          {"centers": index.centers, "lists_data": index.lists_data,
+           "lists_indices": index.lists_indices,
+           "lists_norms": index.lists_norms,
+           "list_sizes": index.list_sizes})
+
+
+def load_ivf_flat(path: str):
+    """Read an IVF-Flat index written by :func:`save_ivf_flat`."""
+    from raft_tpu.neighbors.ivf_flat import Index
+    meta, a = _unpack(path, "ivf_flat")
+    return Index(
+        centers=jnp.asarray(a["centers"]),
+        lists_data=jnp.asarray(a["lists_data"]),
+        lists_indices=jnp.asarray(a["lists_indices"]),
+        lists_norms=jnp.asarray(a["lists_norms"]),
+        list_sizes=jnp.asarray(a["list_sizes"]),
+        metric=DistanceType(meta["metric"]),
+        size=meta["size"])
+
+
+def save_ivf_pq(index, path: str) -> None:
+    """Write an :class:`raft_tpu.neighbors.ivf_pq.Index` to ``path``."""
+    _pack(path, "ivf_pq",
+          {"metric": int(index.metric), "size": int(index.size),
+           "pq_bits": int(index.pq_bits)},
+          {"centers": index.centers, "centers_rot": index.centers_rot,
+           "rotation_matrix": index.rotation_matrix,
+           "pq_centers": index.pq_centers, "codes": index.codes,
+           "lists_indices": index.lists_indices,
+           "list_sizes": index.list_sizes})
+
+
+def load_ivf_pq(path: str):
+    """Read an IVF-PQ index written by :func:`save_ivf_pq`."""
+    from raft_tpu.neighbors.ivf_pq import Index
+    meta, a = _unpack(path, "ivf_pq")
+    return Index(
+        centers=jnp.asarray(a["centers"]),
+        centers_rot=jnp.asarray(a["centers_rot"]),
+        rotation_matrix=jnp.asarray(a["rotation_matrix"]),
+        pq_centers=jnp.asarray(a["pq_centers"]),
+        codes=jnp.asarray(a["codes"]),
+        lists_indices=jnp.asarray(a["lists_indices"]),
+        list_sizes=jnp.asarray(a["list_sizes"]),
+        metric=DistanceType(meta["metric"]),
+        pq_bits=meta["pq_bits"],
+        size=meta["size"])
+
+
+def save(index, path: str) -> None:
+    """Type-dispatching save for any supported ANN index."""
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    if isinstance(index, ivf_flat.Index):
+        save_ivf_flat(index, path)
+    elif isinstance(index, ivf_pq.Index):
+        save_ivf_pq(index, path)
+    else:
+        raise TypeError(f"serialize.save: unsupported index {type(index)}")
+
+
+def load(path: str):
+    """Type-dispatching load: reads the format tag and returns the
+    matching index type."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    fmt = meta.get("format")
+    if fmt == "ivf_flat":
+        return load_ivf_flat(path)
+    if fmt == "ivf_pq":
+        return load_ivf_pq(path)
+    raise ValueError(f"serialize.load: unknown format {fmt!r} in {path}")

@@ -54,15 +54,13 @@ def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
     else:  # "ip": similarity → negate so smaller-is-better uniformly
         d = -ip
     row = jax.lax.broadcasted_iota(jnp.int32, (tn, tm), 0) + j * tn
-    d = jnp.where(row < n, d, jnp.inf)
+    if n % tn:  # only pay the padded-row masking pass when padding exists
+        d = jnp.where(row < n, d, jnp.inf)
 
     # (2) binned partial top-1: (TN, TM) → (L, TM) candidates
     b = tn // l_bins
     db_ = d.reshape(l_bins, b, tm)
-    rb = row.reshape(l_bins, b, tm)
     cand_d = jnp.min(db_, axis=1)                        # (L, TM)
-    cand_i = jnp.min(jnp.where(db_ == cand_d[:, None, :], rb, _BIG_I32),
-                     axis=1)                             # (L, TM)
 
     @pl.when(j == 0)
     def _():
@@ -71,13 +69,18 @@ def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
 
     # filtered merge (the role of the reference's warp_sort_filtered,
     # topk/warpsort_topk.cuh:136): once the running top-k is warm, most
-    # tiles can't improve any query's k-th best — skip their merge.
+    # tiles can't improve any query's k-th best — skip their merge (and
+    # the bin-argmin pass, which only merging needs).
     kth = od_ref[0, k - 1:k, :]                          # (1, TM)
     improves = jnp.any(cand_d < kth)
 
     # (3) merge candidates into the running top-k: k rounds of extract-min
     @pl.when(improves)
     def _():
+        rb = row.reshape(l_bins, b, tm)
+        cand_i = jnp.min(
+            jnp.where(db_ == cand_d[:, None, :], rb, _BIG_I32),
+            axis=1)                                      # (L, TM)
         c_d = jnp.concatenate([od_ref[0], cand_d], axis=0)   # (k+L, TM)
         c_i = jnp.concatenate([oi_ref[0], cand_i], axis=0)
         ri = jax.lax.broadcasted_iota(jnp.int32, (k + l_bins, tm), 0)
