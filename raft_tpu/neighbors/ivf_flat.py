@@ -158,11 +158,24 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
                  size=index.size + x_new.shape[0])
 
 
+def _score_probe(queries, qq, lists_data, lists_norms, lists_indices,
+                 list_id):
+    """Score one probe rank: per-query (max_list,) distances + ids — the
+    fine-phase GEMM shared by single-chip and sharded searches
+    (reference interleaved_scan_kernel, ivf_flat_search.cuh:665)."""
+    data = lists_data[list_id]                  # (nq, max_list, dim)
+    ids = lists_indices[list_id]                # (nq, max_list)
+    ip = jnp.einsum("qd,qld->ql", queries, data,
+                    preferred_element_type=jnp.float32,
+                    precision=matmul_precision())
+    d = qq[:, None] + lists_norms[list_id] - 2.0 * ip
+    return jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf), ids
+
+
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "sqrt"))
 def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
                  k: int, n_probes: int, sqrt: bool):
     nq, dim = queries.shape
-    n_lists, max_list, _ = lists_data.shape
 
     # ---- coarse phase (reference ivf_flat_search.cuh:1070-1147):
     # query×centers GEMM + top-k probes
@@ -173,15 +186,8 @@ def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
     # ---- fine phase: scan over probe rank; each rank is one batched GEMM
     def probe_step(carry, p):
         best_d, best_i = carry
-        list_id = probes[:, p]                      # (nq,)
-        data = lists_data[list_id]                  # (nq, max_list, dim)
-        norms = lists_norms[list_id]                # (nq, max_list)
-        ids = lists_indices[list_id]                # (nq, max_list)
-        ip = jnp.einsum("qd,qld->ql", queries, data,
-                        preferred_element_type=jnp.float32,
-                        precision=matmul_precision())
-        d = qq[:, None] + norms - 2.0 * ip
-        d = jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf)
+        d, ids = _score_probe(queries, qq, lists_data, lists_norms,
+                              lists_indices, probes[:, p])
         cat_d = jnp.concatenate([best_d, d], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
         nd, sel = lax.top_k(-cat_d, k)

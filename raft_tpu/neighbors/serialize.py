@@ -86,10 +86,12 @@ def save_ivf_pq(index, path: str) -> None:
 
 
 def load_ivf_pq(path: str):
-    """Read an IVF-PQ index written by :func:`save_ivf_pq`."""
+    """Read an IVF-PQ index written by :func:`save_ivf_pq`. The bf16
+    reconstruction cache is re-derived lazily from the compact codes at
+    first reconstruct-mode search."""
     from raft_tpu.neighbors.ivf_pq import Index
     meta, a = _unpack(path, "ivf_pq")
-    return Index(
+    index = Index(
         centers=jnp.asarray(a["centers"]),
         centers_rot=jnp.asarray(a["centers_rot"]),
         rotation_matrix=jnp.asarray(a["rotation_matrix"]),
@@ -100,6 +102,7 @@ def load_ivf_pq(path: str):
         metric=DistanceType(meta["metric"]),
         pq_bits=meta["pq_bits"],
         size=meta["size"])
+    return index
 
 
 def save(index, path: str) -> None:

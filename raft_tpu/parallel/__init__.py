@@ -9,9 +9,17 @@ data + psum'd centroid statistics), and sharded IVF search.
 from raft_tpu.parallel.mesh import make_mesh, shard_rows, replicate
 from raft_tpu.parallel.knn import distributed_knn
 from raft_tpu.parallel.kmeans import distributed_kmeans_fit, distributed_kmeans_step
+from raft_tpu.parallel.ivf import (
+    shard_ivf_flat,
+    shard_ivf_pq,
+    distributed_ivf_flat_search,
+    distributed_ivf_pq_search,
+)
 
 __all__ = [
     "make_mesh", "shard_rows", "replicate",
     "distributed_knn",
     "distributed_kmeans_fit", "distributed_kmeans_step",
+    "shard_ivf_flat", "shard_ivf_pq",
+    "distributed_ivf_flat_search", "distributed_ivf_pq_search",
 ]

@@ -1,0 +1,207 @@
+"""Distributed (sharded) IVF search — the 100M-vector north star
+(SURVEY.md §6-§7: shard the IVF lists across a mesh, per-shard probe
+scans, collective top-k merge).
+
+Design: the index's list dimension (``n_lists``) is sharded over the
+mesh's data axis; queries are replicated. Each shard runs the standard
+coarse→fine search against its local lists (its local centers are a
+disjoint subset of the global centers), then shards merge their top-k
+with one all_gather + select. Like the reference's multi-part search
+(``knn_merge_parts``-over-parts, brute_force.cuh:48 — and cuML's MNMG
+ANN), each shard probes ``n_probes`` of *its own* lists, so total probed
+lists grow with the mesh: recall at fixed n_probes is ≥ the single-chip
+index's.
+
+List indices hold global database row ids from the single build, so no
+id translation is needed at merge.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
+from raft_tpu.comms.comms import build_comms
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import _l2_expanded
+
+
+def _shard0(arr, mesh, axis):
+    """Shard an array's leading (list) dimension over mesh[axis]."""
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def shard_ivf_flat(index, mesh: jax.sharding.Mesh, axis: str = "data"):
+    """Reshard an IVF-Flat index's lists over ``mesh[axis]`` (in place on
+    a new Index). n_lists must divide evenly."""
+    from raft_tpu.neighbors.ivf_flat import Index
+    n_shards = mesh.shape[axis]
+    expects(index.n_lists % n_shards == 0,
+            f"shard_ivf_flat: n_lists={index.n_lists} not divisible by "
+            f"{n_shards} shards")
+    return Index(
+        centers=_shard0(index.centers, mesh, axis),
+        lists_data=_shard0(index.lists_data, mesh, axis),
+        lists_indices=_shard0(index.lists_indices, mesh, axis),
+        lists_norms=_shard0(index.lists_norms, mesh, axis),
+        list_sizes=_shard0(index.list_sizes, mesh, axis),
+        metric=index.metric, size=index.size)
+
+
+def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
+    """Reshard an IVF-PQ index's lists over ``mesh[axis]``. The bf16
+    reconstruction cache is decoded first (sharded scans use it)."""
+    from raft_tpu.neighbors.ivf_pq import Index, _decode_lists
+    n_shards = mesh.shape[axis]
+    expects(index.n_lists % n_shards == 0,
+            f"shard_ivf_pq: n_lists={index.n_lists} not divisible by "
+            f"{n_shards} shards")
+    # shard the compact payload FIRST, then decode: the bf16 cache is the
+    # one array sharding exists to split — it must never materialize on a
+    # single device (the 100M north-star constraint)
+    codes = _shard0(index.codes, mesh, axis)
+    lists_indices = _shard0(index.lists_indices, mesh, axis)
+    pq_centers = jax.device_put(index.pq_centers, NamedSharding(mesh, P()))
+    decoded, decoded_norms = _decode_lists(codes, pq_centers, lists_indices)
+    return Index(
+        centers=_shard0(index.centers, mesh, axis),
+        centers_rot=_shard0(index.centers_rot, mesh, axis),
+        rotation_matrix=jax.device_put(index.rotation_matrix,
+                                       NamedSharding(mesh, P())),
+        pq_centers=pq_centers,
+        codes=codes,
+        lists_indices=lists_indices,
+        list_sizes=_shard0(index.list_sizes, mesh, axis),
+        metric=index.metric, pq_bits=index.pq_bits, size=index.size,
+        decoded=decoded, decoded_norms=decoded_norms)
+
+
+def _fine_scan(queries, get_probe, k: int, n_probes: int, axis: str):
+    """Shared probe-rank scan with a shard-varying carry (plain
+    ``_search_impl`` carries an unvarying init that shard_map's
+    varying-manual-axes tracking rejects)."""
+    nq = queries.shape[0]
+
+    def probe_step(carry, p):
+        best_d, best_i = carry
+        d, ids = get_probe(p)
+        cat_d = jnp.concatenate([best_d, d], axis=1)
+        cat_i = jnp.concatenate([best_i, ids], axis=1)
+        nd, sel = lax.top_k(-cat_d, k)
+        return (-nd, jnp.take_along_axis(cat_i, sel, axis=1)), None
+
+    init = (lax.pcast(jnp.full((nq, k), jnp.inf, jnp.float32),
+                      (axis,), to="varying"),
+            lax.pcast(jnp.full((nq, k), -1, jnp.int32),
+                      (axis,), to="varying"))
+    (d, i), _ = lax.scan(probe_step, init, jnp.arange(n_probes))
+    return d, i
+
+
+def _global_merge(comms, axis, d, i, k):
+    gd = comms.allgather(d)                   # (n_shards, nq, k)
+    gi = comms.allgather(i)
+    cat_d = jnp.moveaxis(gd, 0, 1).reshape(d.shape[0], -1)
+    cat_i = jnp.moveaxis(gi, 0, 1).reshape(d.shape[0], -1)
+    nd, sel = lax.top_k(-cat_d, k)
+    fd, fi = -nd, jnp.take_along_axis(cat_i, sel, axis=1)
+    # identical on every rank; pmax proves replication to shard_map
+    return lax.pmax(fd, axis), lax.pmax(fi, axis)
+
+
+def distributed_ivf_flat_search(
+    index, queries, k: int, params=None,
+    mesh: jax.sharding.Mesh = None, axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Search a list-sharded IVF-Flat index (see :func:`shard_ivf_flat`)."""
+    from raft_tpu.neighbors.ivf_flat import SearchParams
+    params = params or SearchParams()
+    expects(mesh is not None, "distributed ivf_flat: mesh is required")
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == index.dim, "distributed ivf_flat: dim mismatch")
+    n_shards = mesh.shape[axis]
+    nl_local = index.n_lists // n_shards
+    n_probes = min(params.n_probes, nl_local)
+    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
+                            DistanceType.L2SqrtUnexpanded)
+    comms = build_comms(mesh, axis)
+
+    def local(centers, lists_data, lists_indices, lists_norms, q_rep):
+        qq = jnp.sum(q_rep * q_rep, axis=1)
+        coarse = _l2_expanded(q_rep, centers, sqrt=False)
+        _, probes = lax.top_k(-coarse, n_probes)
+
+        def get_probe(p):
+            from raft_tpu.neighbors.ivf_flat import _score_probe
+            return _score_probe(q_rep, qq, lists_data, lists_norms,
+                                lists_indices, probes[:, p])
+
+        d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
+        if sqrt:
+            d = jnp.sqrt(jnp.maximum(d, 0.0))
+        return _global_merge(comms, axis, d, i, k)
+
+    shmapped = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
+                  P(axis, None), P()),
+        out_specs=(P(), P())))
+    q_rep = jax.device_put(q, NamedSharding(mesh, P()))
+    return shmapped(index.centers, index.lists_data, index.lists_indices,
+                    index.lists_norms, q_rep)
+
+
+def distributed_ivf_pq_search(
+    index, queries, k: int, params=None,
+    mesh: jax.sharding.Mesh = None, axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Search a list-sharded IVF-PQ index (see :func:`shard_ivf_pq`) via
+    the bf16 reconstruction scan."""
+    from raft_tpu.neighbors.ivf_pq import SearchParams
+    params = params or SearchParams()
+    expects(mesh is not None, "distributed ivf_pq: mesh is required")
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == index.dim, "distributed ivf_pq: dim mismatch")
+    expects(index.decoded is not None,
+            "distributed ivf_pq: index not sharded via shard_ivf_pq")
+    n_shards = mesh.shape[axis]
+    nl_local = index.n_lists // n_shards
+    n_probes = min(params.n_probes, nl_local)
+    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
+                            DistanceType.L2SqrtUnexpanded)
+    comms = build_comms(mesh, axis)
+
+    def local(centers, centers_rot, rot, decoded, decoded_norms,
+              lists_indices, q_rep):
+        coarse = _l2_expanded(q_rep, centers, sqrt=False)
+        _, probes = lax.top_k(-coarse, n_probes)
+        q_rot = jnp.matmul(q_rep, rot.T, precision=matmul_precision())
+
+        def get_probe(p):
+            from raft_tpu.neighbors.ivf_pq import _score_probe_reconstruct
+            return _score_probe_reconstruct(
+                q_rot, centers_rot, decoded, decoded_norms, lists_indices,
+                probes[:, p])
+
+        d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
+        if sqrt:
+            d = jnp.sqrt(jnp.maximum(d, 0.0))
+        return _global_merge(comms, axis, d, i, k)
+
+    shmapped = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(), P(axis, None, None),
+                  P(axis, None), P(axis, None), P()),
+        out_specs=(P(), P())))
+    q_rep = jax.device_put(q, NamedSharding(mesh, P()))
+    return shmapped(index.centers, index.centers_rot,
+                    index.rotation_matrix, index.decoded,
+                    index.decoded_norms, index.lists_indices, q_rep)

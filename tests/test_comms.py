@@ -142,3 +142,102 @@ class TestDistributedKmeans:
         _, inertia_single, _ = fit(x, params)
         centroids, inertia_dist, _ = distributed_kmeans_fit(x, params, mesh)
         assert float(inertia_dist) < float(inertia_single) * 1.3
+
+
+class TestDistributedIvf:
+    """List-sharded IVF search over the 8-device mesh
+    (raft_tpu/parallel/ivf.py)."""
+
+    def _mesh(self):
+        from raft_tpu.parallel.mesh import make_mesh
+        return make_mesh((8,), ("data",))
+
+    def test_ivf_flat_full_probe_equals_exact(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.parallel import (distributed_ivf_flat_search,
+                                       shard_ivf_flat)
+        key = jax.random.key(0)
+        db = jax.random.normal(key, (2048, 24))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (32, 24))
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4, metric=DistanceType.L2Expanded))
+        mesh = self._mesh()
+        sidx = shard_ivf_flat(idx, mesh)
+        # probing every local list on every shard == exhaustive search
+        d, i = distributed_ivf_flat_search(
+            sidx, q, 8, ivf_flat.SearchParams(n_probes=4), mesh=mesh)
+        de, ie = brute_force_knn(db, q, 8, DistanceType.L2Expanded)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ie))
+        np.testing.assert_allclose(np.asarray(d), np.asarray(de),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_ivf_flat_recall_geq_single(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.parallel import (distributed_ivf_flat_search,
+                                       shard_ivf_flat)
+        key = jax.random.key(4)
+        db = jax.random.normal(key, (4096, 16))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (64, 16))
+        k = 10
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(
+            n_lists=64, kmeans_n_iters=5, metric=DistanceType.L2Expanded))
+        _, ie = brute_force_knn(db, q, k, DistanceType.L2Expanded)
+        ie = np.asarray(ie)
+
+        def recall(ii):
+            ii = np.asarray(ii)
+            return np.mean([len(set(ii[r]) & set(ie[r])) / k
+                            for r in range(len(ie))])
+        sp = ivf_flat.SearchParams(n_probes=2)
+        _, i_single = ivf_flat.search(idx, q, k, sp)
+        mesh = self._mesh()
+        sidx = shard_ivf_flat(idx, mesh)
+        _, i_dist = distributed_ivf_flat_search(sidx, q, k, sp, mesh=mesh)
+        # each shard probes 2 of its local lists → 16 lists total vs 2:
+        # distributed recall must dominate
+        assert recall(i_dist) >= recall(i_single)
+        assert recall(i_dist) > 0.5
+
+    def test_ivf_pq_distributed(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.parallel import (distributed_ivf_pq_search,
+                                       shard_ivf_pq)
+        key = jax.random.key(5)
+        db = jax.random.normal(key, (2048, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (32, 32))
+        k = 10
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(
+            n_lists=32, kmeans_n_iters=4, metric=DistanceType.L2Expanded))
+        mesh = self._mesh()
+        sidx = shard_ivf_pq(idx, mesh)
+        d, i = distributed_ivf_pq_search(
+            sidx, q, k, ivf_pq.SearchParams(n_probes=4), mesh=mesh)
+        _, ie = brute_force_knn(db, q, k, DistanceType.L2Expanded)
+        ie, i = np.asarray(ie), np.asarray(i)
+        rec = np.mean([len(set(i[r]) & set(ie[r])) / k for r in range(32)])
+        assert rec >= 0.5, rec  # PQ-quantized exhaustive probe
+
+    def test_shard_requires_divisibility(self):
+        import pytest
+        import jax
+        from raft_tpu.core.error import LogicError
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import shard_ivf_flat
+        key = jax.random.key(6)
+        db = jax.random.normal(key, (300, 8))
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=12,
+                                                      kmeans_n_iters=2))
+        with pytest.raises(LogicError):
+            shard_ivf_flat(idx, self._mesh())

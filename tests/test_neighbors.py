@@ -286,3 +286,41 @@ class TestSerialize:
         assert os.path.exists(path) and not os.path.exists(path + ".npz")
         idx2 = serialize.load(path)
         assert idx2.size == idx.size
+
+
+class TestIvfPqScanModes:
+    def test_reconstruct_matches_lut(self):
+        """The bf16 reconstruction scan must agree with the exact f32
+        LUT scan (same asymmetric-PQ distances up to bf16 rounding)."""
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_pq
+        key = jax.random.key(9)
+        db = jax.random.normal(key, (2000, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (50, 32))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=16,
+                                                  kmeans_n_iters=4))
+        k = 10
+        d_r, i_r = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="reconstruct"))
+        d_l, i_l = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="lut"))
+        i_r, i_l = np.asarray(i_r), np.asarray(i_l)
+        overlap = np.mean([len(set(i_r[r]) & set(i_l[r])) / k
+                           for r in range(50)])
+        assert overlap >= 0.9, overlap
+        np.testing.assert_allclose(np.asarray(d_r), np.asarray(d_l),
+                                   rtol=0.05, atol=0.05)
+
+    def test_bad_scan_mode(self):
+        import pytest
+        import jax
+        from raft_tpu.core.error import LogicError
+        from raft_tpu.neighbors import ivf_pq
+        key = jax.random.key(10)
+        db = jax.random.normal(key, (300, 16))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=4,
+                                                  kmeans_n_iters=2))
+        with pytest.raises(LogicError):
+            ivf_pq.search(idx, db[:5], 3,
+                          ivf_pq.SearchParams(scan_mode="nope"))
