@@ -25,7 +25,7 @@ extern "C" {
 // Version
 // ---------------------------------------------------------------------------
 
-int rth_abi_version() { return 1; }
+int rth_abi_version() { return 2; }
 
 // ---------------------------------------------------------------------------
 // Logging core (reference core/logger.hpp:118-251: level gating + callback
@@ -168,6 +168,69 @@ int rth_extract_flattened(int64_t n, const int64_t* children,
     labels[i] = static_cast<int32_t>(it - uniq.begin());
   }
   return static_cast<int>(uniq.size());
+}
+
+// ---------------------------------------------------------------------------
+// Borůvka minimum spanning forest (reference sparse/solver/detail/
+// mst_solver_inl.cuh:117 — the reference contracts components with CUDA
+// atomics; this is the host union-find formulation over the same
+// altered-weight tie-break, used by single-linkage and graph algos).
+// ---------------------------------------------------------------------------
+
+// Inputs: n vertices, m undirected edges (src/dst/altered weights for
+// selection + original weights to report). Outputs (capacity n-1):
+// out_src/out_dst/out_w; out_comp (capacity n) holds final component
+// labels (root ids). Returns the number of MSF edges written, or -2 on
+// invalid vertex ids.
+int64_t rth_boruvka_mst(int64_t n, int64_t m, const int64_t* src,
+                        const int64_t* dst, const double* altered_w,
+                        const double* orig_w, int64_t* out_src,
+                        int64_t* out_dst, double* out_w,
+                        int64_t* out_comp) {
+  std::vector<int64_t> parent(n);
+  std::iota(parent.begin(), parent.end(), int64_t{0});
+  auto find = [&parent](int64_t a) {
+    int64_t root = a;
+    while (parent[root] != root) root = parent[root];
+    while (parent[a] != root) {
+      int64_t next = parent[a];
+      parent[a] = root;
+      a = next;
+    }
+    return root;
+  };
+  for (int64_t e = 0; e < m; ++e)
+    if (src[e] < 0 || src[e] >= n || dst[e] < 0 || dst[e] >= n) return -2;
+
+  std::vector<int64_t> best(n);  // best outgoing edge per component root
+  int64_t n_out = 0;
+  bool merged = true;
+  while (merged) {
+    merged = false;
+    std::fill(best.begin(), best.end(), int64_t{-1});
+    for (int64_t e = 0; e < m; ++e) {
+      const int64_t ra = find(src[e]);
+      const int64_t rb = find(dst[e]);
+      if (ra == rb) continue;
+      if (best[ra] < 0 || altered_w[e] < altered_w[best[ra]]) best[ra] = e;
+      if (best[rb] < 0 || altered_w[e] < altered_w[best[rb]]) best[rb] = e;
+    }
+    for (int64_t v = 0; v < n; ++v) {
+      const int64_t e = best[v];
+      if (e < 0 || find(v) != v) continue;  // roots only
+      const int64_t ra = find(src[e]);
+      const int64_t rb = find(dst[e]);
+      if (ra == rb) continue;  // both endpoints picked the same edge
+      parent[ra] = rb;
+      out_src[n_out] = src[e];
+      out_dst[n_out] = dst[e];
+      out_w[n_out] = orig_w[e];
+      ++n_out;
+      merged = true;
+    }
+  }
+  for (int64_t v = 0; v < n; ++v) out_comp[v] = find(v);
+  return n_out;
 }
 
 }  // extern "C"

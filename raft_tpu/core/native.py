@@ -19,6 +19,7 @@ from typing import Optional
 import numpy as np
 
 _LIB_NAME = "libraft_tpu_host.so"
+_ABI = 2  # must match rth_abi_version() in _cpp/raft_tpu_host.cpp
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
@@ -65,6 +66,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rth_extract_flattened.restype = ctypes.c_int
     lib.rth_extract_flattened.argtypes = [
         ctypes.c_int64, i64p, ctypes.c_int64, i32p]
+    lib.rth_boruvka_mst.restype = ctypes.c_int64
+    lib.rth_boruvka_mst.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, i64p, i64p, f64p, f64p,
+        i64p, i64p, f64p, i64p]
     return lib
 
 
@@ -83,14 +88,32 @@ def load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(path) and not _try_build():
             _load_failed = True
             return None
+
+        def _open():
+            raw = ctypes.CDLL(path)
+            try:
+                lib = _configure(raw)
+                if lib.rth_abi_version() != _ABI:
+                    raise OSError("ABI mismatch")
+            except (OSError, AttributeError):
+                # release the handle: a later CDLL(path) after rebuild
+                # must not get this already-mapped stale image back
+                import _ctypes
+                _ctypes.dlclose(raw._handle)
+                raise
+            return lib
+
         try:
-            lib = _configure(ctypes.CDLL(path))
-            if lib.rth_abi_version() != 1:
+            _lib = _open()
+        except (OSError, AttributeError):
+            # stale library from an older source revision: rebuild once
+            if _try_build():
+                try:
+                    _lib = _open()
+                except (OSError, AttributeError):
+                    _load_failed = True
+            else:
                 _load_failed = True
-                return None
-            _lib = lib
-        except OSError:
-            _load_failed = True
         return _lib
 
 
@@ -143,6 +166,34 @@ def extract_flattened(children, n: int, n_merges: int):
     if rc < 0:
         raise ValueError(f"extract_flattened: invalid input (rc={rc})")
     return labels
+
+
+def boruvka_mst(n: int, src, dst, altered_w, orig_w):
+    """Native Borůvka minimum spanning forest → (mst_src, mst_dst,
+    mst_weight, component_labels), or None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    if n < 0:
+        raise ValueError("boruvka_mst: negative vertex count")
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    altered_w = np.ascontiguousarray(altered_w, np.float64)
+    orig_w = np.ascontiguousarray(orig_w, np.float64)
+    m = src.shape[0]
+    if (dst.shape != (m,) or altered_w.shape != (m,)
+            or orig_w.shape != (m,)):
+        raise ValueError("boruvka_mst: edge array length mismatch")
+    cap = max(int(n) - 1, 1)
+    out_s = np.empty(cap, np.int64)
+    out_d = np.empty(cap, np.int64)
+    out_w = np.empty(cap, np.float64)
+    out_c = np.empty(max(int(n), 1), np.int64)
+    rc = lib.rth_boruvka_mst(n, m, src, dst, altered_w, orig_w,
+                             out_s, out_d, out_w, out_c)
+    if rc < 0:
+        raise ValueError(f"boruvka_mst: invalid edges (rc={rc})")
+    return out_s[:rc], out_d[:rc], out_w[:rc], out_c[:int(n)]
 
 
 def log(level: int, msg: str) -> bool:

@@ -7,10 +7,11 @@ weight-alteration trick to break ties deterministically
 
 TPU/host split: MST contraction is irregular pointer-chasing — the
 reference itself runs the union bookkeeping in device kernels with
-atomics, which have no TPU analogue. Here the per-round min-edge
-selection is a vectorized segmented argmin (numpy on host; arrays arrive
-from device once), and rounds are O(log n). The same weight-alteration
-tie-break is applied so the MST is unique and deterministic.
+atomics, which have no TPU analogue. The preferred path is the native
+C++ Borůvka (raft_tpu/_cpp/raft_tpu_host.cpp rth_boruvka_mst, union-find
+per round); the fallback below is a vectorized numpy segmented argmin.
+Both apply the same weight-alteration tie-break, so the MSF is unique
+and identical across paths.
 """
 
 from __future__ import annotations
@@ -49,6 +50,14 @@ def boruvka_mst_edges(n: int, src, dst, weight
     dst = np.asarray(dst, np.int64)
     w_orig = np.asarray(weight, np.float64)
     aw = _alter_weights(w_orig, src, dst)
+
+    # native C++ Borůvka when available (raft_tpu/_cpp; same altered
+    # weights → identical unique MSF); numpy segmented-argmin fallback
+    from raft_tpu.core import native
+    if len(src) and native.available():
+        nat = native.boruvka_mst(n, src, dst, aw, w_orig)
+        if nat is not None:
+            return nat
 
     comp = np.arange(n, dtype=np.int64)
     out_s, out_d, out_w = [], [], []

@@ -103,3 +103,39 @@ class TestSingleLinkageEndToEnd:
         # each blob uniform
         for s in (slice(0, 40), slice(40, 80), slice(80, 120)):
             assert len(np.unique(labels[s])) == 1
+
+
+class TestBoruvkaNative:
+    def test_mst_parity_with_numpy(self, monkeypatch):
+        from raft_tpu.sparse.solver.mst import boruvka_mst_edges
+        rng = np.random.default_rng(7)
+        n, m = 200, 1500
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m)
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+        w = rng.random(len(src))
+        s_n, d_n, w_n, c_n = boruvka_mst_edges(n, src, dst, w)
+        _force_python(monkeypatch)
+        s_p, d_p, w_p, c_p = boruvka_mst_edges(n, src, dst, w)
+        # identical unique MSF: same total weight, same edge count, same
+        # component partition
+        assert len(s_n) == len(s_p)
+        np.testing.assert_allclose(np.sort(w_n), np.sort(w_p), rtol=1e-12)
+        edges_n = {frozenset((a, b)) for a, b in zip(s_n, d_n)}
+        edges_p = {frozenset((a, b)) for a, b in zip(s_p, d_p)}
+        assert edges_n == edges_p
+        # same partition (labels up to renaming)
+        remap = {}
+        for a, b in zip(c_n, c_p):
+            assert remap.setdefault(a, b) == b
+
+    def test_disconnected_forest(self, monkeypatch):
+        from raft_tpu.sparse.solver.mst import boruvka_mst_edges
+        # two components: 0-1-2 and 3-4
+        src = np.array([0, 1, 3])
+        dst = np.array([1, 2, 4])
+        w = np.array([1.0, 2.0, 3.0])
+        s, d, wts, comp = boruvka_mst_edges(5, src, dst, w)
+        assert len(s) == 3
+        assert len(np.unique(comp)) == 2
