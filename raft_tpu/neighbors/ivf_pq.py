@@ -227,6 +227,55 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
                  pq_bits=params.pq_bits, size=n)
 
 
+def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
+    """Add vectors to an existing index (reference ``ivf_pq::extend``,
+    ivf_pq_build.cuh:605): label against the trained centers, encode
+    residuals with the FROZEN codebooks/rotation, and re-bucket the
+    combined code set. Returns a new Index; the reconstruction cache is
+    re-derived lazily."""
+    x = as_array(new_vectors).astype(jnp.float32)
+    expects(x.ndim == 2 and x.shape[1] == index.dim,
+            "ivf_pq.extend: dim mismatch")
+    n_new = x.shape[0]
+    new_ids = (jnp.arange(index.size, index.size + n_new, dtype=jnp.int32)
+               if new_indices is None
+               else as_array(new_indices).astype(jnp.int32))
+    expects(new_ids.shape == (n_new,), "ivf_pq.extend: bad new_indices")
+    expects(bool((new_ids >= 0).all()),
+            "ivf_pq.extend: new_indices must be non-negative (negative "
+            "ids are the padding sentinel)")
+
+    labels = kmeans_balanced.predict(x, index.centers, res=res)
+    residuals_rot = jnp.matmul(x - index.centers[labels],
+                               index.rotation_matrix.T,
+                               precision=matmul_precision())
+    new_codes = _encode(residuals_rot, index.pq_centers)  # (n_new, pq_dim)
+
+    # flatten existing valid slots back to (n_old, pq_dim) + their ids
+    flat_codes = index.codes.reshape(-1, index.pq_dim)
+    flat_ids = index.lists_indices.reshape(-1)
+    n_lists, max_list = index.lists_indices.shape
+    old_list = jnp.repeat(jnp.arange(n_lists, dtype=jnp.int32), max_list)
+    valid = flat_ids >= 0  # eager boolean mask, as in ivf_flat.extend
+    n_old = int(index.size)
+    all_codes = jnp.concatenate([flat_codes[valid], new_codes], axis=0)
+    all_labels = jnp.concatenate([old_list[valid], labels], axis=0)
+    all_ids = jnp.concatenate([flat_ids[valid], new_ids], axis=0)
+
+    bucketed, slot_idx, _, counts = _bucketize(
+        all_codes.astype(jnp.float32), all_labels, n_lists)
+    # _bucketize stores row positions; map back to the caller ids
+    idx = jnp.where(slot_idx >= 0, all_ids[jnp.clip(slot_idx, 0, None)],
+                    jnp.int32(-1))
+    return Index(centers=index.centers, centers_rot=index.centers_rot,
+                 rotation_matrix=index.rotation_matrix,
+                 pq_centers=index.pq_centers,
+                 codes=bucketed.astype(jnp.uint8),
+                 lists_indices=idx, list_sizes=counts,
+                 metric=index.metric, pq_bits=index.pq_bits,
+                 size=n_old + n_new)
+
+
 @jax.jit
 def _decode_lists(codes_b, pq_centers, lists_indices):
     """Decode bucketed PQ codes → bf16 reconstruction cache

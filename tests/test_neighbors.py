@@ -324,3 +324,41 @@ class TestIvfPqScanModes:
         with pytest.raises(LogicError):
             ivf_pq.search(idx, db[:5], 3,
                           ivf_pq.SearchParams(scan_mode="nope"))
+
+
+class TestIvfPqExtend:
+    def test_extend_then_search_finds_new_vectors(self):
+        import jax
+        from raft_tpu.neighbors import ivf_pq
+        key = jax.random.key(11)
+        db = jax.random.normal(key, (1000, 32))
+        extra = jax.random.normal(jax.random.fold_in(key, 1), (200, 32))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=8,
+                                                  kmeans_n_iters=4))
+        idx2 = ivf_pq.extend(idx, extra)
+        assert idx2.size == 1200
+        # searching for the extra vectors themselves must surface their
+        # new ids (1000..1199) among top hits for most queries
+        _, ids = ivf_pq.search(idx2, extra[:50], 5,
+                               ivf_pq.SearchParams(n_probes=8))
+        ids = np.asarray(ids)
+        hit = np.mean([(ids[r] >= 1000).any() for r in range(50)])
+        assert hit >= 0.8, hit
+        # original vectors still retrievable
+        _, ids0 = ivf_pq.search(idx2, db[:50], 5,
+                                ivf_pq.SearchParams(n_probes=8))
+        ids0 = np.asarray(ids0)
+        assert np.mean([(ids0[r] < 1000).any() for r in range(50)]) >= 0.9
+
+    def test_extend_custom_indices(self):
+        import jax
+        from raft_tpu.neighbors import ivf_pq
+        key = jax.random.key(12)
+        db = jax.random.normal(key, (500, 16))
+        extra = jax.random.normal(jax.random.fold_in(key, 1), (50, 16))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=4,
+                                                  kmeans_n_iters=3))
+        custom = np.arange(9000, 9050, dtype=np.int32)
+        idx2 = ivf_pq.extend(idx, extra, new_indices=custom)
+        all_ids = np.asarray(idx2.lists_indices).reshape(-1)
+        assert set(custom) <= set(all_ids[all_ids >= 0])
