@@ -30,8 +30,32 @@ __version__ = "0.1.0"
 
 from raft_tpu.core.resources import Resources, DeviceResources
 
+# pylibraft spells the resource context ``Handle`` (common/handle.pyx:30)
+Handle = DeviceResources
+
+_SUBPACKAGES = (
+    "cluster", "comms", "core", "distance", "label", "linalg", "matrix",
+    "neighbors", "ops", "parallel", "random", "solver", "sparse",
+    "spectral", "stats",
+)
+
 __all__ = [
     "Resources",
     "DeviceResources",
+    "Handle",
     "__version__",
+    *_SUBPACKAGES,
 ]
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(__all__)))
+
+
+def __getattr__(name):
+    # lazy subpackage import (PEP 562): `import raft_tpu` stays light but
+    # `raft_tpu.neighbors...` works without explicit submodule imports
+    if name in _SUBPACKAGES:
+        import importlib
+        return importlib.import_module(f"raft_tpu.{name}")
+    raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")

@@ -15,7 +15,7 @@ TPU re-design highlights:
 
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.selection import select_k
-from raft_tpu.neighbors.brute_force import knn, brute_force_knn, knn_merge_parts, fused_l2_knn
+from raft_tpu.neighbors.brute_force import knn, brute_force_knn, knn_merge_parts, fused_l2_knn, haversine_knn
 from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors_l2sq
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
@@ -26,6 +26,7 @@ from raft_tpu.neighbors import serialize
 __all__ = [
     "IndexParams", "SearchParams",
     "select_k", "knn", "brute_force_knn", "knn_merge_parts", "fused_l2_knn",
+    "haversine_knn",
     "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ball_cover", "refine",
     "serialize",
 ]

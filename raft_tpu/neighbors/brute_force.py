@@ -169,6 +169,16 @@ def knn_merge_parts(part_dists, part_indices, k: int, select_min: bool = True,
     return sign * -nd, jnp.take_along_axis(i, sel, axis=1)
 
 
+def haversine_knn(db, queries, k: int, res=None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """k-NN under the haversine great-circle metric over (lat, lon)
+    radian pairs (reference ``spatial/knn/detail/haversine_distance.cuh``
+    — a bespoke brute-force kernel there; here the generic scan with the
+    haversine core)."""
+    return brute_force_knn(db, queries, k, DistanceType.Haversine,
+                           res=res)
+
+
 def fused_l2_knn(db, queries, k: int, sqrt: bool = False, res=None
                  ) -> Tuple[jax.Array, jax.Array]:
     """L2 k-NN without materializing distances (reference

@@ -362,3 +362,26 @@ class TestIvfPqExtend:
         idx2 = ivf_pq.extend(idx, extra, new_indices=custom)
         all_ids = np.asarray(idx2.lists_indices).reshape(-1)
         assert set(custom) <= set(all_ids[all_ids >= 0])
+
+
+class TestHaversineKnn:
+    def test_matches_direct_formula(self):
+        from raft_tpu.neighbors import haversine_knn
+        rng = np.random.default_rng(13)
+        pts = np.stack([rng.uniform(-np.pi / 2, np.pi / 2, 300),
+                        rng.uniform(-np.pi, np.pi, 300)], axis=1)
+        q = pts[:10]
+        d, i = haversine_knn(pts.astype(np.float32),
+                             q.astype(np.float32), 3)
+        # naive haversine reference
+        lat1, lon1 = q[:, None, 0], q[:, None, 1]
+        lat2, lon2 = pts[None, :, 0], pts[None, :, 1]
+        h = (np.sin((lat2 - lat1) / 2) ** 2
+             + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2)
+        ref = 2 * np.arcsin(np.sqrt(np.clip(h, 0, 1)))
+        ref_i = np.argsort(ref, axis=1)[:, :3]
+        # self is always the nearest
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(10))
+        overlap = np.mean([len(set(np.asarray(i)[r]) & set(ref_i[r])) / 3
+                           for r in range(10)])
+        assert overlap >= 0.9
