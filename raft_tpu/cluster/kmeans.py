@@ -27,7 +27,7 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
-from raft_tpu.distance.fused_l2_nn import _fused_l2_nn
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 
 
@@ -42,9 +42,10 @@ def _weighted_update(x, labels, weights, n_clusters: int):
 
 
 def _assign(x, centroids):
-    """(labels, sq-dists) of each point to its nearest centroid."""
-    idx, d = _fused_l2_nn(x, centroids, False)
-    return idx, d
+    """(labels, sq-dists) of each point to its nearest centroid — via the
+    public fused_l2_nn (Pallas kernel on TPU)."""
+    kv = fused_l2_nn(x, centroids, sqrt=False)
+    return kv.key, kv.value
 
 
 @functools.partial(jax.jit, static_argnames=("n_clusters", "max_iter"))
