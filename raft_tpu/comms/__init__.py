@@ -26,6 +26,7 @@ from raft_tpu.comms.collective_checks import (
     test_commsplit,
 )
 from raft_tpu.comms.bootstrap import Session, local_handle, initialize_distributed
+from raft_tpu.comms.host_p2p import HostP2P, Request
 
 __all__ = [
     "Comms", "ReduceOp", "Status", "build_comms", "inject_comms",
@@ -34,4 +35,5 @@ __all__ = [
     "test_collective_gather", "test_collective_reducescatter",
     "test_pointToPoint_simple_send_recv", "test_commsplit",
     "Session", "local_handle", "initialize_distributed",
+    "HostP2P", "Request",
 ]

@@ -53,7 +53,13 @@ class Session:
 
     def __init__(self, axis_names: Tuple[str, ...] = ("data",),
                  mesh_shape: Optional[Tuple[int, ...]] = None,
-                 devices: Optional[Sequence[jax.Device]] = None):
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 name: str = "default"):
+        # ``name`` must agree across SPMD processes (it scopes the host
+        # p2p key namespace); session_id is process-local, for the
+        # local_handle registry (the reference generates sessionId on the
+        # client and ships it to workers — ``name`` plays that part)
+        self.name = name
         self.session_id = uuid.uuid4().hex[:16]
         self._axis_names = axis_names
         self._mesh_shape = mesh_shape
@@ -82,9 +88,25 @@ class Session:
             _sessions[self.session_id] = self
         return self
 
+    def host_p2p(self) -> "HostP2P":
+        """Tagged host p2p channel among this session's processes (the
+        UCX-endpoints role, reference comms.py:574+ _func_ucp_create_
+        endpoints). Rank/size are process-level (one channel per host
+        process, like one UCX worker per Dask worker). One channel per
+        Session: repeated calls return the same instance (sequence
+        numbers must not reset against live coordination-service keys)."""
+        from raft_tpu.comms.host_p2p import HostP2P
+        expects(self.mesh is not None, "Session not initialized")
+        if getattr(self, "_host_p2p", None) is None:
+            self._host_p2p = HostP2P(jax.process_index(),
+                                     jax.process_count(),
+                                     session=self.name)
+        return self._host_p2p
+
     def destroy(self) -> None:
         with _lock:
             _sessions.pop(self.session_id, None)
+        self._host_p2p = None
         self.mesh = None
         self.resources = None
         self.comms = None

@@ -226,7 +226,7 @@ class Comms:
     # -- p2p (core/comms.hpp device_send/recv; ppermute is the ICI path).
     # XLA needs the full (src, dst) pattern statically, so the tagged
     # dynamic send/recv of the reference becomes device_send_recv(perm) /
-    # ring_permute; arbitrary host tagged p2p lives in bootstrap.Session.
+    # ring_permute; arbitrary host tagged p2p lives in comms.host_p2p.
     def ring_permute(self, x, shift: int = 1):
         """collective_permute around the ring (within each subgroup for a
         split comm) — the merge primitive for sharded top-k (SURVEY.md §5

@@ -1,0 +1,168 @@
+"""Tagged host-side point-to-point messaging (the UCX role).
+
+Reference: ``comms_t``'s host ``isend/irecv/waitall`` are served by UCX
+endpoints with a progress-loop timeout (``std_comms.hpp:209-305``); they
+exist so algorithms can exchange small host metadata (sizes, plans,
+handshakes) without a device collective.
+
+TPU-native equivalent: the JAX coordination service (the same
+distributed runtime that bootstraps multi-host meshes) exposes a
+key-value store reachable from every process over DCN. Tagged messages
+become KV entries ``p2p/<src>-><dst>/<tag>/<seq>``; ``irecv`` blocks on
+the key with a timeout — giving the reference's waitall-with-timeout
+failure semantics (``Status.ABORT`` instead of a hang,
+``std_comms.hpp:246-249``). In single-process settings (tests, one-host
+meshes) an in-memory registry serves the same API.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from raft_tpu.comms.comms import Status
+from raft_tpu.core.error import expects
+
+
+def _coordination_client():
+    """The process-global coordination-service client, or None."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+class _InProcessRegistry:
+    """Shared mailbox for ranks living in one process (test meshes)."""
+
+    def __init__(self):
+        self._boxes: Dict[Tuple[str, int, int, int, int], queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def box(self, session: str, src: int, dst: int, tag: int,
+            seq: int) -> queue.Queue:
+        key = (session, src, dst, tag, seq)
+        with self._lock:
+            if key not in self._boxes:
+                self._boxes[key] = queue.Queue()
+            return self._boxes[key]
+
+
+# ranks of a single-process clique share this registry by default, so two
+# HostP2P instances can talk without explicit plumbing
+_default_registry = _InProcessRegistry()
+
+
+@dataclass
+class Request:
+    """A pending send/recv (reference ``request_t``)."""
+
+    _wait: object                      # callable(timeout_s) -> bytes|None
+    done: bool = False
+    payload: Optional[bytes] = None
+
+    def wait(self, timeout_s: Optional[float] = None) -> Status:
+        if self.done:
+            return Status.SUCCESS
+        out = self._wait(timeout_s)
+        if out is None:
+            return Status.ABORT
+        self.payload = out
+        self.done = True
+        return Status.SUCCESS
+
+
+class HostP2P:
+    """Tagged host p2p between the ranks of a comms clique.
+
+    ``session`` scopes keys so concurrent cliques don't collide (the
+    role of the UCX worker per comm). Messages with the same
+    (src, dst, tag) are ordered by an internal sequence number.
+    """
+
+    def __init__(self, rank: int, size: int, session: str = "default",
+                 registry: Optional[_InProcessRegistry] = None):
+        expects(0 <= rank < size, "HostP2P: bad rank")
+        self.rank = rank
+        self.size = size
+        self.session = session
+        self._client = None if registry is not None else _coordination_client()
+        self._registry = registry
+        if self._client is None and self._registry is None:
+            self._registry = _default_registry
+        self._send_seq: Dict[Tuple[int, int], int] = {}
+        self._recv_seq: Dict[Tuple[int, int], int] = {}
+
+    # -- helpers ----------------------------------------------------------
+    def _key(self, src: int, dst: int, tag: int, seq: int) -> str:
+        return f"raft_tpu/p2p/{self.session}/{src}->{dst}/{tag}/{seq}"
+
+    def _next_seq(self, table, src: int, dst: int, tag: int) -> int:
+        k = (src * self.size + dst, tag)
+        s = table.get(k, 0)
+        table[k] = s + 1
+        return s
+
+    # -- API (reference core/comms.hpp isend/irecv/waitall) ---------------
+    def isend(self, payload: bytes, dest: int, tag: int = 0) -> Request:
+        """Post a tagged send; completes eagerly (buffered semantics,
+        like the reference's UCX eager protocol for small messages)."""
+        expects(0 <= dest < self.size, "isend: bad dest rank")
+        seq = self._next_seq(self._send_seq, self.rank, dest, tag)
+        if self._client is not None:
+            # value must be str for the coordination KV store
+            self._client.key_value_set(
+                self._key(self.rank, dest, tag, seq),
+                payload.decode("latin-1"))
+        else:
+            self._registry.box(self.session, self.rank, dest, tag,
+                               seq).put(payload)
+        return Request(_wait=lambda t: payload, done=True, payload=payload)
+
+    def irecv(self, source: int, tag: int = 0) -> Request:
+        """Post a tagged receive; ``wait()`` blocks with timeout."""
+        expects(0 <= source < self.size, "irecv: bad source rank")
+        seq = self._next_seq(self._recv_seq, source, self.rank, tag)
+        if self._client is not None:
+            key = self._key(source, self.rank, tag, seq)
+            client = self._client
+
+            def waiter(timeout_s):
+                try:
+                    ms = int((timeout_s if timeout_s is not None else 600.0)
+                             * 1000)
+                    return client.blocking_key_value_get(
+                        key, ms).encode("latin-1")
+                except Exception as e:  # timeout → ABORT; real RPC/
+                    # coordinator failures must surface, not masquerade
+                    # as a peer timeout
+                    msg = str(e).upper()
+                    if "DEADLINE" in msg or "TIMEOUT" in msg:
+                        return None
+                    raise
+        else:
+            box = self._registry.box(self.session, source, self.rank,
+                                     tag, seq)
+
+            def waiter(timeout_s):
+                try:
+                    return box.get(timeout=timeout_s)
+                except queue.Empty:
+                    return None
+        return Request(_wait=waiter)
+
+    def waitall(self, requests, timeout_s: Optional[float] = 10.0) -> Status:
+        """Progress all requests; any timing out → ABORT (the reference's
+        10 s UCX progress timeout, std_comms.hpp:246-249)."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        for r in requests:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if r.wait(remaining) != Status.SUCCESS:
+                return Status.ABORT
+        return Status.SUCCESS

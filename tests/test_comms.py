@@ -241,3 +241,108 @@ class TestDistributedIvf:
                                                       kmeans_n_iters=2))
         with pytest.raises(LogicError):
             shard_ivf_flat(idx, self._mesh())
+
+
+class TestHostP2P:
+    """Tagged host p2p (raft_tpu/comms/host_p2p.py — the UCX role,
+    reference std_comms.hpp:209-305)."""
+
+    def test_in_process_send_recv(self):
+        from raft_tpu.comms.host_p2p import HostP2P, _InProcessRegistry
+        from raft_tpu.comms.comms import Status
+        reg = _InProcessRegistry()
+        r0 = HostP2P(0, 2, registry=reg)
+        r1 = HostP2P(1, 2, registry=reg)
+        s = r0.isend(b"hello", dest=1, tag=7)
+        r = r1.irecv(source=0, tag=7)
+        assert r1.waitall([s, r], timeout_s=2.0) == Status.SUCCESS
+        assert r.payload == b"hello"
+
+    def test_tag_isolation_and_ordering(self):
+        from raft_tpu.comms.host_p2p import HostP2P, _InProcessRegistry
+        from raft_tpu.comms.comms import Status
+        reg = _InProcessRegistry()
+        r0 = HostP2P(0, 2, registry=reg)
+        r1 = HostP2P(1, 2, registry=reg)
+        r0.isend(b"a-first", 1, tag=1)
+        r0.isend(b"b", 1, tag=2)
+        r0.isend(b"a-second", 1, tag=1)
+        rb = r1.irecv(0, tag=2)
+        ra1 = r1.irecv(0, tag=1)
+        ra2 = r1.irecv(0, tag=1)
+        assert r1.waitall([rb, ra1, ra2]) == Status.SUCCESS
+        assert rb.payload == b"b"
+        assert ra1.payload == b"a-first"      # per-tag FIFO
+        assert ra2.payload == b"a-second"
+
+    def test_waitall_timeout_aborts(self):
+        from raft_tpu.comms.host_p2p import HostP2P, _InProcessRegistry
+        from raft_tpu.comms.comms import Status
+        reg = _InProcessRegistry()
+        r1 = HostP2P(1, 2, registry=reg)
+        r = r1.irecv(source=0, tag=0)  # nothing ever sent
+        assert r1.waitall([r], timeout_s=0.05) == Status.ABORT
+
+    def test_multiprocess_coordination_service(self, tmp_path):
+        """Real two-process exchange over jax.distributed's KV store —
+        the reference's real-local-cluster comms test strategy
+        (SURVEY.md §4)."""
+        import subprocess, sys, textwrap, socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        prog = textwrap.dedent(f"""
+            import sys
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            pid = int(sys.argv[1])
+            jax.distributed.initialize(
+                coordinator_address="127.0.0.1:{port}",
+                num_processes=2, process_id=pid)
+            from raft_tpu.comms.host_p2p import HostP2P
+            from raft_tpu.comms.comms import Status
+            p = HostP2P(pid, 2, session="t")
+            if pid == 0:
+                p.isend(b"from-zero", dest=1, tag=3)
+                r = p.irecv(source=1, tag=4)
+            else:
+                p.isend(b"from-one", dest=0, tag=4)
+                r = p.irecv(source=0, tag=3)
+            assert p.waitall([r], timeout_s=30.0) == Status.SUCCESS
+            expected = b"from-one" if pid == 0 else b"from-zero"
+            assert r.payload == expected, r.payload
+            print("OK", pid)
+        """)
+        f = tmp_path / "worker.py"
+        f.write_text(prog)
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen([sys.executable, str(f), str(i)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, env=env)
+                 for i in range(2)]
+        outs = [p.communicate(timeout=120) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, (out, err[-2000:])
+            assert b"OK" in out
+
+    def test_default_registry_shared_in_process(self):
+        from raft_tpu.comms.host_p2p import HostP2P
+        from raft_tpu.comms.comms import Status
+        a = HostP2P(0, 2, session="shared-default-test")
+        b = HostP2P(1, 2, session="shared-default-test")
+        a.isend(b"x", dest=1, tag=0)
+        r = b.irecv(source=0, tag=0)
+        assert b.waitall([r], timeout_s=2.0) == Status.SUCCESS
+        assert r.payload == b"x"
+
+    def test_session_host_p2p_cached_and_named(self):
+        from raft_tpu.comms.bootstrap import Session
+        with Session(name="p2p-test") as s:
+            p1 = s.host_p2p()
+            p2 = s.host_p2p()
+            assert p1 is p2
+            assert p1.session == "p2p-test"
