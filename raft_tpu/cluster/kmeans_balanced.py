@@ -101,44 +101,44 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
         xt = x
     nt = xt.shape[0]
 
-    # TPU-first: up to a few thousand centers, flat EM at full k is a
-    # single compile of pure MXU work (the fused argmin handles
+    # TPU-first: up to tens of thousands of centers, flat EM at full k is
+    # a single compile of pure MXU work (the fused argmin tiles
     # n_rows × k × dim at ~peak); the reference's two-level hierarchy
     # (built to bound CUDA fusedL2NN cost) only pays for itself beyond
-    # that — and its per-mesocluster shapes would trigger one XLA
+    # that — and naive per-mesocluster shapes would trigger one XLA
     # recompile each (SURVEY.md hard part (c)).
-    if n_clusters <= 4096:
+    if n_clusters <= 16384:
         return balanced_kmeans(xt, n_clusters, n_iters, seed=seed, res=res)
 
+    # two-level path, shape-bucketed so XLA compiles O(log) variants, not
+    # O(n_meso): uniform fine allocation (one km for every mesocluster —
+    # the trainer is balanced by construction) and per-meso point sets
+    # padded to the next power of two by cyclic repetition (preserves the
+    # empirical distribution seen by EM).
     n_meso = int(math.isqrt(n_clusters))
+    km = -(-n_clusters // n_meso)  # uniform fine centers per meso
     meso_centers = balanced_kmeans(xt, n_meso, n_iters, seed=seed, res=res)
     meso_labels = predict(xt, meso_centers, res=res)
-    counts = jax.device_get(jax.ops.segment_sum(
-        jnp.ones((nt,), jnp.int32), meso_labels, num_segments=n_meso))
-
-    # proportional fine-cluster allocation (reference assigns
-    # fine-per-meso ∝ mesocluster size, at least 1)
-    alloc = [max(1, round(n_clusters * c / max(1, nt))) for c in counts]
-    # fix rounding drift
-    while sum(alloc) > n_clusters:
-        alloc[alloc.index(max(alloc))] -= 1
-    while sum(alloc) < n_clusters:
-        alloc[alloc.index(max(alloc))] += 1
-
     meso_np = jax.device_get(meso_labels)
+
     centers = []
     for m in range(n_meso):
         pts = xt[meso_np == m]
-        km = alloc[m]
         if pts.shape[0] == 0:
-            centers.append(jnp.broadcast_to(meso_centers[m], (km, x.shape[1])))
-        elif pts.shape[0] <= km:
+            centers.append(jnp.broadcast_to(meso_centers[m],
+                                            (km, x.shape[1])))
+            continue
+        if pts.shape[0] <= km:
             pad = jnp.broadcast_to(meso_centers[m],
                                    (km - pts.shape[0], x.shape[1]))
             centers.append(jnp.concatenate([pts, pad], axis=0))
-        else:
-            centers.append(balanced_kmeans(pts, km, max(4, n_iters // 2),
-                                           seed=seed + m + 1, res=res))
-    all_centers = jnp.concatenate(centers, axis=0)
+            continue
+        target = 1 << max(km.bit_length(),
+                          (pts.shape[0] - 1).bit_length())
+        reps = -(-target // pts.shape[0])
+        pts_p = jnp.tile(pts, (reps, 1))[:target]
+        centers.append(balanced_kmeans(pts_p, km, max(4, n_iters // 2),
+                                       seed=seed + m + 1, res=res))
+    all_centers = jnp.concatenate(centers, axis=0)[:n_clusters]
     # final balancing sweeps over the full center set
     return _em(xt, all_centers, n_clusters, max(2, n_iters // 4), 0.25)
