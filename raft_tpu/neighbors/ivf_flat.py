@@ -46,6 +46,12 @@ class IndexParams:
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
     adaptive_centers: bool = False
+    # list storage dtype: "float32" | "bfloat16" | "int8". The reference
+    # indexes f32/f16/u8/s8 datasets (ivf_flat_types.hpp index<T>,
+    # quantized dtypes via the kDivisor convention, ann_utils.cuh:79);
+    # here narrower storage halves/quarters the HBM bytes the probe
+    # scans gather — the search bottleneck — at a small recall cost.
+    storage_dtype: str = "float32"
 
 
 @dataclass
@@ -58,7 +64,9 @@ class SearchParams:
 @dataclass
 class Index:
     """IVF-Flat index (reference ``ivf_flat::index``): cluster centers +
-    padded per-list data/indices/norms."""
+    padded per-list data/indices/norms. ``lists_data`` may be stored
+    narrow (bf16/int8); ``scale`` dequantizes int8 (value ≈ stored *
+    scale — the kDivisor convention, reference ann_utils.cuh:79-123)."""
 
     centers: jax.Array          # (n_lists, dim)
     lists_data: jax.Array       # (n_lists, max_list, dim)
@@ -67,6 +75,7 @@ class Index:
     list_sizes: jax.Array       # (n_lists,) int32
     metric: DistanceType
     size: int
+    scale: float = 1.0
 
     @property
     def n_lists(self) -> int:
@@ -127,9 +136,30 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         trainset, params.n_lists, params.kmeans_n_iters, res=res)
     labels = kmeans_balanced.predict(x, centers, res=res)
     data, idx, norms, counts = _bucketize(x, labels, params.n_lists)
+    data, norms, scale = _quantize_lists(data, norms, params.storage_dtype)
     return Index(centers=centers, lists_data=data, lists_indices=idx,
                  lists_norms=norms, list_sizes=counts,
-                 metric=params.metric, size=n)
+                 metric=params.metric, size=n, scale=scale)
+
+
+def _quantize_lists(data, norms, storage_dtype: str):
+    """Narrow the bucketed list storage; for narrow dtypes the norms are
+    recomputed over the dequantized values so probe distances stay
+    self-consistent (f32 keeps the caller's precomputed norms)."""
+    expects(storage_dtype in ("float32", "bfloat16", "int8"),
+            "ivf_flat: storage_dtype must be float32|bfloat16|int8")
+    if storage_dtype == "float32":
+        return data, norms, 1.0
+    if storage_dtype == "bfloat16":
+        q = data.astype(jnp.bfloat16)
+        return (q, jnp.sum(q.astype(jnp.float32) ** 2, axis=2), 1.0)
+    # int8: one global scale (the kDivisor convention uses one fixed
+    # divisor for the whole dataset)
+    max_abs = float(jax.device_get(jnp.max(jnp.abs(data))))
+    scale = max(max_abs, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    deq = q.astype(jnp.float32) * scale
+    return q, jnp.sum(deq * deq, axis=2), scale
 
 
 def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
@@ -138,9 +168,18 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     default; adaptive_centers handled at build)."""
     x_new = as_array(new_vectors).astype(jnp.float32)
     n_lists = index.n_lists
-    # reconstruct flat (data, ids) view of current contents
+    # reconstruct flat (data, ids) view of current contents, dequantized
+    # to f32 (narrow storage is re-applied after re-bucketing)
     valid = index.lists_indices >= 0
     old_data = index.lists_data.reshape(-1, index.dim)[valid.reshape(-1)]
+    if old_data.dtype == jnp.int8:
+        old_data = old_data.astype(jnp.float32) * index.scale
+        storage = "int8"
+    elif old_data.dtype == jnp.bfloat16:
+        old_data = old_data.astype(jnp.float32)
+        storage = "bfloat16"
+    else:
+        storage = "float32"
     old_ids = index.lists_indices.reshape(-1)[valid.reshape(-1)]
     if new_indices is None:
         new_ids = jnp.arange(index.size, index.size + x_new.shape[0],
@@ -153,28 +192,39 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     data, idx, norms, counts = _bucketize(all_data, labels, n_lists)
     # idx holds row positions into all_data; translate to user ids
     idx = jnp.where(idx >= 0, all_ids[jnp.clip(idx, 0, all_ids.shape[0] - 1)], -1)
+    data, norms, scale = _quantize_lists(data, norms, storage)
     return Index(centers=index.centers, lists_data=data, lists_indices=idx,
                  lists_norms=norms, list_sizes=counts, metric=index.metric,
-                 size=index.size + x_new.shape[0])
+                 size=index.size + x_new.shape[0], scale=scale)
 
 
 def _score_probe(queries, qq, lists_data, lists_norms, lists_indices,
-                 list_id):
+                 list_id, scale: float = 1.0):
     """Score one probe rank: per-query (max_list,) distances + ids — the
     fine-phase GEMM shared by single-chip and sharded searches
-    (reference interleaved_scan_kernel, ivf_flat_search.cuh:665)."""
+    (reference interleaved_scan_kernel, ivf_flat_search.cuh:665).
+    Handles narrow list storage: bf16 rides the MXU directly; int8 is
+    dequantized by folding ``scale`` into the accumulated product."""
     data = lists_data[list_id]                  # (nq, max_list, dim)
     ids = lists_indices[list_id]                # (nq, max_list)
-    ip = jnp.einsum("qd,qld->ql", queries, data,
-                    preferred_element_type=jnp.float32,
-                    precision=matmul_precision())
+    if data.dtype == jnp.bfloat16:
+        ip = jnp.einsum("qd,qld->ql", queries.astype(jnp.bfloat16), data,
+                        preferred_element_type=jnp.float32)
+    elif data.dtype == jnp.int8:
+        ip = scale * jnp.einsum("qd,qld->ql", queries,
+                                data.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+    else:
+        ip = jnp.einsum("qd,qld->ql", queries, data,
+                        preferred_element_type=jnp.float32,
+                        precision=matmul_precision())
     d = qq[:, None] + lists_norms[list_id] - 2.0 * ip
     return jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf), ids
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "sqrt"))
 def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
-                 k: int, n_probes: int, sqrt: bool):
+                 scale, k: int, n_probes: int, sqrt: bool):
     nq, dim = queries.shape
 
     # ---- coarse phase (reference ivf_flat_search.cuh:1070-1147):
@@ -187,7 +237,7 @@ def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
     def probe_step(carry, p):
         best_d, best_i = carry
         d, ids = _score_probe(queries, qq, lists_data, lists_norms,
-                              lists_indices, probes[:, p])
+                              lists_indices, probes[:, p], scale)
         cat_d = jnp.concatenate([best_d, d], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
         nd, sel = lax.top_k(-cat_d, k)
@@ -213,4 +263,4 @@ def search(index: Index, queries, k: int,
                             DistanceType.L2SqrtUnexpanded)
     return _search_impl(q, index.centers, index.lists_data,
                         index.lists_indices, index.lists_norms,
-                        k, n_probes, sqrt)
+                        jnp.float32(index.scale), k, n_probes, sqrt)

@@ -28,10 +28,20 @@ _VERSION = 1
 
 
 def _pack(path: str, fmt: str, meta: dict, arrays: dict) -> None:
-    meta = dict(meta, format=fmt, version=_VERSION)
+    # bfloat16 has no numpy-native representation: npz would silently
+    # store it as raw void ('|V2'); persist as uint16 bit patterns and
+    # record which fields to view back
+    out, bf16_fields = {}, []
+    for k, v in arrays.items():
+        a = np.asarray(v)
+        if a.dtype == jnp.bfloat16:
+            a = a.view(np.uint16)
+            bf16_fields.append(k)
+        out[k] = a
+    meta = dict(meta, format=fmt, version=_VERSION,
+                bf16_fields=bf16_fields)
     np.savez(path, __meta__=np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8), **{
-            k: np.asarray(v) for k, v in arrays.items()})
+        json.dumps(meta).encode(), dtype=np.uint8), **out)
     if not path.endswith(".npz") and os.path.exists(path + ".npz"):
         os.replace(path + ".npz", path)  # np.savez appends .npz; honor the
         # exact path the caller asked for so load(path) round-trips
@@ -46,13 +56,17 @@ def _unpack(path: str, fmt: str):
         expects(meta.get("version") == _VERSION,
                 f"serialize: unsupported version {meta.get('version')}")
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    import ml_dtypes
+    for k in meta.get("bf16_fields", []):
+        arrays[k] = arrays[k].view(ml_dtypes.bfloat16)
     return meta, arrays
 
 
 def save_ivf_flat(index, path: str) -> None:
     """Write an :class:`raft_tpu.neighbors.ivf_flat.Index` to ``path``."""
     _pack(path, "ivf_flat",
-          {"metric": int(index.metric), "size": int(index.size)},
+          {"metric": int(index.metric), "size": int(index.size),
+           "scale": float(index.scale)},
           {"centers": index.centers, "lists_data": index.lists_data,
            "lists_indices": index.lists_indices,
            "lists_norms": index.lists_norms,
@@ -70,7 +84,8 @@ def load_ivf_flat(path: str):
         lists_norms=jnp.asarray(a["lists_norms"]),
         list_sizes=jnp.asarray(a["list_sizes"]),
         metric=DistanceType(meta["metric"]),
-        size=meta["size"])
+        size=meta["size"],
+        scale=float(meta.get("scale", 1.0)))
 
 
 def save_ivf_pq(index, path: str) -> None:

@@ -53,7 +53,7 @@ def shard_ivf_flat(index, mesh: jax.sharding.Mesh, axis: str = "data"):
         lists_indices=_shard0(index.lists_indices, mesh, axis),
         lists_norms=_shard0(index.lists_norms, mesh, axis),
         list_sizes=_shard0(index.list_sizes, mesh, axis),
-        metric=index.metric, size=index.size)
+        metric=index.metric, size=index.size, scale=index.scale)
 
 
 def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
@@ -142,7 +142,8 @@ def distributed_ivf_flat_search(
         def get_probe(p):
             from raft_tpu.neighbors.ivf_flat import _score_probe
             return _score_probe(q_rep, qq, lists_data, lists_norms,
-                                lists_indices, probes[:, p])
+                                lists_indices, probes[:, p],
+                                float(index.scale))
 
         d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
         if sqrt:

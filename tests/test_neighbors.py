@@ -385,3 +385,56 @@ class TestHaversineKnn:
         overlap = np.mean([len(set(np.asarray(i)[r]) & set(ref_i[r])) / 3
                            for r in range(10)])
         assert overlap >= 0.9
+
+
+class TestIvfFlatQuantizedStorage:
+    @pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+    def test_narrow_storage_recall(self, dtype):
+        import jax
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        key = jax.random.key(20)
+        db = jax.random.normal(key, (3000, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (40, 32))
+        k = 10
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(
+            n_lists=16, kmeans_n_iters=5, storage_dtype=dtype))
+        assert str(idx.lists_data.dtype) == dtype
+        d, i = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(n_probes=16))
+        _, ie = brute_force_knn(db, q, k, DistanceType.L2Expanded)
+        i, ie = np.asarray(i), np.asarray(ie)
+        rec = np.mean([len(set(i[r]) & set(ie[r])) / k for r in range(40)])
+        # full probe: only quantization error can cost recall
+        assert rec >= 0.9, (dtype, rec)
+
+    def test_extend_preserves_storage(self):
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.neighbors import ivf_flat
+        key = jax.random.key(21)
+        db = jax.random.normal(key, (500, 16))
+        extra = jax.random.normal(jax.random.fold_in(key, 1), (100, 16))
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(
+            n_lists=8, kmeans_n_iters=3, storage_dtype="int8"))
+        idx2 = ivf_flat.extend(idx, extra)
+        assert idx2.lists_data.dtype == jnp.int8
+        assert idx2.size == 600 and idx2.scale > 0
+
+    @pytest.mark.parametrize("dtype", ["int8", "bfloat16"])
+    def test_serialize_roundtrip_with_scale(self, tmp_path, dtype):
+        import jax
+        from raft_tpu.neighbors import ivf_flat, serialize
+        key = jax.random.key(22)
+        db = jax.random.normal(key, (400, 8))
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(
+            n_lists=4, kmeans_n_iters=2, storage_dtype=dtype))
+        p = str(tmp_path / "q.npz")
+        serialize.save(idx, p)
+        idx2 = serialize.load(p)
+        assert abs(idx2.scale - idx.scale) < 1e-12
+        d1, i1 = ivf_flat.search(idx, db[:10], 3,
+                                 ivf_flat.SearchParams(n_probes=4))
+        d2, i2 = ivf_flat.search(idx2, db[:10], 3,
+                                 ivf_flat.SearchParams(n_probes=4))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
