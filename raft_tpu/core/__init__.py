@@ -5,6 +5,7 @@ TPU-native analogue of the reference's L0/L1 layer
 """
 
 from raft_tpu.core.resources import Resources, DeviceResources, default_resources
+from raft_tpu.core.memory import memory_stats, donate
 from raft_tpu.core.error import (
     RaftError,
     LogicError,
@@ -26,6 +27,8 @@ from raft_tpu.core.interruptible import interruptible, synchronize, cancel
 __all__ = [
     "Resources",
     "DeviceResources",
+    "memory_stats",
+    "donate",
     "default_resources",
     "RaftError",
     "LogicError",

@@ -1,0 +1,36 @@
+"""Device memory helpers (the RMM role, reference util/cudart_utils.hpp:490).
+
+The reference routes every allocation through RMM and offers
+``get_pool_memory_resource`` to wrap a pool; on TPU, XLA owns HBM (a
+BFC allocator preallocates the chip), so the framework's memory story
+is (a) observability — per-device live/limit stats — and (b) donation —
+letting jit reuse input buffers for outputs, the analogue of an
+in-place RMM workflow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+
+def memory_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:
+    """Live allocation stats for a device (bytes). Keys follow the PJRT
+    allocator stats (``bytes_in_use``, ``peak_bytes_in_use``,
+    ``bytes_limit`` where the backend reports them); empty dict when the
+    backend exposes no stats (CPU)."""
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return {}
+    return dict(stats or {})
+
+
+def donate(fn, *donate_argnums: int):
+    """Wrap ``fn`` with jit + buffer donation for the given positional
+    args — the TPU-native "in-place" idiom (donated inputs' HBM is
+    reused for outputs, like writing into a caller-provided RMM
+    buffer)."""
+    return jax.jit(fn, donate_argnums=donate_argnums)

@@ -154,3 +154,17 @@ class TestInterruptible:
     def test_synchronize_ready_array(self):
         x = jnp.ones((8,)) * 2
         synchronize(x)  # returns promptly
+
+
+class TestMemory:
+    def test_memory_stats_shape(self):
+        from raft_tpu.core import memory_stats
+        s = memory_stats()
+        assert isinstance(s, dict)  # CPU backend: may be empty
+
+    def test_donate_runs(self):
+        import jax.numpy as jnp
+        from raft_tpu.core import donate
+        f = donate(lambda x: x + 1.0, 0)
+        out = f(jnp.ones((8,)))
+        assert float(out[0]) == 2.0
