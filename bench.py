@@ -77,16 +77,36 @@ def main():
         if recall < MIN_RECALL:
             mode = "exact"  # fused kernel fails its gate: report exact
 
-    # offline-throughput timing: dispatch n_iters back-to-back searches,
-    # sync once at the end (per-iteration host fetches would bill the
-    # tunnel round-trip to every search)
-    n_iters = 5
-    t0 = time.perf_counter()
-    d = i = None
-    for _ in range(n_iters):
-        d, i = run()
-    _fetch([d[0, 0], i[0, 0]])
-    wall = (time.perf_counter() - t0) / n_iters
+    # offline-throughput timing: n_iters independent searches (distinct
+    # query batches) chained inside ONE jitted computation, synced once —
+    # the gbench methodology (stream-ordered kernel launches + one
+    # stream sync). Per-dispatch tunnel latency on the axon platform is
+    # ~25 ms and does not pipeline across dispatches, so timing separate
+    # dispatches would measure the tunnel, not the kernel.
+    n_iters = 10
+    q_batches = jax.device_put(jax.random.normal(
+        jax.random.fold_in(kq, 7), (n_iters, N_QUERIES, N_DIM),
+        dtype=jnp.float32))
+
+    @jax.jit
+    def run_chain(db_, qs):
+        # touch every search's result so none is dead-code eliminated,
+        # and reduce to ONE scalar: every extra output leaf costs a
+        # ~20 ms tunnel round-trip at fetch time
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(n_iters):
+            d_, i_ = brute_force_knn(db_, qs[i], K, DistanceType.L2Expanded,
+                                     mode=mode)
+            acc += d_[0, 0] + i_[0, 0].astype(jnp.float32)
+        return acc
+
+    _fetch(run_chain(db, q_batches))  # compile + warm
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _fetch(run_chain(db, q_batches))
+        walls.append((time.perf_counter() - t0) / n_iters)
+    wall = min(walls)  # best-of-3: tunnel jitter is not kernel time
     ms = wall * 1e3
     qps = N_QUERIES / wall
     print(json.dumps({

@@ -4,16 +4,21 @@ The reference computes every distance in true fp32 FMAs (CUDA cores /
 cuBLAS default). On TPU, f32 ``dot_general`` defaults to bf16 MXU passes
 (~5e-4 relative error), which is catastrophic for *expanded* forms like
 ``||x||² + ||y||² − 2x·y`` on large-norm data — the cancellation
-amplifies the matmul error far beyond f32 eps. All expanded-distance
-matmuls in this framework therefore default to
-``lax.Precision.HIGHEST`` (≈3e-7 relative error, modest MXU cost),
-matching the reference's accuracy contract.
+amplifies the matmul error far beyond f32 eps. Two knobs, two scopes:
 
-Override with ``RAFT_TPU_MATMUL_PRECISION`` = ``highest`` (default) |
-``high`` (bf16x3) | ``default`` (fastest, bf16) — the knob to trade
-exactness for throughput on workloads that tolerate it (the role of the
+* ``RAFT_TPU_MATMUL_PRECISION`` = ``highest`` (default) | ``high``
+  (bf16x3) | ``default`` (fastest, single bf16 pass) — governs the
+  *XLA-tier* distance matmuls (``matmul_precision()``: pairwise
+  distances, IVF coarse search, kmeans predict, …).
+* ``RAFT_TPU_KERNEL_PRECISION`` = ``bf16x3`` (default) | ``highest`` |
+  ``default`` — governs the *Pallas kernels* (``kernel_matmul_mode()``:
+  fused kNN, fused L2 NN), which cannot lower ``Precision.HIGH`` and
+  instead hand-roll the bf16x3 split (``ops._util.dot_nt_f32``,
+  ~1e-5 relative worst case, ~1e-6 measured on unit-scale data).
+
+Both are the knob to trade exactness for throughput (the role of the
 reference's fp16/fp8 LUT dtypes in IVF-PQ, ``ivf_pq_types.hpp:87``).
-The variable is read ONCE, at first use: precision is baked into traced
+Each variable is read ONCE, at first use: precision is baked into traced
 programs at compile time and jit caches don't key on it, so changing the
 environment mid-process would silently not apply — set it before the
 first distance call (normally: before starting Python).
@@ -48,3 +53,37 @@ def matmul_precision() -> lax.Precision:
                 f"RAFT_TPU_MATMUL_PRECISION={name!r}: "
                 "want highest|high|default") from None
     return _resolved
+
+
+_kernel_resolved = None
+
+
+def kernel_matmul_mode(interpret: bool = False):
+    """Matmul mode for the *Pallas* kernels (fused kNN / fused L2 NN).
+
+    Mosaic cannot lower ``Precision.HIGH`` inside a kernel, so the fast
+    accurate option is a hand-written bf16x3 split matmul
+    (``ops._util.dot_nt_f32``): 3 bf16 MXU passes, ~1e-6 relative error —
+    the reference's fp32-FMA accuracy contract at half the cost of
+    XLA's 6-pass ``HIGHEST``. Env ``RAFT_TPU_KERNEL_PRECISION`` =
+    ``bf16x3`` (default) | ``highest`` | ``default`` (single bf16 pass,
+    ~5e-4 — the IVF-PQ-style speed knob). Read once, like
+    ``matmul_precision``.
+
+    Under the Pallas interpreter (CPU test mesh) bf16 emulation is slow
+    and pointless — interpret mode always uses true f32 ``HIGHEST``.
+    """
+    if interpret:
+        return lax.Precision.HIGHEST
+    global _kernel_resolved
+    if _kernel_resolved is None:
+        name = os.environ.get("RAFT_TPU_KERNEL_PRECISION", "bf16x3").lower()
+        if name == "bf16x3":
+            _kernel_resolved = "bf16x3"
+        elif name in _TABLE and name != "high":
+            _kernel_resolved = _TABLE[name]
+        else:
+            raise ValueError(
+                f"RAFT_TPU_KERNEL_PRECISION={name!r}: "
+                "want bf16x3|highest|default")
+    return _kernel_resolved

@@ -6,8 +6,45 @@ from __future__ import annotations
 # import). Any masked lane gets this index; real indices are < 2**30.
 BIG_I32 = 2**30
 
+# Scoped-VMEM cap passed to Mosaic by the fused kernels. Their (TN, TM)
+# distance blocks plus bf16 operand splits exceed the 16 MiB default;
+# v5e has 128 MiB VMEM per core — leave headroom for double-buffered DMA.
+VMEM_LIMIT = 100 * 1024 * 1024
+
 
 def round_up(v: int, m: int) -> int:
     """Smallest multiple of ``m`` that is >= ``v`` (the Pow2 round-up of
     reference ``util/pow2_utils.cuh:29``, for arbitrary moduli)."""
     return -(-v // m) * m
+
+
+def dot_nt_f32(a, b, mode):
+    """``a @ b.T`` with f32 accumulation, at kernel precision ``mode``.
+
+    ``mode``:
+
+    * ``"bf16x3"`` — the split-matmul trick: each f32 operand is written
+      as ``hi + lo`` with ``hi = bf16(x)`` and ``lo = bf16(x - hi)``;
+      three bf16 MXU passes (``hi·hi + hi·lo + lo·hi``) recover ~16 of
+      f32's 24 mantissa bits: the dropped ``lo·lo`` term is ~2^-17
+      relative worst case (|lo| ≤ 2^-9·|x| per operand; measured ~1e-6
+      on unit-scale data where signs cancel) at half the cost of XLA's
+      6-pass ``HIGHEST``. Mosaic has no ``Precision.HIGH`` lowering
+      in-kernel, so the split is spelled out by hand.
+    * a ``lax.Precision`` — passed straight to ``dot_general``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    dn = (((1,), (1,)), ((), ()))
+    if mode != "bf16x3":
+        return lax.dot_general(a, b, dn, preferred_element_type=jnp.float32,
+                               precision=mode)
+    ah = a.astype(jnp.bfloat16)
+    bh = b.astype(jnp.bfloat16)
+    al = (a - ah.astype(jnp.float32)).astype(jnp.bfloat16)
+    bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
+    acc = lax.dot_general(ah, bl, dn, preferred_element_type=jnp.float32)
+    acc += lax.dot_general(al, bh, dn, preferred_element_type=jnp.float32)
+    acc += lax.dot_general(ah, bh, dn, preferred_element_type=jnp.float32)
+    return acc

@@ -32,21 +32,22 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.ops.dispatch import pallas_interpret
-from raft_tpu.ops._util import BIG_I32 as _BIG_I32, round_up as _round_up
-from raft_tpu.core.precision import matmul_precision
+from raft_tpu.ops._util import (BIG_I32 as _BIG_I32, VMEM_LIMIT as _VMEM_LIMIT,
+                                round_up as _round_up, dot_nt_f32)
+from raft_tpu.core.precision import kernel_matmul_mode
 
 
 def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
-                k: int, l_bins: int, metric: str, sqrt: bool):
+                k: int, l_bins: int, metric: str, sqrt: bool,
+                precision):
     j = pl.program_id(1)
     x = x_ref[:]                                         # (TM, K)
     y = y_ref[:]                                         # (TN, K)
     tm = x.shape[0]
-    ip = jax.lax.dot_general(
-        y, x, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=matmul_precision())
+    ip = dot_nt_f32(y, x, precision)
     if metric == "l2":
         xx = jnp.sum(x * x, axis=1, keepdims=True).T     # (1, TM)
         yy = jnp.sum(y * y, axis=1, keepdims=True)       # (TN, 1)
@@ -119,7 +120,8 @@ def _fused_knn_call(x, y, k: int, metric: str, sqrt: bool, tm: int, tn: int,
     yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
     gm, gn = mp // tm, np_ // tn
     kern = functools.partial(_knn_kernel, n=n, tn=tn, gn=gn, k=k,
-                             l_bins=l_bins, metric=metric, sqrt=sqrt)
+                             l_bins=l_bins, metric=metric, sqrt=sqrt,
+                             precision=kernel_matmul_mode(interpret))
     od, oi = pl.pallas_call(
         kern,
         grid=(gm, gn),
@@ -129,6 +131,8 @@ def _fused_knn_call(x, y, k: int, metric: str, sqrt: bool, tm: int, tn: int,
                    pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((gm, k, tm), jnp.float32),
                    jax.ShapeDtypeStruct((gm, k, tm), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * np_ * dim,
             bytes_accessed=4 * (gm * np_ * dim + gn * mp * dim
@@ -165,13 +169,17 @@ def fused_knn_pallas(x, y, k: int, metric: str = "l2", sqrt: bool = False,
             f"fused_knn_pallas: dim={dim} > 4096 exceeds the VMEM tile "
             "budget; use the exact scan path")
     if tm <= 0 or tn <= 0:
-        # VMEM heuristic: (tm+tn)·dim·4 input blocks + tn·tm·4 block
+        # VMEM heuristic: the (TN, TM) f32 distance block dominates —
+        # 16 MiB at 4096×1024 — plus (tm+tn)·dim·4 operand blocks
+        # (double-buffered) and the bf16 split copies. Measured on v5e:
+        # per-grid-step overhead makes small tiles ~2× slower, so tiles
+        # are as large as the raised VMEM cap allows.
         if dim <= 512:
-            tm, tn = 256, 512
+            tm, tn = 1024, 4096
         elif dim <= 2048:
-            tm, tn = 256, 256
+            tm, tn = 512, 1024
         else:
-            tm, tn = 128, 256
+            tm, tn = 256, 512
     tm = min(tm, _round_up(m, 8))
     tn = min(tn, _round_up(n, 8))
     if l_bins <= 0:

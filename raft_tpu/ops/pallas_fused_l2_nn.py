@@ -23,23 +23,23 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.ops.dispatch import pallas_interpret
-from raft_tpu.ops._util import BIG_I32 as _BIG_I32, round_up as _round_up
-from raft_tpu.core.precision import matmul_precision
+from raft_tpu.ops._util import (BIG_I32 as _BIG_I32, VMEM_LIMIT as _VMEM_LIMIT,
+                                round_up as _round_up, dot_nt_f32)
+from raft_tpu.core.precision import kernel_matmul_mode
 
 
 def _nn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
-               sqrt: bool):
+               sqrt: bool, precision):
     j = pl.program_id(1)
     x = x_ref[:]                                         # (TM, K)
     y = y_ref[:]                                         # (TN, K)
     xx = jnp.sum(x * x, axis=1, keepdims=True).T         # (1, TM)
     yy = jnp.sum(y * y, axis=1, keepdims=True)           # (TN, 1)
     # transposed expanded-L2 block: d[p, q] = ||y_p - x_q||^2
-    d = yy + xx - 2.0 * jax.lax.dot_general(
-        y, x, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=matmul_precision())
+    d = yy + xx - 2.0 * dot_nt_f32(y, x, precision)
     tm = x.shape[0]
     row = jax.lax.broadcasted_iota(jnp.int32, (tn, tm), 0) + j * tn
     d = jnp.where(row < n, jnp.maximum(d, 0.0), jnp.inf)
@@ -70,7 +70,8 @@ def _fused_l2_nn_call(x, y, sqrt: bool, tm: int, tn: int, interpret: bool):
     xp = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, 0)))
     yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
     gm, gn = mp // tm, np_ // tn
-    kern = functools.partial(_nn_kernel, n=n, tn=tn, gn=gn, sqrt=sqrt)
+    kern = functools.partial(_nn_kernel, n=n, tn=tn, gn=gn, sqrt=sqrt,
+                             precision=kernel_matmul_mode(interpret))
     od, oi = pl.pallas_call(
         kern,
         grid=(gm, gn),
@@ -80,6 +81,8 @@ def _fused_l2_nn_call(x, y, sqrt: bool, tm: int, tn: int, interpret: bool):
                    pl.BlockSpec((1, 1, tm), lambda i, j: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((gm, 1, tm), jnp.float32),
                    jax.ShapeDtypeStruct((gm, 1, tm), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * np_ * k,
             bytes_accessed=4 * (gm * np_ * k + gn * mp * k + 2 * mp),
@@ -103,9 +106,9 @@ def fused_l2_nn_pallas(x, y, sqrt: bool = False, tm: int = 0, tn: int = 0):
     m, k = x.shape
     if tm <= 0 or tn <= 0:
         if k <= 512:
-            tm, tn = 1024, 1024
+            tm, tn = 1024, 4096
         elif k <= 2048:
-            tm, tn = 512, 512
+            tm, tn = 512, 1024
         else:
             tm, tn = 256, 512
     tm = min(tm, _round_up(m, 8))
