@@ -1,0 +1,50 @@
+"""IVF probe-order vs list-order on TPU: 500k x 128, 1024 lists."""
+import time
+import numpy as np
+import jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+from bench_suite import _sync
+
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+
+def timeit(f, reps=3):
+    _sync(f())
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f())
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+N, D, Q, K, NLIST, NPROBE = 500_000, 128, 1000, 32, 1024, 64
+key = jax.random.key(0)
+x = jax.random.normal(jax.random.fold_in(key, 1), (N, D), jnp.float32)
+q = jax.random.normal(jax.random.fold_in(key, 2), (Q, D), jnp.float32)
+_sync([x, q])
+
+t0 = time.perf_counter()
+idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=NLIST, kmeans_n_iters=4))
+_sync([idx.lists_data[0, 0]])
+print(f"ivf_flat build: {time.perf_counter()-t0:.1f} s")
+
+for order, bins in (("probe", 0), ("list", 0), ("list", 64), ("list", 128)):
+    p = ivf_flat.SearchParams(n_probes=NPROBE, scan_order=order, scan_bins=bins)
+    t = timeit(lambda: ivf_flat.search(idx, q, K, p))
+    print(f"ivf_flat {order} bins={bins}", flush=True) if False else print(f"ivf_flat {order} bins={bins}: {t:7.1f} ms = {Q/t*1e3:8.0f} QPS")
+
+import sys
+if "pq" not in sys.argv:
+    sys.exit(0)
+t0 = time.perf_counter()
+pidx = ivf_pq.build(x, ivf_pq.IndexParams(n_lists=NLIST, kmeans_n_iters=4))
+_sync([pidx.codes[0, 0]])
+print(f"ivf_pq build: {time.perf_counter()-t0:.1f} s")
+
+for order, bins in (("probe", 0), ("list", 0), ("list", 64)):
+    p = ivf_pq.SearchParams(n_probes=NPROBE, scan_order=order, scan_bins=bins)
+    t = timeit(lambda: ivf_pq.search(pidx, q, K, p))
+    print(f"ivf_pq {order} bins={bins}: {t:7.1f} ms = {Q/t*1e3:8.0f} QPS")

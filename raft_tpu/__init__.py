@@ -36,7 +36,7 @@ Handle = DeviceResources
 _SUBPACKAGES = (
     "cluster", "comms", "core", "distance", "label", "linalg", "matrix",
     "neighbors", "ops", "parallel", "random", "solver", "sparse",
-    "spectral", "stats",
+    "spatial", "spectral", "stats", "util",
 )
 
 __all__ = [

@@ -1,0 +1,201 @@
+"""List-major ("inverted") IVF fine scan, shared by IVF-Flat and IVF-PQ.
+
+The probe-major scan (``ivf_flat._search_impl``) gathers each query's
+p-th probed list per step: every (query, probe) pair re-reads its list's
+rows from HBM, so a batch of ``nq`` queries × ``n_probes`` streams
+``nq·n_probes·(n/n_lists)·dim`` bytes — 64× the index size at the
+default 1024-query/64-probe operating point. The reference reduces the
+equivalent waste by sorting the probe list by cluster so same-cluster
+work shares the L2 (``ivf_pq_search.cuh:1058-1097``, cub radix sort by
+label); the TPU-native version inverts the map outright:
+
+  1. invert (query → probes) into (list → probing queries), a padded
+     (n_lists, cap) table (static shape; ``cap`` ≥ the observed max is
+     computed on host and bucketed to limit recompiles);
+  2. scan lists in chunks: per chunk one dense MXU einsum scores each
+     list against *all* queries probing it — each list's rows are read
+     exactly once per batch;
+  3. per-(list, query) top-k candidates are scattered back through the
+     inverse map and merged per query with one final ``select_k``.
+
+Worth it when the reuse factor ``nq·n_probes / n_lists`` is high; the
+probe-major scan stays the right call for small/online batches (it only
+touches probed lists). ``search()`` picks automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.precision import matmul_precision
+
+
+def probe_cap(probes, n_lists: int) -> int:
+    """Smallest safe static width for the inverted table: the max number
+    of queries probing any one list, rounded up to a power of two (so
+    jit caches bucket instead of recompiling per batch)."""
+    counts = jax.ops.segment_sum(
+        jnp.ones(probes.size, jnp.int32), probes.reshape(-1),
+        num_segments=n_lists)
+    m = int(jax.device_get(jnp.max(counts)))
+    cap = 8
+    while cap < m:
+        cap *= 2
+    return min(cap, probes.shape[0])
+
+
+def _invert_probes(probes, n_lists: int, cap: int):
+    """(nq, n_probes) → ``qmap`` (n_lists, cap) query ids (-1 pad) and
+    ``inv_pos`` (nq, n_probes): each pair's slot within its list's row."""
+    nq, n_probes = probes.shape
+    flat_list = probes.reshape(-1)
+    qid = jnp.broadcast_to(jnp.arange(nq, dtype=jnp.int32)[:, None],
+                           (nq, n_probes)).reshape(-1)
+    counts = jax.ops.segment_sum(jnp.ones(nq * n_probes, jnp.int32),
+                                 flat_list, num_segments=n_lists)
+    order = jnp.argsort(flat_list, stable=True)
+    sl = flat_list[order]
+    starts = jnp.cumsum(jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                         counts]))[:-1]
+    pos = jnp.arange(nq * n_probes, dtype=jnp.int32) - starts[sl]
+    # pairs beyond cap are dropped (cannot happen when cap ≥ max count,
+    # which probe_cap guarantees)
+    slot = jnp.where(pos < cap, sl * cap + pos, n_lists * cap)
+    qmap = jnp.full((n_lists * cap,), -1, jnp.int32)
+    qmap = qmap.at[slot].set(qid[order], mode="drop")
+    inv_pos = jnp.zeros((nq * n_probes,), jnp.int32)
+    inv_pos = inv_pos.at[order].set(pos)
+    return qmap.reshape(n_lists, cap), inv_pos.reshape(nq, n_probes)
+
+
+def _chunk_size(n_lists: int, cap: int, max_list: int,
+                budget_elems: int = 1 << 24) -> int:
+    """Largest divisor of n_lists whose (chunk, cap, max_list) score
+    block stays under ~``budget_elems`` f32 elements (64 MiB default)."""
+    want = max(1, budget_elems // max(1, cap * max_list))
+    c = 1
+    for d in range(1, n_lists + 1):
+        if n_lists % d == 0 and d <= want:
+            c = d
+    return c
+
+
+def _score_block(qsub, data, norms, scale):
+    """(chunk, cap, dim) queries × (chunk, max_list, dim) list rows →
+    (chunk, cap, max_list) squared-L2, mirroring the dtype handling of
+    ``ivf_flat._score_probe`` (bf16 on the MXU; int8 via folded scale)."""
+    qq = jnp.sum(qsub * qsub, axis=2)
+    if data.dtype == jnp.bfloat16:
+        ip = jnp.einsum("gcd,gld->gcl", qsub.astype(jnp.bfloat16), data,
+                        preferred_element_type=jnp.float32)
+    elif data.dtype == jnp.int8:
+        ip = scale * jnp.einsum("gcd,gld->gcl", qsub,
+                                data.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+    else:
+        ip = jnp.einsum("gcd,gld->gcl", qsub, data,
+                        preferred_element_type=jnp.float32,
+                        precision=matmul_precision())
+    return qq[:, :, None] + norms[:, None, :] - 2.0 * ip
+
+
+@functools.partial(jax.jit, static_argnames=("n_probes",))
+def coarse_probes(queries, centers, n_probes: int):
+    """Coarse phase (reference select_clusters, ivf_pq_search.cuh:127):
+    run separately so the host can size the inverted table from its
+    output before the fine-scan jit is staged."""
+    from raft_tpu.distance.pairwise import _l2_expanded
+    coarse = _l2_expanded(queries, centers, sqrt=False)
+    return lax.top_k(-coarse, n_probes)[1]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "cap", "chunk", "bins", "sqrt"))
+def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
+                  chunk: int, scale=1.0, center_offset: Optional[jax.Array]
+                  = None, bins: int = 0, sqrt: bool = False):
+    """Score every (query, probed list) pair list-major and return the
+    merged per-query top-k: (dists (nq, k), global ids (nq, k)).
+
+    ``center_offset`` (n_lists, dim), when given, is subtracted from each
+    list's probing queries before scoring — the IVF-PQ residual form
+    (queries are pre-rotated; lists hold decoded rotated residuals).
+
+    ``bins`` > 0 replaces the exact per-(list, query) top-k with a
+    binned (min, argmin) over ``bins`` row-bins — the TPU-KNN partial
+    top-k of the fused kNN kernel (``pallas_fused_knn.py``): of two true
+    hits in one bin of one list only the nearer survives. Sort-based
+    selection dominates the exact path's runtime; bins ≥ 2k makes the
+    candidate pass a cheap VPU reduction at small recall cost.
+    """
+    nq = queries.shape[0]
+    n_lists, max_list = ids.shape
+    qmap, inv_pos = _invert_probes(probes, n_lists, cap)
+
+    n_chunks = n_lists // chunk
+    qmap_c = qmap.reshape(n_chunks, chunk, cap)
+    data_c = data.reshape(n_chunks, chunk, max_list, -1)
+    norms_c = norms.reshape(n_chunks, chunk, max_list)
+    ids_c = ids.reshape(n_chunks, chunk, max_list)
+    off_c = (None if center_offset is None
+             else center_offset.reshape(n_chunks, chunk, -1))
+
+    kk = min(k, max_list) if bins <= 0 else min(bins, max_list)
+
+    def one_chunk(args):
+        qm, dat, nrm, lid, off = args
+        qsub = queries[jnp.clip(qm, 0, nq - 1)]          # (chunk, cap, dim)
+        if off is not None:
+            qsub = qsub - off[:, None, :]
+        d = _score_block(qsub, dat, nrm, scale)
+        d = jnp.where(lid[:, None, :] >= 0, jnp.maximum(d, 0.0), jnp.inf)
+        if bins > 0 and kk < max_list:
+            b = -(-max_list // kk)                       # bin width
+            pad = kk * b - max_list
+            dp = jnp.pad(d, ((0, 0), (0, 0), (0, pad)),
+                         constant_values=jnp.inf)
+            db_ = dp.reshape(chunk, cap, kk, b)
+            cd = jnp.min(db_, axis=3)                    # (chunk, cap, kk)
+            col = jnp.pad(
+                jnp.broadcast_to(lid[:, None, :], (chunk, cap, max_list)),
+                ((0, 0), (0, 0), (0, pad)), constant_values=-1
+            ).reshape(chunk, cap, kk, b)
+            big = jnp.iinfo(jnp.int32).max
+            gl = jnp.min(jnp.where(db_ == cd[..., None], col, big), axis=3)
+            gl = jnp.where(gl == big, -1, gl)
+            return cd, gl
+        flat = d.reshape(chunk * cap, max_list)
+        cd, csel = lax.top_k(-flat, kk)
+        cd = -cd
+        gl = jnp.take_along_axis(
+            jnp.broadcast_to(lid[:, None, :], (chunk, cap, max_list))
+            .reshape(chunk * cap, max_list), csel, axis=1)
+        return (cd.reshape(chunk, cap, kk), gl.reshape(chunk, cap, kk))
+
+    if off_c is None:
+        cand_d, cand_i = lax.map(
+            lambda a: one_chunk((*a, None)),
+            (qmap_c, data_c, norms_c, ids_c))
+    else:
+        cand_d, cand_i = lax.map(
+            one_chunk, (qmap_c, data_c, norms_c, ids_c, off_c))
+    cand_d = cand_d.reshape(n_lists, cap, kk)
+    cand_i = cand_i.reshape(n_lists, cap, kk)
+
+    # gather each (query, probe) pair's candidate row back: (nq, n_probes, kk)
+    pd = cand_d[probes, inv_pos].reshape(nq, -1)
+    pi = cand_i[probes, inv_pos].reshape(nq, -1)
+    if pd.shape[1] < k:  # fewer candidates than k: pad like the carry init
+        short = k - pd.shape[1]
+        pd = jnp.pad(pd, ((0, 0), (0, short)), constant_values=jnp.inf)
+        pi = jnp.pad(pi, ((0, 0), (0, short)), constant_values=-1)
+    nd, sel = lax.top_k(-pd, k)
+    d = -nd
+    if sqrt:
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+    return d, jnp.take_along_axis(pi, sel, axis=1)

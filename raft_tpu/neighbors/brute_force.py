@@ -114,8 +114,14 @@ def brute_force_knn(
     metric = DistanceType(metric)
     pal = _PALLAS_METRICS.get(metric)
     if mode == "fused":
+        if metric in (DistanceType.CosineExpanded,
+                      DistanceType.CorrelationExpanded):
+            # row-normalize (+ center) → IP kernel → 1 - sim, the
+            # reference's preprocessing route (processing.hpp)
+            from raft_tpu.neighbors.processing import fused_knn_preprocessed
+            return fused_knn_preprocessed(db, queries, k, metric)
         expects(pal is not None,
-                f"fused knn supports L2/IP metrics only, got {metric}")
+                f"fused knn supports L2/IP/cosine/correlation, got {metric}")
         from raft_tpu.ops.pallas_fused_knn import fused_knn_pallas
         m_name, sq = pal
         return fused_knn_pallas(queries, db, k, metric=m_name, sqrt=sq)

@@ -56,9 +56,20 @@ class IndexParams:
 
 @dataclass
 class SearchParams:
-    """reference ivf_flat_types.hpp search_params."""
+    """reference ivf_flat_types.hpp search_params.
+
+    ``scan_order``: "probe" gathers each query's p-th list per step
+    (touches only probed lists — right for small/online batches);
+    "list" inverts the probe map and scores list-major (each list's rows
+    read once per batch — the TPU analogue of the reference's
+    sort-probes-by-cluster locality trick, ``ivf_pq_search.cuh:1058``);
+    "auto" picks by the reuse factor nq·n_probes/n_lists."""
 
     n_probes: int = 20
+    scan_order: str = "auto"
+    # list-order selection: 0 = exact per-(list,query) top-k; >0 = that
+    # many min-bins per list (TPU-KNN partial top-k; >=2k recommended)
+    scan_bins: int = 0
 
 
 @dataclass
@@ -258,9 +269,25 @@ def search(index: Index, queries, k: int,
     ivf_flat_search.cuh:1210)."""
     q = as_array(queries).astype(jnp.float32)
     expects(q.shape[1] == index.dim, "ivf_flat.search: dim mismatch")
+    expects(params.scan_order in ("auto", "probe", "list"),
+            f"ivf_flat.search: unknown scan_order {params.scan_order!r}")
     n_probes = min(params.n_probes, index.n_lists)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
+    nq = q.shape[0]
+    use_list = (params.scan_order == "list"
+                or (params.scan_order == "auto"
+                    and nq >= 64 and nq * n_probes >= 4 * index.n_lists))
+    if use_list:
+        from raft_tpu.neighbors import _ivf_scan
+        probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)
+        cap = _ivf_scan.probe_cap(probes, index.n_lists)
+        chunk = _ivf_scan._chunk_size(
+            index.n_lists, cap, index.lists_indices.shape[1])
+        return _ivf_scan.inverted_scan(
+            q, index.lists_data, index.lists_norms, index.lists_indices,
+            probes, k, cap, chunk, jnp.float32(index.scale),
+            bins=params.scan_bins, sqrt=sqrt)
     return _search_impl(q, index.centers, index.lists_data,
                         index.lists_indices, index.lists_norms,
                         jnp.float32(index.scale), k, n_probes, sqrt)

@@ -74,6 +74,11 @@ class SearchParams:
     # "reconstruct" = bf16 decoded-cache MXU scan (TPU-native default);
     # "lut" = per-probe f32 LUT + gather scan (the CUDA formulation)
     scan_mode: str = "reconstruct"
+    # "probe"/"list"/"auto" — see ivf_flat.SearchParams.scan_order;
+    # list-major applies to the reconstruct scan only
+    scan_order: str = "auto"
+    # see ivf_flat.SearchParams.scan_bins
+    scan_bins: int = 0
 
 
 @dataclass
@@ -280,15 +285,16 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
 def _decode_lists(codes_b, pq_centers, lists_indices):
     """Decode bucketed PQ codes → bf16 reconstruction cache
     ((n_lists, max_list, rot_dim) rotated residuals) + f32 squared norms.
-    One-time row-gather per subquantizer (cheap, build-time only)."""
+    One row-gather per subquantizer from its (n_codes, pq_len) table —
+    a single fancy-gather over the stacked books broadcasts a huge
+    (N, pq_dim, n_codes, pq_len) intermediate on TPU and OOMs at ~1M
+    rows; the per-subspace loop stays O(N·pq_len) per step."""
     n_lists, max_list, pq_dim = codes_b.shape
     _, n_codes, pq_len = pq_centers.shape
     flat = codes_b.reshape(-1, pq_dim).astype(jnp.int32)   # (N, pq_dim)
     # decoded[i, s, :] = pq_centers[s, flat[i, s], :]
-    dec = jnp.take_along_axis(
-        pq_centers[None],                                  # (1, s, c, l)
-        flat[:, :, None, None],                            # (N, s, 1, 1)
-        axis=2)[:, :, 0, :]                                # (N, s, l)
+    dec = jnp.stack([pq_centers[s][flat[:, s]] for s in range(pq_dim)],
+                    axis=1)                                # (N, s, l)
     dec = dec.reshape(n_lists, max_list, pq_dim * pq_len)
     # padded slots decode to code 0's centroid; zero them so their norms
     # are harmless (scores for pads are masked at search anyway)
@@ -404,6 +410,8 @@ def search(index: Index, queries, k: int,
     expects(q.shape[1] == index.dim, "ivf_pq.search: dim mismatch")
     expects(params.scan_mode in ("reconstruct", "lut"),
             f"ivf_pq.search: unknown scan_mode {params.scan_mode!r}")
+    expects(params.scan_order in ("auto", "probe", "list"),
+            f"ivf_pq.search: unknown scan_order {params.scan_order!r}")
     n_probes = min(params.n_probes, index.n_lists)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
@@ -411,6 +419,27 @@ def search(index: Index, queries, k: int,
         if index.decoded is None:
             index.decoded, index.decoded_norms = _decode_lists(
                 index.codes, index.pq_centers, index.lists_indices)
+        nq = q.shape[0]
+        use_list = (params.scan_order == "list"
+                    or (params.scan_order == "auto"
+                        and nq >= 64
+                        and nq * n_probes >= 4 * index.n_lists))
+        if use_list:
+            from raft_tpu.neighbors import _ivf_scan
+            probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)
+            cap = _ivf_scan.probe_cap(probes, index.n_lists)
+            chunk = _ivf_scan._chunk_size(
+                index.n_lists, cap, index.lists_indices.shape[1])
+            q_rot = jnp.matmul(q, index.rotation_matrix.T,
+                               precision=matmul_precision())
+            # lists hold decoded rotated residuals: offset each list's
+            # queries by its rotated center so the einsum scores
+            # ||(q_rot - c_l) - decoded||²
+            return _ivf_scan.inverted_scan(
+                q_rot, index.decoded, index.decoded_norms,
+                index.lists_indices, probes, k, cap, chunk,
+                center_offset=index.centers_rot, bins=params.scan_bins,
+                sqrt=sqrt)
         return _search_impl_reconstruct(
             q, index.centers, index.centers_rot, index.rotation_matrix,
             index.decoded, index.decoded_norms, index.lists_indices,

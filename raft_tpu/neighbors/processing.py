@@ -1,0 +1,54 @@
+"""Query/index preprocessing for metrics the fused kernels lack.
+
+Reference: ``spatial/knn/detail/processing.{hpp,cuh}`` — FAISS only
+speaks L2/IP, so cosine queries are row-normalized and correlation
+queries additionally mean-centered before search, then distances are
+post-processed. The TPU fused kNN kernel (``ops/pallas_fused_knn.py``)
+has the same l2|ip vocabulary, so the same trick extends it to
+cosine/correlation: preprocess both sides → search IP (largest) →
+distance = 1 − similarity."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+
+_EPS = 1e-12
+
+
+def preprocess_rows(x, metric: DistanceType):
+    """Row-transform ``x`` so inner product equals the metric's
+    similarity: cosine → L2-normalize (CosineMetricProcessor); correlation
+    → mean-center then L2-normalize (CorrelationMetricProcessor)."""
+    x = as_array(x).astype(jnp.float32)
+    if metric == DistanceType.CorrelationExpanded:
+        x = x - jnp.mean(x, axis=1, keepdims=True)
+    norms = jnp.linalg.norm(x, axis=1, keepdims=True)
+    return x / jnp.maximum(norms, _EPS)
+
+
+def postprocess_distances(sims, metric: DistanceType):
+    """Similarity → distance: both cosine and correlation report
+    ``1 − similarity`` (the reference's post-search epilogue)."""
+    del metric
+    return 1.0 - sims
+
+
+def fused_knn_preprocessed(db, queries, k: int, metric: DistanceType
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Cosine/correlation k-NN through the fused IP kernel."""
+    from raft_tpu.ops.pallas_fused_knn import fused_knn_pallas
+    if metric not in (DistanceType.CosineExpanded,
+                      DistanceType.CorrelationExpanded):
+        raise ValueError(
+            f"fused_knn_preprocessed: metric {metric} needs no preprocessing"
+            " (use brute_force_knn)")
+    dbp = preprocess_rows(db, metric)
+    qp = preprocess_rows(queries, metric)
+    sims, idx = fused_knn_pallas(qp, dbp, k, metric="ip")
+    return postprocess_distances(sims, metric), idx

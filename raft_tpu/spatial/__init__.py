@@ -1,0 +1,9 @@
+"""Legacy ``spatial`` namespace (reference ``raft/spatial/knn/**`` — the
+older public API kept for cuML; ``raft::neighbors`` forwards into it,
+SURVEY.md §2.7 "Legacy spatial::knn API"). Here the direction is
+reversed: :mod:`raft_tpu.neighbors` is primary and this package
+forwards, so downstream code written against either namespace works."""
+
+from raft_tpu.spatial import knn
+
+__all__ = ["knn"]

@@ -1,0 +1,61 @@
+"""Legacy ``raft::spatial::knn`` API surface.
+
+Reference: ``spatial/knn/knn.cuh`` (``knn_merge_parts`` :55, ``select_k``
+:125, ``brute_force_knn`` :196) and ``spatial/knn/ann.cuh``
+(``approx_knn_build_index`` / ``approx_knn_search`` — the runtime-
+dispatched ANN entry points that route IVF-Flat/IVF-PQ/IVF-SQ through
+FAISS in ``detail/ann_quantized.cuh:67-160``). Thin forwards over the
+primary :mod:`raft_tpu.neighbors` implementations."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+
+from raft_tpu.neighbors.brute_force import (brute_force_knn, knn,
+                                            knn_merge_parts)
+from raft_tpu.neighbors.selection import select_k
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+__all__ = [
+    "brute_force_knn", "knn", "knn_merge_parts", "select_k",
+    "approx_knn_build_index", "approx_knn_search",
+]
+
+_ANNIndex = Union[ivf_flat.Index, ivf_pq.Index]
+
+
+def approx_knn_build_index(
+    dataset,
+    params: Union[ivf_flat.IndexParams, ivf_pq.IndexParams],
+    res=None,
+) -> _ANNIndex:
+    """Build an ANN index, dispatching on the parameter struct's type —
+    the role of the reference's ``knnIndexParam`` dynamic casts
+    (``ann_quantized.cuh:78-103``)."""
+    if isinstance(params, ivf_flat.IndexParams):
+        return ivf_flat.build(dataset, params, res=res)
+    if isinstance(params, ivf_pq.IndexParams):
+        return ivf_pq.build(dataset, params, seed=0, res=res)
+    raise TypeError(
+        f"approx_knn_build_index: unknown params type {type(params).__name__}"
+        " (want ivf_flat.IndexParams or ivf_pq.IndexParams)")
+
+
+def approx_knn_search(
+    index: _ANNIndex,
+    queries,
+    k: int,
+    params: Union[ivf_flat.SearchParams, ivf_pq.SearchParams, None] = None,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search a built ANN index (reference ``ann_quantized.cuh:106-160``)."""
+    if isinstance(index, ivf_flat.Index):
+        return ivf_flat.search(index, queries, k,
+                               params or ivf_flat.SearchParams(), res=res)
+    if isinstance(index, ivf_pq.Index):
+        return ivf_pq.search(index, queries, k,
+                             params or ivf_pq.SearchParams(), res=res)
+    raise TypeError(
+        f"approx_knn_search: unknown index type {type(index).__name__}")

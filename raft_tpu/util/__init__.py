@@ -1,0 +1,26 @@
+"""Utility layer (reference ``raft/util/**``, SURVEY.md §2.1 L0).
+
+What ports and what doesn't: the reference's L0 is mostly CUDA
+micro-architecture glue — ``TxN_t`` vectorized loads, warp
+shuffle/reduce, ``device_atomics``, ``ldg``/``sts`` wrappers,
+``fast_int_div`` — whose TPU "equivalent" is simply XLA/Mosaic codegen
+(vector IO and cross-lane reductions are compiler-scheduled; the grid is
+sequential so atomics have no role). Those files intentionally have no
+counterpart here. What does carry over:
+
+  pow2_utils   Pow2 round/mod/div helpers (``util/pow2_utils.cuh:29``)
+  cache        set-associative device vector cache (``util/cache.cuh:110``)
+  scatter      scatter / scatter_if (``util/scatter.cuh``)
+  seive        Sieve of Eratosthenes (``util/seive.hpp``)
+"""
+
+from raft_tpu.util.pow2_utils import (Pow2, round_up_pow2, round_down_pow2,
+                                      is_pow2)
+from raft_tpu.util.cache import VecCache
+from raft_tpu.util.scatter import scatter, scatter_if
+from raft_tpu.util.seive import Seive
+
+__all__ = [
+    "Pow2", "round_up_pow2", "round_down_pow2", "is_pow2",
+    "VecCache", "scatter", "scatter_if", "Seive",
+]

@@ -1,0 +1,95 @@
+"""Set-associative device vector cache.
+
+Reference: ``util/cache.cuh:110`` ``class Cache`` — caches vectors by
+integer key in GPU memory for SVM-style workloads (cuML kernel cache):
+keys hash to a set, LRU within the set's ``associativity`` ways, and the
+caller splits a key batch into cached / non-cached, computes the misses,
+and stores them back.
+
+TPU design: the same set-associative layout as pure arrays on device —
+``keys (n_sets, ways)``, ``time (n_sets, ways)``, ``vecs (n_sets, ways,
+n_vec)`` — with functional jitted ops (lookup / store return a new cache
+state; nothing mutates). Eviction is LRU by a monotonically increasing
+logical clock, matching the reference's ``cache_time`` scheme."""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class VecCache:
+    keys: jax.Array     # (n_sets, ways) int32, -1 = empty
+    time: jax.Array     # (n_sets, ways) int32 last-use clock
+    vecs: jax.Array     # (n_sets, ways, n_vec)
+    clock: jax.Array    # () int32
+
+    def tree_flatten(self):
+        return (self.keys, self.time, self.vecs, self.clock), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def n_sets(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def associativity(self) -> int:
+        return self.keys.shape[1]
+
+    @property
+    def n_vec(self) -> int:
+        return self.vecs.shape[2]
+
+    @classmethod
+    def create(cls, n_vec: int, n_sets: int, associativity: int = 32,
+               dtype=jnp.float32) -> "VecCache":
+        """Empty cache holding up to ``n_sets * associativity`` vectors
+        of length ``n_vec`` (the reference sizes by MiB; here explicit)."""
+        return cls(
+            keys=jnp.full((n_sets, associativity), -1, jnp.int32),
+            time=jnp.zeros((n_sets, associativity), jnp.int32),
+            vecs=jnp.zeros((n_sets, associativity, n_vec), dtype),
+            clock=jnp.zeros((), jnp.int32))
+
+    def _set_of(self, keys):
+        return keys % self.n_sets
+
+    @jax.jit
+    def lookup(self, query_keys):
+        """(vectors (m, n_vec), hit (m,) bool, state') — hits also bump
+        LRU time (the reference's GetVecs updates cache_time)."""
+        s = self._set_of(query_keys)                       # (m,)
+        set_keys = self.keys[s]                            # (m, ways)
+        match = set_keys == query_keys[:, None]
+        hit = jnp.any(match, axis=1)
+        way = jnp.argmax(match, axis=1)
+        out = self.vecs[s, way]
+        out = jnp.where(hit[:, None], out, 0)
+        # bump LRU time on hits only (max with 0 is a no-op: times ≥ 0)
+        new_time = self.time.at[s, way].max(
+            jnp.where(hit, self.clock + 1, 0), mode="drop")
+        return out, hit, VecCache(self.keys, new_time, self.vecs,
+                                  self.clock + 1)
+
+    @jax.jit
+    def store(self, new_keys, new_vecs):
+        """Insert (m, n_vec) vectors under (m,) keys, evicting the LRU way
+        of each target set (reference AssignCacheIdx + StoreVecs). Returns
+        the new state. Duplicate keys in one batch: last writer wins."""
+        s = self._set_of(new_keys)
+        # LRU way per incoming key (recomputed per key; serialized writes
+        # within a batch colliding on one set may overwrite one way —
+        # the reference's AssignCacheIdx makes the same single-pass choice)
+        lru_way = jnp.argmin(self.time[s], axis=1)
+        keys = self.keys.at[s, lru_way].set(new_keys, mode="drop")
+        time = self.time.at[s, lru_way].set(self.clock + 1, mode="drop")
+        vecs = self.vecs.at[s, lru_way].set(new_vecs, mode="drop")
+        return VecCache(keys, time, vecs, self.clock + 1)

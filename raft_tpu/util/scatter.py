@@ -1,0 +1,29 @@
+"""Scatter helpers (reference ``util/scatter.cuh`` — strided scatter
+kernel; on TPU, XLA's scatter covers it)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def scatter(values, idx, out_len: int = 0, fill=0):
+    """out[idx[i]] = values[i]; ``out_len`` defaults to len(values).
+    Duplicate indices: last write wins (XLA scatter semantics)."""
+    v = as_array(values)
+    i = as_array(idx).astype(jnp.int32)
+    n = out_len if out_len > 0 else v.shape[0]
+    out = jnp.full((n,) + v.shape[1:], fill, v.dtype)
+    return out.at[i].set(v, mode="drop")
+
+
+def scatter_if(values, idx, pred, out_len: int = 0, fill=0):
+    """Like :func:`scatter` but only rows with ``pred[i] != 0`` land."""
+    v = as_array(values)
+    i = as_array(idx).astype(jnp.int32)
+    p = as_array(pred) != 0
+    n = out_len if out_len > 0 else v.shape[0]
+    i = jnp.where(p, i, n)  # out-of-range → dropped
+    out = jnp.full((n,) + v.shape[1:], fill, v.dtype)
+    return out.at[i].set(v, mode="drop")

@@ -164,6 +164,22 @@ class TestIvfFlat:
         _, iref = nn.kneighbors(q)
         assert recall(np.asarray(i), iref) > 0.999
 
+    def test_list_order_matches_probe_order(self, dataset):
+        # the inverted (list-major) scan must produce the probe-major
+        # scan's results: same lists scored, same distances (f32 here)
+        x, q = dataset
+        params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8)
+        index = ivf_flat.build(x, params)
+        dp, ip = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="probe"))
+        dl, il = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list"))
+        np.testing.assert_array_equal(np.asarray(ip), np.asarray(il))
+        np.testing.assert_allclose(np.asarray(dp), np.asarray(dl),
+                                   rtol=1e-4, atol=1e-3)
+
 
 class TestIvfPq:
     def test_recall_gate(self, dataset):
@@ -189,6 +205,21 @@ class TestIvfPq:
         _, iref = nn.kneighbors(q)
         r = recall(np.asarray(i2), iref)
         assert r > 0.95, f"refined ivf_pq recall {r}"
+
+    def test_list_order_matches_probe_order(self, dataset):
+        # same PQ approximation either way; near-ties may flip under the
+        # two paths' different bf16 rounding order, so gate on overlap
+        x, q = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_bits=8, pq_dim=8,
+                                    kmeans_n_iters=8)
+        index = ivf_pq.build(x, params)
+        _, ip = ivf_pq.search(index, q, 10,
+                              ivf_pq.SearchParams(n_probes=16,
+                                                  scan_order="probe"))
+        _, il = ivf_pq.search(index, q, 10,
+                              ivf_pq.SearchParams(n_probes=16,
+                                                  scan_order="list"))
+        assert recall(np.asarray(il), np.asarray(ip)) > 0.98
 
     def test_codes_shape_and_dtype(self, dataset):
         x, _ = dataset
