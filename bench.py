@@ -10,6 +10,13 @@ SURVEY.md §4).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Robustness (round-1 postmortem: the whole round's perf evidence died on
+one transient "Unable to initialize backend 'axon'" at first dispatch):
+the parent process runs the measurement in a child subprocess, retries
+TPU bring-up with backoff, falls back to a degraded CPU measurement if
+the TPU never comes up, and emits a parseable JSON line on *every* exit
+path.
+
 vs_baseline: the reference repo publishes no absolute numbers
 (BASELINE.md); the declared baseline proxy is 40 ms wall for the
 1M×128×1000q×k=32 search on the reference's A100 class hardware — the
@@ -19,9 +26,9 @@ vs_baseline = proxy_ms / measured_ms (>1 means faster than proxy).
 
 import json
 import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 N_DB = int(os.environ.get("BENCH_N_DB", 1_000_000))
 N_DIM = int(os.environ.get("BENCH_DIM", 128))
@@ -30,21 +37,41 @@ K = int(os.environ.get("BENCH_K", 32))
 BASELINE_PROXY_MS = 40.0
 MIN_RECALL = 0.95
 
+TPU_ATTEMPTS = 3
+TPU_BACKOFF_S = (5.0, 30.0)
+CHILD_TIMEOUT_S = float(os.environ.get("BENCH_CHILD_TIMEOUT", 1500))
 
-from bench_suite import _sync as _fetch  # host-transfer completion barrier
-# (block_until_ready returns early on the tunneled axon platform; see
-# .claude/skills/verify/SKILL.md)
+
+def _init_backend_with_retry(jax, attempts=4, base_sleep=5.0):
+    """jax.devices() with in-process retries: a transient tunnel hiccup at
+    first dispatch must not kill the measurement."""
+    last = None
+    for a in range(attempts):
+        try:
+            return jax.devices()
+        except Exception as e:  # backend init failures surface as RuntimeError
+            last = e
+            try:
+                jax.clear_backends()
+            except Exception:
+                pass
+            time.sleep(base_sleep * (a + 1))
+    raise last
 
 
-def main():
+def child_main():
+    import numpy as np
     import jax
-    # BENCH_PLATFORM=cpu for smoke runs: the env-var route
+    # BENCH_PLATFORM=cpu for smoke/degraded runs: the env-var route
     # (JAX_PLATFORMS) is overridden by the host sitecustomize, so the
     # config API is the only reliable selector
     if "BENCH_PLATFORM" in os.environ:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    _init_backend_with_retry(jax)
     import jax.numpy as jnp
 
+    from bench_suite import _sync as _fetch  # host-transfer completion barrier
+    # (block_until_ready returns early on the tunneled axon platform)
     from raft_tpu.neighbors.brute_force import brute_force_knn
     from raft_tpu.distance.distance_types import DistanceType
     from raft_tpu.ops.dispatch import pallas_enabled
@@ -83,7 +110,7 @@ def main():
     # stream sync). Per-dispatch tunnel latency on the axon platform is
     # ~25 ms and does not pipeline across dispatches, so timing separate
     # dispatches would measure the tunnel, not the kernel.
-    n_iters = 10
+    n_iters = int(os.environ.get("BENCH_CHAIN", 10))
     q_batches = jax.device_put(jax.random.normal(
         jax.random.fold_in(kq, 7), (n_iters, N_QUERIES, N_DIM),
         dtype=jnp.float32))
@@ -109,17 +136,102 @@ def main():
     wall = min(walls)  # best-of-3: tunnel jitter is not kernel time
     ms = wall * 1e3
     qps = N_QUERIES / wall
-    print(json.dumps({
+    platform = jax.devices()[0].platform
+    out = {
         "metric": (f"bfknn_{mode}_search_{N_DB//1000}kx{N_DIM}"
                    f"_q{N_QUERIES}_k{K}_qps"),
         "value": round(qps, 1),
         "unit": "queries/s",
         "vs_baseline": round(BASELINE_PROXY_MS / ms, 3),
-    }))
+    }
+    if platform not in ("tpu", "axon"):
+        out["degraded_platform"] = platform
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_child(extra_env, timeout_s):
+    """Run this script as a measurement child; return its JSON dict or
+    None. The subprocess boundary makes backend-init failures retryable —
+    a poisoned backend cache dies with the child."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        stdout = proc.stdout or ""
+        rc_note = f"rc={proc.returncode}"
+        stderr = proc.stderr or ""
+    except subprocess.TimeoutExpired as e:
+        # a child that printed its result then hung at teardown (tunnel
+        # exit) still produced a valid measurement — salvage it
+        stdout = (e.stdout if isinstance(e.stdout, str)
+                  else (e.stdout or b"").decode("utf-8", "replace"))
+        rc_note = "child timeout"
+        stderr = ""
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj, None
+        except (json.JSONDecodeError, ValueError):
+            continue
+    tail = stderr.strip().splitlines()[-3:]
+    return None, f"{rc_note}: " + " | ".join(tail)
+
+
+def parent_main():
+    errors = []
+    for attempt in range(TPU_ATTEMPTS):
+        if attempt:
+            time.sleep(TPU_BACKOFF_S[min(attempt - 1,
+                                         len(TPU_BACKOFF_S) - 1)])
+        result, err = _run_child({}, CHILD_TIMEOUT_S)
+        if result is not None:
+            print(json.dumps(result), flush=True)
+            return 0
+        errors.append(f"tpu[{attempt}]: {err}")
+        print(f"# bench attempt {attempt} failed: {err}", file=sys.stderr)
+
+    # degraded path: measure on CPU at a reduced shape so the round still
+    # has a perf artifact (flagged via the metric name + degraded key)
+    result, err = _run_child(
+        {"BENCH_PLATFORM": "cpu",
+         "BENCH_N_DB": str(min(N_DB, 100_000)),
+         "BENCH_CHAIN": "2"},
+        CHILD_TIMEOUT_S)
+    if result is not None:
+        result["degraded"] = True
+        result["errors"] = errors
+        print(json.dumps(result), flush=True)
+        return 0
+    errors.append(f"cpu: {err}")
+
+    # last resort: still one parseable line
+    print(json.dumps({
+        "metric": f"bfknn_fused_search_{N_DB//1000}kx{N_DIM}"
+                  f"_q{N_QUERIES}_k{K}_qps",
+        "value": 0.0,
+        "unit": "queries/s",
+        "vs_baseline": 0.0,
+        "failed": True,
+        "errors": errors,
+    }), flush=True)
+    return 0
+
+
+def main():
+    """Back-compat direct entry (runs the measurement in-process)."""
+    return child_main()
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD"):
+        sys.exit(child_main())
+    sys.exit(parent_main())
 
 
 def run_suite():

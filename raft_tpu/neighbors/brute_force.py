@@ -167,12 +167,16 @@ def knn_merge_parts(part_dists, part_indices, k: int, select_min: bool = True,
                     res=None) -> Tuple[jax.Array, jax.Array]:
     """Merge per-part top-k lists into a global top-k (reference
     ``knn_merge_parts``, brute_force.cuh:48 — BlockSelect heap merge; here
-    one concat + top_k, which XLA fuses)."""
+    one concat + ``select_k``, whose Pallas merge kernel is the
+    BlockSelect analogue)."""
+    from raft_tpu.neighbors.selection import select_k
     d = jnp.concatenate([as_array(x) for x in part_dists], axis=1)
     i = jnp.concatenate([as_array(x) for x in part_indices], axis=1)
-    sign = 1.0 if select_min else -1.0
-    nd, sel = lax.top_k(-sign * d, k)
-    return sign * -nd, jnp.take_along_axis(i, sel, axis=1)
+    vals, sel = select_k(d, k, select_min=select_min)
+    # kernel-path -1 sentinels (rows with < k finite candidates) must
+    # stay -1, not clamp-gather part 0's first id
+    out_i = jnp.take_along_axis(i, jnp.maximum(sel, 0), axis=1)
+    return vals, jnp.where(sel >= 0, out_i, -1)
 
 
 def haversine_knn(db, queries, k: int, res=None

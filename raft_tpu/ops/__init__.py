@@ -9,6 +9,8 @@ CUDA kernels:
 * :mod:`raft_tpu.ops.pallas_fused_knn` — fused distance + in-kernel top-k
   (reference ``spatial/knn/detail/fused_l2_knn.cuh:196``), using the
   binned partial-top-k trick of TPU-KNN (PAPERS.md).
+* :mod:`raft_tpu.ops.pallas_select_k` — exact k-selection by filtered
+  merge (reference warpsort, ``spatial/knn/detail/topk.cuh:65``).
 
 Every kernel has an XLA reference formulation in the primitive layer; the
 public APIs dispatch between them via :mod:`raft_tpu.ops.dispatch`. A
@@ -31,11 +33,13 @@ __all__ = [
     "pallas_interpret",
     "fused_l2_nn_pallas",
     "fused_knn_pallas",
+    "select_k_pallas",
 ]
 
 _LAZY = {
     "fused_l2_nn_pallas": "raft_tpu.ops.pallas_fused_l2_nn",
     "fused_knn_pallas": "raft_tpu.ops.pallas_fused_knn",
+    "select_k_pallas": "raft_tpu.ops.pallas_select_k",
 }
 
 

@@ -128,3 +128,85 @@ class TestFusedKnnPallas:
             len(set(np.asarray(fi[r])) & set(np.asarray(ei[r]))) / 4
             for r in range(20)])
         assert agree >= 0.9
+
+
+class TestSelectKPallas:
+    """Exact warpsort-slot kernel (ops/pallas_select_k.py) vs numpy sort
+    — exactness required, unlike the recall-gated fused-kNN bins."""
+
+    @pytest.mark.parametrize("m,n,k", [(7, 33, 5), (64, 4096, 32),
+                                       (3, 8, 8), (129, 1000, 1),
+                                       (100, 513, 100)])
+    def test_exact_min(self, m, n, k, rng_np):
+        from raft_tpu.ops import select_k_pallas
+        v = rng_np.normal(size=(m, n)).astype(np.float32)
+        d, i = select_k_pallas(jnp.asarray(v), k)
+        want = np.sort(v, axis=1)[:, :k]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-6,
+                                   atol=1e-6)
+        np.testing.assert_allclose(
+            np.take_along_axis(v, np.asarray(i), axis=1), want,
+            rtol=1e-6, atol=1e-6)
+
+    def test_exact_max_and_sorted(self, rng_np):
+        from raft_tpu.ops import select_k_pallas
+        v = rng_np.normal(size=(40, 700)).astype(np.float32)
+        d, i = select_k_pallas(jnp.asarray(v), 9, select_min=False)
+        want = -np.sort(-v, axis=1)[:, :9]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-6,
+                                   atol=1e-6)
+        assert np.all(np.diff(np.asarray(d), axis=1) <= 1e-6)
+
+    def test_ties_deterministic_and_consistent(self, rng_np):
+        from raft_tpu.ops import select_k_pallas
+        v = np.repeat(rng_np.normal(size=(10, 50)).astype(np.float32), 4,
+                      axis=1)
+        d, i = select_k_pallas(jnp.asarray(v), 6)
+        want = np.sort(v, axis=1)[:, :6]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-6,
+                                   atol=1e-6)
+        # returned ids must reproduce the returned values, and single-tile
+        # ties resolve to the lowest column index (50 cols = one tile)
+        np.testing.assert_allclose(
+            np.take_along_axis(v, np.asarray(i), axis=1), want,
+            rtol=1e-6, atol=1e-6)
+        stable = np.argsort(v, axis=1, kind="stable")[:, :6]
+        np.testing.assert_array_equal(np.asarray(i), stable)
+        d2, i2 = select_k_pallas(jnp.asarray(v), 6)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
+
+    def test_short_rows_get_sentinels(self):
+        from raft_tpu.ops import select_k_pallas
+        v = np.full((4, 16), np.inf, np.float32)
+        v[:, 5] = 1.0
+        d, i = select_k_pallas(jnp.asarray(v), 4)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], 5)
+        np.testing.assert_array_equal(np.asarray(i)[:, 1:], -1)
+        assert np.all(np.isinf(np.asarray(d)[:, 1:]))
+
+    def test_select_k_dispatches_to_kernel(self, monkeypatch, rng_np):
+        from raft_tpu.neighbors.selection import select_k
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        v = rng_np.normal(size=(16, 640)).astype(np.float32)
+        d, i = select_k(v, 12)
+        want = np.sort(v, axis=1)[:, :12]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-6,
+                                   atol=1e-6)
+
+    def test_merge_parts_uses_kernel(self, monkeypatch, rng_np):
+        from raft_tpu.neighbors.brute_force import knn_merge_parts
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        k = 8
+        pd = [np.sort(rng_np.normal(size=(20, k)).astype(np.float32), 1)
+              for _ in range(3)]
+        pi = [rng_np.integers(0, 10000, size=(20, k)).astype(np.int32)
+              for _ in range(3)]
+        d, i = knn_merge_parts(pd, pi, k)
+        cat_d = np.concatenate(pd, axis=1)
+        cat_i = np.concatenate(pi, axis=1)
+        want = np.sort(cat_d, axis=1)[:, :k]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-6,
+                                   atol=1e-6)
+        sel = np.argsort(cat_d, axis=1, kind="stable")[:, :k]
+        np.testing.assert_array_equal(
+            np.asarray(i), np.take_along_axis(cat_i, sel, axis=1))
