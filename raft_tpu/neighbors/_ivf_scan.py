@@ -104,6 +104,33 @@ def _score_block(qsub, data, norms, scale):
     return qq[:, :, None] + norms[:, None, :] - 2.0 * ip
 
 
+def merge_candidates(cand_d, cand_i, probes, inv_pos, k: int,
+                     sqrt: bool, use_pallas_select: bool = False):
+    """Shared tail of both list-major scans: gather each (query, probe)
+    pair's candidate row from the (n_lists, cap, kk) blocks and merge to
+    the per-query top-k. ``-1`` candidate ids stay ``-1``."""
+    nq = probes.shape[0]
+    pd = cand_d[probes, inv_pos].reshape(nq, -1)
+    pi = cand_i[probes, inv_pos].reshape(nq, -1)
+    pd = jnp.where(pi >= 0, pd, jnp.inf)
+    if pd.shape[1] < k:  # fewer candidates than k: pad like the carry init
+        short = k - pd.shape[1]
+        pd = jnp.pad(pd, ((0, 0), (0, short)), constant_values=jnp.inf)
+        pi = jnp.pad(pi, ((0, 0), (0, short)), constant_values=-1)
+        use_pallas_select = False
+    if use_pallas_select:
+        from raft_tpu.ops.pallas_select_k import select_k_pallas
+        d, sel = select_k_pallas(pd, k)
+    else:
+        nd, sel = lax.top_k(-pd, k)
+        d = -nd
+    ids = jnp.take_along_axis(pi, jnp.maximum(sel, 0), axis=1)
+    ids = jnp.where(sel >= 0, ids, -1)
+    if sqrt:
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+    return d, ids
+
+
 @functools.partial(jax.jit, static_argnames=("n_probes",))
 def coarse_probes(queries, centers, n_probes: int):
     """Coarse phase (reference select_clusters, ivf_pq_search.cuh:127):
@@ -186,16 +213,4 @@ def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
             one_chunk, (qmap_c, data_c, norms_c, ids_c, off_c))
     cand_d = cand_d.reshape(n_lists, cap, kk)
     cand_i = cand_i.reshape(n_lists, cap, kk)
-
-    # gather each (query, probe) pair's candidate row back: (nq, n_probes, kk)
-    pd = cand_d[probes, inv_pos].reshape(nq, -1)
-    pi = cand_i[probes, inv_pos].reshape(nq, -1)
-    if pd.shape[1] < k:  # fewer candidates than k: pad like the carry init
-        short = k - pd.shape[1]
-        pd = jnp.pad(pd, ((0, 0), (0, short)), constant_values=jnp.inf)
-        pi = jnp.pad(pi, ((0, 0), (0, short)), constant_values=-1)
-    nd, sel = lax.top_k(-pd, k)
-    d = -nd
-    if sqrt:
-        d = jnp.sqrt(jnp.maximum(d, 0.0))
-    return d, jnp.take_along_axis(pi, sel, axis=1)
+    return merge_candidates(cand_d, cand_i, probes, inv_pos, k, sqrt)

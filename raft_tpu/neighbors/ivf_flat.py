@@ -67,8 +67,10 @@ class SearchParams:
 
     n_probes: int = 20
     scan_order: str = "auto"
-    # list-order selection: 0 = exact per-(list,query) top-k; >0 = that
-    # many min-bins per list (TPU-KNN partial top-k; >=2k recommended)
+    # list-order candidate selection: 0 = auto (exact per-(list,query)
+    # top-k on the XLA path; 4k strided min-bins in the Pallas kernel —
+    # the TPU-KNN partial top-k, recall-gated); -1 = exact on every
+    # path; >0 = explicitly that many min-bins per list
     scan_bins: int = 0
 
 
@@ -280,8 +282,15 @@ def search(index: Index, queries, k: int,
                     and nq >= 64 and nq * n_probes >= 4 * index.n_lists))
     if use_list:
         from raft_tpu.neighbors import _ivf_scan
+        from raft_tpu.ops.dispatch import pallas_enabled
         probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)
         cap = _ivf_scan.probe_cap(probes, index.n_lists)
+        if pallas_enabled():
+            from raft_tpu.ops.pallas_ivf_scan import ivf_list_scan_pallas
+            return ivf_list_scan_pallas(
+                q, index.lists_data, index.lists_norms,
+                index.lists_indices, probes, k, cap, scale=index.scale,
+                bins=params.scan_bins, sqrt=sqrt)
         chunk = _ivf_scan._chunk_size(
             index.n_lists, cap, index.lists_indices.shape[1])
         return _ivf_scan.inverted_scan(

@@ -34,12 +34,14 @@ __all__ = [
     "fused_l2_nn_pallas",
     "fused_knn_pallas",
     "select_k_pallas",
+    "ivf_list_scan_pallas",
 ]
 
 _LAZY = {
     "fused_l2_nn_pallas": "raft_tpu.ops.pallas_fused_l2_nn",
     "fused_knn_pallas": "raft_tpu.ops.pallas_fused_knn",
     "select_k_pallas": "raft_tpu.ops.pallas_select_k",
+    "ivf_list_scan_pallas": "raft_tpu.ops.pallas_ivf_scan",
 }
 
 

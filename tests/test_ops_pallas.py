@@ -210,3 +210,67 @@ class TestSelectKPallas:
         sel = np.argsort(cat_d, axis=1, kind="stable")[:, :k]
         np.testing.assert_array_equal(
             np.asarray(i), np.take_along_axis(cat_i, sel, axis=1))
+
+
+class TestIvfListScanPallas:
+    """Fused list-major IVF fine scan (ops/pallas_ivf_scan.py) — recall
+    gates mirror the reference's ANN test strategy (SURVEY.md §4)."""
+
+    @pytest.fixture(scope="class")
+    def blob_index(self):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.random import make_blobs
+        x, _ = make_blobs(n_samples=8000, n_features=24, centers=40,
+                          cluster_std=3.0, seed=0)
+        q, _ = make_blobs(n_samples=80, n_features=24, centers=40,
+                          cluster_std=3.0, seed=1)
+        x = jnp.asarray(np.asarray(x))
+        q = jnp.asarray(np.asarray(q))
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=32,
+                                                     kmeans_n_iters=4))
+        return idx, x, q
+
+    def _recall(self, got, want, k):
+        return np.mean([
+            len(set(np.asarray(got[r])) & set(np.asarray(want[r]))) / k
+            for r in range(got.shape[0])])
+
+    def test_exact_bins_all_probes_equals_exact_knn(self, blob_index,
+                                                    monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = blob_index
+        k, ml = 8, int(idx.lists_indices.shape[1])
+        d, i = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=32, scan_order="list", scan_bins=ml))
+        xn, qn = np.asarray(x), np.asarray(q)
+        d2 = ((xn ** 2).sum(1)[None, :] + (qn ** 2).sum(1)[:, None]
+              - 2 * qn @ xn.T)
+        np.testing.assert_allclose(np.asarray(d), np.sort(d2, 1)[:, :k],
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_binned_recall_gate_vs_probe_major(self, blob_index,
+                                               monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = blob_index
+        k = 8
+        d_b, i_b = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=8, scan_order="list"))
+        d_r, i_r = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=8, scan_order="probe"))
+        assert self._recall(i_b, i_r, k) >= 0.95
+
+    @pytest.mark.parametrize("storage", ["bfloat16", "int8"])
+    def test_narrow_storage_recall(self, blob_index, storage, monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        _, x, q = blob_index
+        k = 8
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4, storage_dtype=storage))
+        d_b, i_b = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=8, scan_order="list"))
+        d_r, i_r = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=8, scan_order="probe"))
+        assert self._recall(i_b, i_r, k) >= 0.9
