@@ -73,16 +73,21 @@ def _invert_probes(probes, n_lists: int, cap: int):
     return qmap.reshape(n_lists, cap), inv_pos.reshape(nq, n_probes)
 
 
+def largest_divisor_at_most(n: int, want: int) -> int:
+    """Largest divisor of ``n`` that is ≤ ``want`` (≥ 1)."""
+    c = 1
+    for d in range(1, n + 1):
+        if n % d == 0 and d <= want:
+            c = d
+    return c
+
+
 def _chunk_size(n_lists: int, cap: int, max_list: int,
                 budget_elems: int = 1 << 24) -> int:
     """Largest divisor of n_lists whose (chunk, cap, max_list) score
     block stays under ~``budget_elems`` f32 elements (64 MiB default)."""
     want = max(1, budget_elems // max(1, cap * max_list))
-    c = 1
-    for d in range(1, n_lists + 1):
-        if n_lists % d == 0 and d <= want:
-            c = d
-    return c
+    return largest_divisor_at_most(n_lists, want)
 
 
 def _score_block(qsub, data, norms, scale):
@@ -131,13 +136,18 @@ def merge_candidates(cand_d, cand_i, probes, inv_pos, k: int,
     return d, ids
 
 
-@functools.partial(jax.jit, static_argnames=("n_probes",))
-def coarse_probes(queries, centers, n_probes: int):
+@functools.partial(jax.jit, static_argnames=("n_probes", "kind"))
+def coarse_probes(queries, centers, n_probes: int, kind: str = "l2"):
     """Coarse phase (reference select_clusters, ivf_pq_search.cuh:127):
     run separately so the host can size the inverted table from its
-    output before the fine-scan jit is staged."""
+    output before the fine-scan jit is staged. ``kind`` "ip" probes the
+    largest-dot-product centers."""
     from raft_tpu.distance.pairwise import _l2_expanded
-    coarse = _l2_expanded(queries, centers, sqrt=False)
+    if kind == "ip":
+        coarse = -jnp.matmul(queries, centers.T,
+                             precision=matmul_precision())
+    else:
+        coarse = _l2_expanded(queries, centers, sqrt=False)
     return lax.top_k(-coarse, n_probes)[1]
 
 

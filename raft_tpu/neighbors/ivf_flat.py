@@ -99,6 +99,16 @@ class Index:
         return self.centers.shape[1]
 
 
+def _coarse_scores(queries, centers, kind: str):
+    """Coarse cluster scores, smaller-is-better (reference
+    select_clusters GEMM, ivf_pq_search.cuh:127): expanded L2, or
+    negated dot for the ip core."""
+    if kind == "ip":
+        return -jnp.matmul(queries, centers.T,
+                           precision=matmul_precision())
+    return _l2_expanded(queries, centers, sqrt=False)
+
+
 def _bucketize(x, labels, n_lists: int, round_to: int = 8):
     """Scatter rows into padded per-list buckets — static-shape layout."""
     n, dim = x.shape
@@ -124,18 +134,45 @@ def _bucketize(x, labels, n_lists: int, round_to: int = 8):
     return data, idx, norms, counts
 
 
+_SIM_METRICS = (DistanceType.InnerProduct, DistanceType.CosineExpanded)
+
+
+def _metric_kind(metric: DistanceType) -> str:
+    """"l2" or "ip" — the two scoring cores (reference
+    ivf_flat_search.cuh metric dispatch; cosine rides the ip core after
+    row normalization, the processing.cuh preprocessing trick)."""
+    return "ip" if metric in _SIM_METRICS else "l2"
+
+
+def _postprocess(d, metric: DistanceType):
+    """Kernel-internal scores are uniformly smaller-is-better (-sim for
+    the ip core); map back to the metric's output convention: IP →
+    similarities (descending), cosine → 1 − cos (ascending)."""
+    if metric == DistanceType.InnerProduct:
+        return -d
+    if metric == DistanceType.CosineExpanded:
+        return 1.0 + d
+    return d
+
+
 def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
     """Train + populate (reference ivf_flat_build.cuh:228 build =
-    train balanced kmeans then extend with the full dataset)."""
+    train balanced kmeans then extend with the full dataset). Cosine
+    datasets are row-normalized at build (reference processing.cuh) so
+    the ip scoring core applies."""
     x = as_array(dataset).astype(jnp.float32)
     n = x.shape[0]
     expects(params.n_lists <= n, "ivf_flat.build: n_lists > n_samples")
     expects(params.metric in (DistanceType.L2Expanded,
                               DistanceType.L2SqrtExpanded,
                               DistanceType.L2Unexpanded,
-                              DistanceType.L2SqrtUnexpanded),
-            "ivf_flat: only L2-family metrics are supported (got %s)",
-            params.metric)
+                              DistanceType.L2SqrtUnexpanded,
+                              DistanceType.InnerProduct,
+                              DistanceType.CosineExpanded),
+            "ivf_flat: unsupported metric %s", params.metric)
+    if params.metric == DistanceType.CosineExpanded:
+        x = x / jnp.maximum(
+            jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
     # random trainset subsample — a prefix would bias centers when input
     # rows arrive ordered (reference subsamples too)
@@ -180,6 +217,11 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     nearest centers and re-bucket. Centers are kept fixed (the reference's
     default; adaptive_centers handled at build)."""
     x_new = as_array(new_vectors).astype(jnp.float32)
+    if index.metric == DistanceType.CosineExpanded:
+        # build() stores row-normalized vectors for cosine; extended
+        # rows must match or the ip core scores raw dot products
+        x_new = x_new / jnp.maximum(
+            jnp.linalg.norm(x_new, axis=1, keepdims=True), 1e-30)
     n_lists = index.n_lists
     # reconstruct flat (data, ids) view of current contents, dequantized
     # to f32 (narrow storage is re-applied after re-bucketing)
@@ -212,12 +254,13 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
 
 
 def _score_probe(queries, qq, lists_data, lists_norms, lists_indices,
-                 list_id, scale: float = 1.0):
-    """Score one probe rank: per-query (max_list,) distances + ids — the
+                 list_id, scale: float = 1.0, kind: str = "l2"):
+    """Score one probe rank: per-query (max_list,) scores + ids — the
     fine-phase GEMM shared by single-chip and sharded searches
     (reference interleaved_scan_kernel, ivf_flat_search.cuh:665).
     Handles narrow list storage: bf16 rides the MXU directly; int8 is
-    dequantized by folding ``scale`` into the accumulated product."""
+    dequantized by folding ``scale`` into the accumulated product.
+    ``kind`` "ip" returns negated similarities (smaller-is-better)."""
     data = lists_data[list_id]                  # (nq, max_list, dim)
     ids = lists_indices[list_id]                # (nq, max_list)
     if data.dtype == jnp.bfloat16:
@@ -231,26 +274,31 @@ def _score_probe(queries, qq, lists_data, lists_norms, lists_indices,
         ip = jnp.einsum("qd,qld->ql", queries, data,
                         preferred_element_type=jnp.float32,
                         precision=matmul_precision())
+    if kind == "ip":
+        return jnp.where(ids >= 0, -ip, jnp.inf), ids
     d = qq[:, None] + lists_norms[list_id] - 2.0 * ip
     return jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf), ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes", "sqrt"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "n_probes", "sqrt", "kind"))
 def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
-                 scale, k: int, n_probes: int, sqrt: bool):
+                 scale, k: int, n_probes: int, sqrt: bool,
+                 kind: str = "l2"):
     nq, dim = queries.shape
 
     # ---- coarse phase (reference ivf_flat_search.cuh:1070-1147):
     # query×centers GEMM + top-k probes
     qq = jnp.sum(queries * queries, axis=1)
-    coarse = _l2_expanded(queries, centers, sqrt=False)
+    coarse = _coarse_scores(queries, centers, kind)
     _, probes = lax.top_k(-coarse, n_probes)  # (nq, n_probes)
 
     # ---- fine phase: scan over probe rank; each rank is one batched GEMM
     def probe_step(carry, p):
         best_d, best_i = carry
         d, ids = _score_probe(queries, qq, lists_data, lists_norms,
-                              lists_indices, probes[:, p], scale)
+                              lists_indices, probes[:, p], scale,
+                              kind=kind)
         cat_d = jnp.concatenate([best_d, d], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
         nd, sel = lax.top_k(-cat_d, k)
@@ -276,27 +324,40 @@ def search(index: Index, queries, k: int,
     n_probes = min(params.n_probes, index.n_lists)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
+    kind = _metric_kind(index.metric)
+    if index.metric == DistanceType.CosineExpanded:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                            1e-30)
+    from raft_tpu.ops.dispatch import pallas_enabled
     nq = q.shape[0]
-    use_list = (params.scan_order == "list"
-                or (params.scan_order == "auto"
-                    and nq >= 64 and nq * n_probes >= 4 * index.n_lists))
+    # the XLA-tier list scan only has the l2 core; don't pay the coarse
+    # phase + probe_cap host sync just to fall through to probe-major
+    use_list = ((pallas_enabled() or kind == "l2")
+                and (params.scan_order == "list"
+                     or (params.scan_order == "auto"
+                         and nq >= 64
+                         and nq * n_probes >= 4 * index.n_lists)))
     if use_list:
         from raft_tpu.neighbors import _ivf_scan
-        from raft_tpu.ops.dispatch import pallas_enabled
-        probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)
+        probes = _ivf_scan.coarse_probes(q, index.centers, n_probes,
+                                         kind=kind)
         cap = _ivf_scan.probe_cap(probes, index.n_lists)
         if pallas_enabled():
             from raft_tpu.ops.pallas_ivf_scan import ivf_list_scan_pallas
-            return ivf_list_scan_pallas(
+            d, i = ivf_list_scan_pallas(
                 q, index.lists_data, index.lists_norms,
                 index.lists_indices, probes, k, cap, scale=index.scale,
-                bins=params.scan_bins, sqrt=sqrt)
+                bins=params.scan_bins, sqrt=sqrt, metric=kind)
+            return _postprocess(d, index.metric), i
         chunk = _ivf_scan._chunk_size(
             index.n_lists, cap, index.lists_indices.shape[1])
         return _ivf_scan.inverted_scan(
-            q, index.lists_data, index.lists_norms, index.lists_indices,
-            probes, k, cap, chunk, jnp.float32(index.scale),
-            bins=params.scan_bins, sqrt=sqrt)
-    return _search_impl(q, index.centers, index.lists_data,
+            q, index.lists_data, index.lists_norms,
+            index.lists_indices, probes, k, cap, chunk,
+            jnp.float32(index.scale), bins=params.scan_bins,
+            sqrt=sqrt)
+    d, i = _search_impl(q, index.centers, index.lists_data,
                         index.lists_indices, index.lists_norms,
-                        jnp.float32(index.scale), k, n_probes, sqrt)
+                        jnp.float32(index.scale), k, n_probes, sqrt,
+                        kind=kind)
+    return _postprocess(d, index.metric), i

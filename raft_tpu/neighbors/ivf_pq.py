@@ -68,12 +68,20 @@ class IndexParams:
 @dataclass
 class SearchParams:
     n_probes: int = 20
-    # lut/internal dtype knobs kept for parity; bf16 LUT is the useful one
-    lut_dtype: object = jnp.float32
+    # the reference's LUT-precision variants (ivf_pq_search.cuh:780-1004)
+    # mapped to TPU terms — both live on the "codes" scan path:
+    # lut_dtype = decode-tile dtype (bf16 = one MXU pass, f32 = bf16x3
+    # split); internal_distance_dtype = candidate score dtype carried to
+    # the merge (bf16 halves candidate HBM traffic)
+    lut_dtype: object = jnp.bfloat16
     internal_distance_dtype: object = jnp.float32
-    # "reconstruct" = bf16 decoded-cache MXU scan (TPU-native default);
+    # "auto" = "codes" when the Pallas tier is live, else "reconstruct";
+    # "codes" = fused Pallas scan over the u8 codes with transient
+    #           per-chunk decode tiles (pq_dim+8 bytes resident/vector);
+    # "reconstruct" = bf16 decoded-cache MXU scan (XLA formulation;
+    #           persists an ~8x cache over the codes);
     # "lut" = per-probe f32 LUT + gather scan (the CUDA formulation)
-    scan_mode: str = "reconstruct"
+    scan_mode: str = "auto"
     # "probe"/"list"/"auto" — see ivf_flat.SearchParams.scan_order;
     # list-major applies to the reconstruct scan only
     scan_order: str = "auto"
@@ -93,9 +101,15 @@ class Index:
     metric: DistanceType
     pq_bits: int
     size: int
-    # bf16 reconstruction cache for the MXU scan path (decoded codes,
-    # (n_lists, max_list, rot_dim)) + its per-row squared norms. Derived
-    # from codes/pq_centers; rebuilt on deserialize.
+    # exact decoded-residual squared norms, (n_lists, max_list) f32:
+    # PQ subspaces concatenate orthogonally so the norm is a sum of
+    # per-subspace codeword norms — computed once at build. With ids
+    # this bounds resident memory at pq_dim+8 bytes/vector.
+    code_norms: Optional[jax.Array] = None
+    # bf16 reconstruction cache for the non-Pallas MXU scan path
+    # (decoded codes, (n_lists, max_list, rot_dim)) + its per-row squared
+    # norms. Derived from codes/pq_centers; built lazily, never on the
+    # "codes" path.
     decoded: Optional[jax.Array] = None
     decoded_norms: Optional[jax.Array] = None
 
@@ -182,9 +196,10 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     expects(params.metric in (DistanceType.L2Expanded,
                               DistanceType.L2SqrtExpanded,
                               DistanceType.L2Unexpanded,
-                              DistanceType.L2SqrtUnexpanded),
-            "ivf_pq: only L2-family metrics are supported (got %s)",
-            params.metric)
+                              DistanceType.L2SqrtUnexpanded,
+                              DistanceType.InnerProduct),
+            "ivf_pq: L2-family and InnerProduct metrics are supported "
+            "(got %s)", params.metric)
 
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
     if n_train < n:
@@ -224,12 +239,13 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     codes_b = bucketed.astype(jnp.uint8)
 
     # the bf16 reconstruction cache is decoded lazily at first
-    # reconstruct-mode search — LUT-mode users and serialized indexes
-    # never pay its ~8x memory over the codes
+    # reconstruct-mode search — codes/LUT-mode users and serialized
+    # indexes never pay its ~8x memory over the codes
     return Index(centers=centers, centers_rot=centers_rot,
                  rotation_matrix=rot, pq_centers=pq_centers, codes=codes_b,
                  lists_indices=idx, list_sizes=counts, metric=params.metric,
-                 pq_bits=params.pq_bits, size=n)
+                 pq_bits=params.pq_bits, size=n,
+                 code_norms=_code_norms(codes_b, pq_centers, idx))
 
 
 def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
@@ -272,23 +288,41 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     # _bucketize stores row positions; map back to the caller ids
     idx = jnp.where(slot_idx >= 0, all_ids[jnp.clip(slot_idx, 0, None)],
                     jnp.int32(-1))
+    codes_b = bucketed.astype(jnp.uint8)
     return Index(centers=index.centers, centers_rot=index.centers_rot,
                  rotation_matrix=index.rotation_matrix,
                  pq_centers=index.pq_centers,
-                 codes=bucketed.astype(jnp.uint8),
+                 codes=codes_b,
                  lists_indices=idx, list_sizes=counts,
                  metric=index.metric, pq_bits=index.pq_bits,
-                 size=n_old + n_new)
+                 size=n_old + n_new,
+                 code_norms=_code_norms(codes_b, index.pq_centers, idx))
+
+
+@jax.jit
+def _code_norms(codes_b, pq_centers, lists_indices):
+    """Exact ||decoded||² per bucketed slot from the codebook norm
+    table: subspaces are orthogonal coordinate blocks, so the decoded
+    squared norm is Σ_s ||book_s[c_s]||². Pad slots → 0."""
+    n_lists, max_list, pq_dim = codes_b.shape
+    bb = jnp.sum(pq_centers * pq_centers, axis=2)      # (pq_dim, n_codes)
+    flat = codes_b.reshape(-1, pq_dim).astype(jnp.int32)
+    norms = jnp.zeros((flat.shape[0],), jnp.float32)
+    for s in range(pq_dim):
+        norms = norms + bb[s][flat[:, s]]
+    norms = norms.reshape(n_lists, max_list)
+    return jnp.where(lists_indices >= 0, norms, 0.0)
 
 
 @jax.jit
 def _decode_lists(codes_b, pq_centers, lists_indices):
     """Decode bucketed PQ codes → bf16 reconstruction cache
-    ((n_lists, max_list, rot_dim) rotated residuals) + f32 squared norms.
-    One row-gather per subquantizer from its (n_codes, pq_len) table —
-    a single fancy-gather over the stacked books broadcasts a huge
-    (N, pq_dim, n_codes, pq_len) intermediate on TPU and OOMs at ~1M
-    rows; the per-subspace loop stays O(N·pq_len) per step."""
+    ((n_lists, max_list, rot_dim) rotated residuals). Its norms are NOT
+    recomputed here — ``_code_norms`` already holds the identical exact
+    quantity. One row-gather per subquantizer from its (n_codes, pq_len)
+    table — a single fancy-gather over the stacked books broadcasts a
+    huge (N, pq_dim, n_codes, pq_len) intermediate on TPU and OOMs at
+    ~1M rows; the per-subspace loop stays O(N·pq_len) per step."""
     n_lists, max_list, pq_dim = codes_b.shape
     _, n_codes, pq_len = pq_centers.shape
     flat = codes_b.reshape(-1, pq_dim).astype(jnp.int32)   # (N, pq_dim)
@@ -300,17 +334,23 @@ def _decode_lists(codes_b, pq_centers, lists_indices):
     # are harmless (scores for pads are masked at search anyway)
     valid = (lists_indices >= 0)[:, :, None]
     dec = jnp.where(valid, dec, 0.0)
-    norms = jnp.sum(dec.astype(jnp.float32) ** 2, axis=2)
-    return dec.astype(jnp.bfloat16), norms
+    return dec.astype(jnp.bfloat16)
 
 
 def _score_probe_reconstruct(q_rot, centers_rot, decoded, decoded_norms,
-                             lists_indices, list_id):
+                             lists_indices, list_id, kind: str = "l2"):
     """Score one probe rank via the bf16 reconstruction cache — shared
-    by single-chip and sharded searches."""
-    resid = (q_rot - centers_rot[list_id]).astype(jnp.bfloat16)
+    by single-chip and sharded searches. ``kind`` "ip" scores
+    ``q_rot·(c_l + decoded)`` and returns negated similarities."""
     data = decoded[list_id]                          # (nq, ml, rot_dim)
     ids = lists_indices[list_id]                     # (nq, ml)
+    if kind == "ip":
+        qb = q_rot.astype(jnp.bfloat16)
+        ip = jnp.einsum("qd,qld->ql", qb, data,
+                        preferred_element_type=jnp.float32)
+        cq = jnp.sum(q_rot * centers_rot[list_id], axis=1)  # (nq,)
+        return jnp.where(ids >= 0, -(ip + cq[:, None]), jnp.inf), ids
+    resid = (q_rot - centers_rot[list_id]).astype(jnp.bfloat16)
     ip = jnp.einsum("qd,qld->ql", resid, data,
                     preferred_element_type=jnp.float32)
     rr = jnp.sum(resid.astype(jnp.float32) ** 2, axis=1)
@@ -318,17 +358,20 @@ def _score_probe_reconstruct(q_rot, centers_rot, decoded, decoded_norms,
     return jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf), ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes", "sqrt"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "n_probes", "sqrt", "kind"))
 def _search_impl_reconstruct(queries, centers, centers_rot, rot, decoded,
                              decoded_norms, lists_indices, k: int,
-                             n_probes: int, sqrt: bool):
+                             n_probes: int, sqrt: bool,
+                             kind: str = "l2"):
     """MXU scan over the bf16 reconstruction cache: per probe rank,
     score = ||resid - decoded||² via the expanded form — the IVF-Flat
     interleaved-scan analogue (ivf_flat_search.cuh:665) with residuals
     in place of raw queries."""
     nq, dim = queries.shape
 
-    coarse = _l2_expanded(queries, centers, sqrt=False)
+    from raft_tpu.neighbors.ivf_flat import _coarse_scores
+    coarse = _coarse_scores(queries, centers, kind)
     _, probes = lax.top_k(-coarse, n_probes)
     q_rot = jnp.matmul(queries, rot.T, precision=matmul_precision())
 
@@ -336,7 +379,7 @@ def _search_impl_reconstruct(queries, centers, centers_rot, rot, decoded,
         best_d, best_i = carry
         d, ids = _score_probe_reconstruct(
             q_rot, centers_rot, decoded, decoded_norms, lists_indices,
-            probes[:, p])
+            probes[:, p], kind=kind)
         cat_d = jnp.concatenate([best_d, d], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
         nd, sel = lax.top_k(-cat_d, k)
@@ -350,33 +393,50 @@ def _search_impl_reconstruct(queries, centers, centers_rot, rot, decoded,
     return d, i
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes", "sqrt"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "n_probes", "sqrt", "kind"))
 def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
-                 lists_indices, k: int, n_probes: int, sqrt: bool):
+                 lists_indices, k: int, n_probes: int, sqrt: bool,
+                 kind: str = "l2"):
     nq, dim = queries.shape
     n_lists = centers.shape[0]
     pq_dim, n_codes, pq_len = pq_centers.shape
 
     # coarse: select_clusters (reference :127)
-    coarse = _l2_expanded(queries, centers, sqrt=False)
+    from raft_tpu.neighbors.ivf_flat import _coarse_scores
+    coarse = _coarse_scores(queries, centers, kind)
     _, probes = lax.top_k(-coarse, n_probes)
 
     q_rot = queries @ rot.T  # (nq, rot_dim) (reference :1360 query rotation)
 
     bb = jnp.sum(pq_centers * pq_centers, axis=2)  # (pq_dim, n_codes)
 
+    # the IP LUT is probe-independent (no residual): LUT[q, s, j] =
+    # sub_q(q,s)·book[s, j]; the per-probe center term q_rot·c_l is
+    # added after the code gather (reference ip distance dispatch).
+    # Hoisted out of the scan so it runs once, not n_probes times.
+    ip_lut = None
+    if kind == "ip":
+        ip_lut = jnp.einsum("qsl,sjl->qsj",
+                            q_rot.reshape(nq, pq_dim, pq_len), pq_centers,
+                            preferred_element_type=jnp.float32,
+                            precision=matmul_precision())
+
     def probe_step(carry, p):
         best_d, best_i = carry
         list_id = probes[:, p]                           # (nq,)
-        # per-query LUT from the rotated residual wrt this probe's center
-        resid = q_rot - centers_rot[list_id]             # (nq, rot_dim)
-        sub = resid.reshape(nq, pq_dim, pq_len)
-        # LUT[q, s, j] = ||sub(q,s) - pq_centers[s, j]||²
-        ip = jnp.einsum("qsl,sjl->qsj", sub, pq_centers,
-                        preferred_element_type=jnp.float32,
-                        precision=matmul_precision())
-        ss = jnp.sum(sub * sub, axis=2)
-        lut = ss[:, :, None] + bb[None, :, :] - 2.0 * ip  # (nq, pq_dim, n_codes)
+        if kind == "ip":
+            lut = ip_lut
+        else:
+            # per-query LUT from the rotated residual wrt this center
+            resid = q_rot - centers_rot[list_id]         # (nq, rot_dim)
+            sub = resid.reshape(nq, pq_dim, pq_len)
+            # LUT[q, s, j] = ||sub(q,s) - pq_centers[s, j]||²
+            ip = jnp.einsum("qsl,sjl->qsj", sub, pq_centers,
+                            preferred_element_type=jnp.float32,
+                            precision=matmul_precision())
+            ss = jnp.sum(sub * sub, axis=2)
+            lut = ss[:, :, None] + bb[None, :, :] - 2.0 * ip
 
         pcodes = codes[list_id].astype(jnp.int32)        # (nq, max_list, pq_dim)
         ids = lists_indices[list_id]                     # (nq, max_list)
@@ -386,7 +446,11 @@ def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
             pcodes[:, :, :, None],                       # (nq, max_list, pq_dim, 1)
             axis=3)[..., 0]                              # (nq, max_list, pq_dim)
         d = jnp.sum(gathered, axis=2)
-        d = jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf)
+        if kind == "ip":
+            cq = jnp.sum(q_rot * centers_rot[list_id], axis=1)
+            d = jnp.where(ids >= 0, -(d + cq[:, None]), jnp.inf)
+        else:
+            d = jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf)
         cat_d = jnp.concatenate([best_d, d], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
         nd, sel = lax.top_k(-cat_d, k)
@@ -404,26 +468,64 @@ def search(index: Index, queries, k: int,
            params: SearchParams = SearchParams(), res=None
            ) -> Tuple[jax.Array, jax.Array]:
     """ANN search → (approx dists, neighbor ids) (reference
-    ivf_pq_search.cuh:1251). ``params.scan_mode`` picks the TPU-native
-    bf16 reconstruction scan (default) or the CUDA-style f32 LUT scan."""
+    ivf_pq_search.cuh:1251). ``params.scan_mode``: "auto" (default)
+    resolves to the code-resident fused Pallas scan ("codes": u8 codes
+    + transient decode tiles, pq_dim+8 bytes resident per vector) when
+    the kernel tier is live, else the bf16 reconstruction-cache scan
+    ("reconstruct", ~8x the codes' memory); "lut" is the CUDA-style
+    gather formulation kept for parity testing."""
     q = as_array(queries).astype(jnp.float32)
     expects(q.shape[1] == index.dim, "ivf_pq.search: dim mismatch")
-    expects(params.scan_mode in ("reconstruct", "lut"),
+    expects(params.scan_mode in ("auto", "codes", "reconstruct", "lut"),
             f"ivf_pq.search: unknown scan_mode {params.scan_mode!r}")
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_pq.search: unknown scan_order {params.scan_order!r}")
     n_probes = min(params.n_probes, index.n_lists)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
-    if params.scan_mode == "reconstruct":
+    from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
+    kind = _metric_kind(index.metric)
+    scan_mode = params.scan_mode
+    if scan_mode == "auto":
+        from raft_tpu.ops.dispatch import pallas_enabled
+        scan_mode = "codes" if pallas_enabled() else "reconstruct"
+    if scan_mode == "codes":
+        from raft_tpu.neighbors import _ivf_scan
+        from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
+        probes = _ivf_scan.coarse_probes(q, index.centers, n_probes,
+                                         kind=kind)
+        cap = _ivf_scan.probe_cap(probes, index.n_lists)
+        q_rot = jnp.matmul(q, index.rotation_matrix.T,
+                           precision=matmul_precision())
+        code_norms = index.code_norms
+        if code_norms is None:  # older/deserialized index: derive once
+            code_norms = _code_norms(index.codes, index.pq_centers,
+                                     index.lists_indices)
+            index.code_norms = code_norms
+        d, i = ivf_pq_code_scan_pallas(
+            q_rot, index.centers_rot, index.pq_centers, index.codes,
+            code_norms, index.lists_indices, probes, k, cap,
+            bins=params.scan_bins, sqrt=sqrt,
+            lut_dtype=params.lut_dtype,
+            internal_distance_dtype=params.internal_distance_dtype,
+            metric=kind)
+        return _postprocess(d, index.metric), i
+    if scan_mode == "reconstruct":
         if index.decoded is None:
-            index.decoded, index.decoded_norms = _decode_lists(
+            index.decoded = _decode_lists(
                 index.codes, index.pq_centers, index.lists_indices)
+        if index.decoded_norms is None:
+            # alias the exact build-time norms — same quantity, no copy
+            if index.code_norms is None:
+                index.code_norms = _code_norms(
+                    index.codes, index.pq_centers, index.lists_indices)
+            index.decoded_norms = index.code_norms
         nq = q.shape[0]
-        use_list = (params.scan_order == "list"
-                    or (params.scan_order == "auto"
-                        and nq >= 64
-                        and nq * n_probes >= 4 * index.n_lists))
+        use_list = (kind == "l2"
+                    and (params.scan_order == "list"
+                         or (params.scan_order == "auto"
+                             and nq >= 64
+                             and nq * n_probes >= 4 * index.n_lists)))
         if use_list:
             from raft_tpu.neighbors import _ivf_scan
             probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)
@@ -440,10 +542,13 @@ def search(index: Index, queries, k: int,
                 index.lists_indices, probes, k, cap, chunk,
                 center_offset=index.centers_rot, bins=params.scan_bins,
                 sqrt=sqrt)
-        return _search_impl_reconstruct(
+        d, i = _search_impl_reconstruct(
             q, index.centers, index.centers_rot, index.rotation_matrix,
             index.decoded, index.decoded_norms, index.lists_indices,
-            k, n_probes, sqrt)
-    return _search_impl(q, index.centers, index.centers_rot,
-                        index.rotation_matrix, index.pq_centers, index.codes,
-                        index.lists_indices, k, n_probes, sqrt)
+            k, n_probes, sqrt, kind=kind)
+        return _postprocess(d, index.metric), i
+    d, i = _search_impl(q, index.centers, index.centers_rot,
+                        index.rotation_matrix, index.pq_centers,
+                        index.codes, index.lists_indices, k, n_probes,
+                        sqrt, kind=kind)
+    return _postprocess(d, index.metric), i

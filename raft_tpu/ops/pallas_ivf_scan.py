@@ -44,7 +44,7 @@ from raft_tpu.core.precision import kernel_matmul_mode
 
 
 def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
-                      cd_ref, ci_ref, *, lc: int, bins: int,
+                      cd_ref, ci_ref, *, lc: int, bins: int, metric: str,
                       precision):
     scale = scale_ref[0, 0]
     for l in range(lc):
@@ -65,12 +65,17 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
                 preferred_element_type=jnp.float32)
         else:
             ip = dot_nt_f32(y, q, precision)             # (ML, cap)
-        qq = jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32),
-                     axis=1)[None, :]                    # (1, cap)
         ids = ids_ref[l]                                 # (ML,) int32
-        d = norms_ref[l][:, None] + qq - 2.0 * ip
         ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
-        d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
+        if metric == "ip":
+            # similarity → negate: smaller-is-better uniformly (the
+            # reference's max-heap IP routing, fused_l2_knn.cuh:947)
+            d = jnp.where(ids_b >= 0, -ip, jnp.inf)
+        else:
+            qq = jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32),
+                         axis=1)[None, :]                # (1, cap)
+            d = norms_ref[l][:, None] + qq - 2.0 * ip
+            d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
 
         # STRIDED bins (row r → bin r % B): bucketized rows follow
         # dataset order, so a query's true neighbors sit in adjacent
@@ -83,18 +88,20 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
         ci = jnp.min(jnp.where(db_ == cd[None, :, :], rb, _BIG_I32),
                      axis=0)
         ci = jnp.where(ci == _BIG_I32, -1, ci)
-        cd_ref[l] = cd
+        cd_ref[l] = cd.astype(cd_ref.dtype)
         ci_ref[l] = ci
 
 
-@functools.partial(jax.jit, static_argnames=("bins", "lc", "interpret"))
+@functools.partial(jax.jit, static_argnames=("bins", "lc", "metric",
+                                             "out_dtype", "interpret"))
 def _list_scan_call(qsub, data, norms, ids, bins: int, lc: int,
-                    scale, interpret: bool):
+                    scale, interpret: bool, metric: str = "l2",
+                    out_dtype=jnp.float32):
     n_lists, cap, dim = qsub.shape
     max_list = data.shape[1]
     gc = n_lists // lc
     kern = functools.partial(
-        _list_scan_kernel, lc=lc, bins=bins,
+        _list_scan_kernel, lc=lc, bins=bins, metric=metric,
         precision=kernel_matmul_mode(interpret))
     # scale rides as a (1,1) traced input: a static arg would recompile
     # the kernel for every distinct int8 index scale
@@ -109,7 +116,7 @@ def _list_scan_call(qsub, data, norms, ids, bins: int, lc: int,
                   pl.BlockSpec((lc, max_list), lambda g: (g, 0))],
         out_specs=[pl.BlockSpec((lc, bins, cap), lambda g: (g, 0, 0)),
                    pl.BlockSpec((lc, bins, cap), lambda g: (g, 0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((n_lists, bins, cap), jnp.float32),
+        out_shape=[jax.ShapeDtypeStruct((n_lists, bins, cap), out_dtype),
                    jax.ShapeDtypeStruct((n_lists, bins, cap), jnp.int32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
@@ -142,55 +149,164 @@ def _pick_lc(n_lists: int, max_list: int, cap: int, dim: int,
     return lc
 
 
+class _Layout:
+    """Shared prologue of both list-major scans: bins resolution, probe
+    inversion, list-axis padding to a bins multiple, lane-aligned
+    inverted-table width.
+
+    ``bins``: 0 = auto — 4k bins. IVF lists concentrate a query's true
+    neighbors far more than brute-force tiles do, so the collision
+    budget needs more width than fused_knn's 2k default (recall 0.944 →
+    0.97+ at 16/64 probes on clustered data); the merge rides the fast
+    select_k, so the wider candidate set costs little. -1 = exact (one
+    row per bin); >0 explicit.
+    """
+
+    def __init__(self, probes, n_lists: int, max_list: int, cap: int,
+                 bins: int, k: int):
+        from raft_tpu.neighbors._ivf_scan import _invert_probes
+        if bins == 0:
+            bins = min(max(4 * k, 64), max_list)
+        self.qmap, self.inv_pos = _invert_probes(probes, n_lists, cap)
+        # pad the list axis so bins divides it (pad rows: id -1 → +inf)
+        self.mlp = _round_up(max_list, bins if bins > 0 else 1)
+        self.bins = self.mlp if bins < 0 else bins
+        self.cap = cap
+        self.capp = _round_up(max(cap, 8), 8)  # lane-aligned table width
+
+    def pad_lists(self, arr, max_list: int, fill=0):
+        if self.mlp == max_list:
+            return arr
+        pad = [(0, 0), (0, self.mlp - max_list)] + [(0, 0)] * (arr.ndim - 2)
+        return jnp.pad(arr, pad, constant_values=fill)
+
+    def padded_qmap(self):
+        if self.capp == self.cap:
+            return self.qmap
+        return jnp.pad(self.qmap, ((0, 0), (0, self.capp - self.cap)),
+                       constant_values=-1)
+
+    def merge(self, cd, ci, probes, k: int, sqrt: bool):
+        from raft_tpu.neighbors._ivf_scan import merge_candidates
+        cd = jnp.swapaxes(cd, 1, 2)                # (n_lists, cap, B)
+        ci = jnp.swapaxes(ci, 1, 2)
+        return merge_candidates(
+            cd[:, :self.cap].astype(jnp.float32), ci[:, :self.cap],
+            probes, self.inv_pos, k, sqrt, use_pallas_select=True)
+
+
 def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
                          probes, k: int, cap: int, scale=1.0,
-                         bins: int = 0, sqrt: bool = False):
+                         bins: int = 0, sqrt: bool = False,
+                         metric: str = "l2"):
     """Fused list-major IVF-Flat fine scan + merge.
 
     ``queries`` (nq, dim) f32; ``lists_data`` (n_lists, max_list, dim)
     f32/bf16/int8; ``probes`` (nq, n_probes) int32; ``cap`` the inverted
-    table width (``_ivf_scan.probe_cap``). ``bins``: 0 = auto (4k
-    strided bins), -1 = exact (one row per bin), >0 explicit. Returns
-    (dists (nq, k), ids (nq, k)) sorted best-first — squared L2
-    (``sqrt`` optional).
+    table width (``_ivf_scan.probe_cap``). ``bins``: see ``_Layout``.
+    ``metric``: "l2" (squared, ``sqrt`` optional) or "ip" (returns
+    NEGATED similarities, ascending — callers postprocess). Returns
+    (dists (nq, k), ids (nq, k)) sorted best-first.
     """
-    from raft_tpu.neighbors._ivf_scan import (_invert_probes,
-                                              merge_candidates)
-
     nq, dim = queries.shape
     n_lists, max_list = lists_indices.shape
-    if bins == 0:
-        # auto: 4k bins. IVF lists concentrate a query's true neighbors
-        # far more than brute-force tiles do, so the collision budget
-        # needs more width than fused_knn's 2k default (recall 0.944 →
-        # 0.97+ at 16/64 probes on clustered data); the merge rides the
-        # fast select_k, so the wider candidate set costs little
-        bins = min(max(4 * k, 64), max_list)
-
-    qmap, inv_pos = _invert_probes(probes, n_lists, cap)
-
-    # pad the list axis so bins divides it (pad rows carry id -1 → +inf)
-    mlp = _round_up(max_list, bins if bins > 0 else 1)
-    if bins < 0:
-        bins = mlp  # exact mode: one row per bin
-    if mlp != max_list:
-        pad = ((0, 0), (0, mlp - max_list))
-        lists_data = jnp.pad(lists_data, pad + ((0, 0),))
-        lists_norms = jnp.pad(lists_norms, pad)
-        lists_indices = jnp.pad(lists_indices, pad, constant_values=-1)
-    # lane-align the inverted-table width
-    capp = _round_up(max(cap, 8), 8)
+    lay = _Layout(probes, n_lists, max_list, cap, bins, k)
+    lists_data = lay.pad_lists(lists_data, max_list)
+    lists_norms = lay.pad_lists(lists_norms, max_list)
+    lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
 
     # XLA pre-gather: each list's probing queries → (n_lists, cap, dim).
     # ~cap/mean-probes ≤ 2× the query bytes; read once by the kernel.
-    qm = qmap if capp == cap else jnp.pad(qmap, ((0, 0), (0, capp - cap)),
-                                          constant_values=-1)
-    qsub = queries[jnp.clip(qm, 0, nq - 1)]
-    lc = _pick_lc(n_lists, mlp, capp, dim, lists_data.dtype.itemsize)
+    qsub = queries[jnp.clip(lay.padded_qmap(), 0, nq - 1)]
+    lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim,
+                  lists_data.dtype.itemsize)
     cd, ci = _list_scan_call(qsub, lists_data, lists_norms, lists_indices,
-                             bins, lc, scale, pallas_interpret())
+                             lay.bins, lc, scale, pallas_interpret(),
+                             metric=metric)
+    return lay.merge(cd, ci, probes, k, sqrt)
 
-    cd = jnp.swapaxes(cd, 1, 2)                       # (n_lists, cap, B)
-    ci = jnp.swapaxes(ci, 1, 2)
-    return merge_candidates(cd[:, :cap], ci[:, :cap], probes, inv_pos, k,
-                            sqrt, use_pallas_select=True)
+
+def _pq_chunk(n_lists: int, max_list: int, rot_dim: int, itemsize: int,
+              budget_bytes: int = 32 << 20) -> int:
+    """Lists per decode chunk: the transient decode tile
+    (chunk·max_list·rot_dim·itemsize) stays under ``budget_bytes``."""
+    from raft_tpu.neighbors._ivf_scan import largest_divisor_at_most
+    want = max(1, budget_bytes // max(1, max_list * rot_dim * itemsize))
+    return largest_divisor_at_most(n_lists, want)
+
+
+def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
+                            code_norms, lists_indices, probes, k: int,
+                            cap: int, bins: int = 0, sqrt: bool = False,
+                            lut_dtype=jnp.bfloat16,
+                            internal_distance_dtype=jnp.float32,
+                            metric: str = "l2"):
+    """IVF-PQ fine scan directly over the compressed codes.
+
+    Reference ``ivf_pq_search.cuh:593`` scans the bit-packed
+    ``pq_dataset`` against a smem LUT. Per-lane LUT gathers are hostile
+    to the TPU vector unit, so the TPU formulation decodes each chunk of
+    lists on the fly — codes (u8, pq_dim B/vector) are the only
+    persistent payload; the decoded (chunk, max_list, rot_dim) tile is
+    transient (the "on-the-fly decode tile that never persists") and
+    feeds the same fused list-scan kernel as IVF-Flat, with each list's
+    probing queries pre-offset by its rotated center so the kernel
+    scores ``||(q_rot − c_l) − decoded||²``.
+
+    The reference's LUT-precision variants (``ivf_pq_search.cuh:
+    780-1004``, fp32/fp16/fp8 LUT × fp32/fp16 internal) map to
+    ``lut_dtype`` — the decode-tile dtype (bf16 = one MXU pass, f32 =
+    bf16x3 split) — and ``internal_distance_dtype`` — the candidate
+    score dtype carried to the merge (bf16 halves candidate HBM).
+
+    ``code_norms`` are exact: PQ subspaces concatenate orthogonally, so
+    ``||decoded_i||² = Σ_s ||book_s[c_is]||²`` is computed once at build
+    from the codebook norm table.
+    """
+    nq = q_rot.shape[0]
+    n_lists, max_list, pq_dim = codes.shape
+    _, n_codes, pq_len = pq_centers.shape
+    rot_dim = pq_dim * pq_len
+    itemsize = jnp.dtype(lut_dtype).itemsize
+    lay = _Layout(probes, n_lists, max_list, cap, bins, k)
+    codes = lay.pad_lists(codes, max_list)
+    code_norms = lay.pad_lists(code_norms, max_list)
+    lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
+    mlp, capp = lay.mlp, lay.capp
+    qg = q_rot[jnp.clip(lay.padded_qmap(), 0, nq - 1)]
+    if metric == "ip":
+        # IP has no residual form: q·y = q_rot·(c_rot + dec) — decode
+        # FULL rotated vectors (center added to the transient tile) and
+        # score plain rotated queries against them
+        qsub = qg
+    else:
+        # per-list probing queries, residual form: (n_lists, cap, rot_dim)
+        qsub = qg - centers_rot[:, None, :]
+
+    chunk = _pq_chunk(n_lists, mlp, rot_dim, itemsize)
+    lc = _pick_lc(chunk, mlp, capp, rot_dim, itemsize)
+    n_chunks = n_lists // chunk
+    interpret = pallas_interpret()
+
+    def one_chunk(args):
+        codes_c, norms_c, ids_c, qsub_c, crot_c = args
+        flat = codes_c.reshape(-1, pq_dim).astype(jnp.int32)
+        # decode: one row-gather per subquantizer (O(N·pq_len) each)
+        dec = jnp.concatenate(
+            [pq_centers[s][flat[:, s]] for s in range(pq_dim)], axis=1)
+        dec = dec.reshape(chunk, mlp, rot_dim)
+        if metric == "ip":
+            dec = dec + crot_c[:, None, :]
+        dec = dec.astype(lut_dtype)
+        return _list_scan_call(qsub_c, dec, norms_c, ids_c, lay.bins, lc,
+                               1.0, interpret, metric=metric,
+                               out_dtype=internal_distance_dtype)
+
+    cd, ci = jax.lax.map(one_chunk, (
+        codes.reshape(n_chunks, chunk, mlp, pq_dim),
+        code_norms.reshape(n_chunks, chunk, mlp),
+        lists_indices.reshape(n_chunks, chunk, mlp),
+        qsub.reshape(n_chunks, chunk, capp, rot_dim),
+        centers_rot.reshape(n_chunks, chunk, rot_dim)))
+    return lay.merge(cd.reshape(n_lists, lay.bins, capp),
+                     ci.reshape(n_lists, lay.bins, capp), probes, k, sqrt)

@@ -59,7 +59,8 @@ def shard_ivf_flat(index, mesh: jax.sharding.Mesh, axis: str = "data"):
 def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
     """Reshard an IVF-PQ index's lists over ``mesh[axis]``. The bf16
     reconstruction cache is decoded first (sharded scans use it)."""
-    from raft_tpu.neighbors.ivf_pq import Index, _decode_lists
+    from raft_tpu.neighbors.ivf_pq import (Index, _code_norms,
+                                           _decode_lists)
     n_shards = mesh.shape[axis]
     expects(index.n_lists % n_shards == 0,
             f"shard_ivf_pq: n_lists={index.n_lists} not divisible by "
@@ -70,7 +71,8 @@ def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
     codes = _shard0(index.codes, mesh, axis)
     lists_indices = _shard0(index.lists_indices, mesh, axis)
     pq_centers = jax.device_put(index.pq_centers, NamedSharding(mesh, P()))
-    decoded, decoded_norms = _decode_lists(codes, pq_centers, lists_indices)
+    decoded = _decode_lists(codes, pq_centers, lists_indices)
+    decoded_norms = _code_norms(codes, pq_centers, lists_indices)
     return Index(
         centers=_shard0(index.centers, mesh, axis),
         centers_rot=_shard0(index.centers_rot, mesh, axis),
@@ -125,25 +127,30 @@ def distributed_ivf_flat_search(
     from raft_tpu.neighbors.ivf_flat import SearchParams
     params = params or SearchParams()
     expects(mesh is not None, "distributed ivf_flat: mesh is required")
+    from raft_tpu.neighbors.ivf_flat import (_coarse_scores, _metric_kind,
+                                             _postprocess, _score_probe)
     q = as_array(queries).astype(jnp.float32)
     expects(q.shape[1] == index.dim, "distributed ivf_flat: dim mismatch")
+    if index.metric == DistanceType.CosineExpanded:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                            1e-30)
     n_shards = mesh.shape[axis]
     nl_local = index.n_lists // n_shards
     n_probes = min(params.n_probes, nl_local)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
+    kind = _metric_kind(index.metric)
     comms = build_comms(mesh, axis)
 
     def local(centers, lists_data, lists_indices, lists_norms, q_rep):
         qq = jnp.sum(q_rep * q_rep, axis=1)
-        coarse = _l2_expanded(q_rep, centers, sqrt=False)
+        coarse = _coarse_scores(q_rep, centers, kind)
         _, probes = lax.top_k(-coarse, n_probes)
 
         def get_probe(p):
-            from raft_tpu.neighbors.ivf_flat import _score_probe
             return _score_probe(q_rep, qq, lists_data, lists_norms,
                                 lists_indices, probes[:, p],
-                                float(index.scale))
+                                float(index.scale), kind=kind)
 
         d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
         if sqrt:
@@ -156,8 +163,9 @@ def distributed_ivf_flat_search(
                   P(axis, None), P()),
         out_specs=(P(), P())))
     q_rep = jax.device_put(q, NamedSharding(mesh, P()))
-    return shmapped(index.centers, index.lists_data, index.lists_indices,
+    d, i = shmapped(index.centers, index.lists_data, index.lists_indices,
                     index.lists_norms, q_rep)
+    return _postprocess(d, index.metric), i
 
 
 def distributed_ivf_pq_search(
@@ -173,24 +181,27 @@ def distributed_ivf_pq_search(
     expects(q.shape[1] == index.dim, "distributed ivf_pq: dim mismatch")
     expects(index.decoded is not None,
             "distributed ivf_pq: index not sharded via shard_ivf_pq")
+    from raft_tpu.neighbors.ivf_flat import (_coarse_scores, _metric_kind,
+                                             _postprocess)
+    from raft_tpu.neighbors.ivf_pq import _score_probe_reconstruct
     n_shards = mesh.shape[axis]
     nl_local = index.n_lists // n_shards
     n_probes = min(params.n_probes, nl_local)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
+    kind = _metric_kind(index.metric)
     comms = build_comms(mesh, axis)
 
     def local(centers, centers_rot, rot, decoded, decoded_norms,
               lists_indices, q_rep):
-        coarse = _l2_expanded(q_rep, centers, sqrt=False)
+        coarse = _coarse_scores(q_rep, centers, kind)
         _, probes = lax.top_k(-coarse, n_probes)
         q_rot = jnp.matmul(q_rep, rot.T, precision=matmul_precision())
 
         def get_probe(p):
-            from raft_tpu.neighbors.ivf_pq import _score_probe_reconstruct
             return _score_probe_reconstruct(
                 q_rot, centers_rot, decoded, decoded_norms, lists_indices,
-                probes[:, p])
+                probes[:, p], kind=kind)
 
         d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
         if sqrt:
@@ -203,6 +214,7 @@ def distributed_ivf_pq_search(
                   P(axis, None), P(axis, None), P()),
         out_specs=(P(), P())))
     q_rep = jax.device_put(q, NamedSharding(mesh, P()))
-    return shmapped(index.centers, index.centers_rot,
+    d, i = shmapped(index.centers, index.centers_rot,
                     index.rotation_matrix, index.decoded,
                     index.decoded_norms, index.lists_indices, q_rep)
+    return _postprocess(d, index.metric), i

@@ -274,3 +274,188 @@ class TestIvfListScanPallas:
         d_r, i_r = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
             n_probes=8, scan_order="probe"))
         assert self._recall(i_b, i_r, k) >= 0.9
+
+
+class TestIvfPqCodeScanPallas:
+    """Code-resident IVF-PQ scan (ops/pallas_ivf_scan.py): u8 codes are
+    the only persistent payload; decode tiles are transient."""
+
+    @pytest.fixture(scope="class")
+    def pq_setup(self):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.random import make_blobs
+        x, _ = make_blobs(n_samples=8000, n_features=32, centers=40,
+                          cluster_std=3.0, seed=0)
+        q, _ = make_blobs(n_samples=80, n_features=32, centers=40,
+                          cluster_std=3.0, seed=1)
+        x = jnp.asarray(np.asarray(x))
+        q = jnp.asarray(np.asarray(q))
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(n_lists=32,
+                                                 kmeans_n_iters=4,
+                                                 pq_dim=8))
+        return idx, x, q
+
+    def _recall(self, got, want, k):
+        return np.mean([
+            len(set(np.asarray(got[r])) & set(np.asarray(want[r]))) / k
+            for r in range(got.shape[0])])
+
+    def test_codes_agrees_with_reconstruct(self, pq_setup, monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = pq_setup
+        k = 8
+        d_c, i_c = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes"))
+        d_r, i_r = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="reconstruct", scan_order="probe"))
+        assert self._recall(i_c, i_r, k) >= 0.9
+        # tail slots may hold a different boundary neighbor (binned
+        # candidates); the top half must agree numerically
+        np.testing.assert_allclose(np.asarray(d_c)[:, :k // 2],
+                                   np.asarray(d_r)[:, :k // 2],
+                                   rtol=0.05, atol=0.5)
+
+    def test_lut_and_internal_dtype_knobs_live(self, pq_setup,
+                                               monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = pq_setup
+        k = 8
+        d_r, i_r = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="reconstruct", scan_order="probe"))
+        for lut, internal in [(jnp.float32, jnp.float32),
+                              (jnp.bfloat16, jnp.bfloat16)]:
+            d, i = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+                n_probes=8, scan_mode="codes", lut_dtype=lut,
+                internal_distance_dtype=internal))
+            assert self._recall(i, i_r, k) >= 0.85, (lut, internal)
+
+    def test_code_norms_exact(self, pq_setup):
+        from raft_tpu.neighbors.ivf_pq import _code_norms, _decode_lists
+        idx, _, _ = pq_setup
+        norms = _code_norms(idx.codes, idx.pq_centers, idx.lists_indices)
+        dec = _decode_lists(idx.codes, idx.pq_centers, idx.lists_indices)
+        ref_norms = np.sum(np.asarray(dec, dtype=np.float32) ** 2, axis=2)
+        np.testing.assert_allclose(np.asarray(norms),
+                                   np.asarray(ref_norms),
+                                   rtol=2e-2, atol=1e-2)
+
+    def test_codes_path_after_serialize_roundtrip(self, pq_setup,
+                                                  tmp_path, monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.neighbors.serialize import save, load
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = pq_setup
+        k = 8
+        p = str(tmp_path / "pq.idx")
+        save(idx, p)
+        idx2 = load(p)
+        assert idx2.code_norms is None  # derived lazily, not persisted
+        d2, i2 = ivf_pq.search(idx2, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes"))
+        d1, i1 = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes"))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestIvfMetrics:
+    """IP/cosine threading through the ANN indexes (VERDICT round-1
+    item 5): recall-gated tests mirroring the L2 ones, reference
+    ivf_flat_search.cuh metric dispatch / fused_l2_knn.cuh:947."""
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        from raft_tpu.random import make_blobs
+        x, _ = make_blobs(n_samples=8000, n_features=24, centers=40,
+                          cluster_std=3.0, seed=0)
+        q, _ = make_blobs(n_samples=80, n_features=24, centers=40,
+                          cluster_std=3.0, seed=1)
+        return jnp.asarray(np.asarray(x)), jnp.asarray(np.asarray(q))
+
+    def _recall(self, got, want, k):
+        return np.mean([
+            len(set(np.asarray(got[r])) & set(np.asarray(want[r]))) / k
+            for r in range(got.shape[0])])
+
+    @pytest.mark.parametrize("order", ["probe", "list"])
+    def test_ivf_flat_ip(self, data, order, monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.distance.distance_types import DistanceType as DT
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        x, q = data
+        k = 8
+        xn, qn = np.asarray(x), np.asarray(q)
+        gt = np.argsort(-(qn @ xn.T), axis=1)[:, :k]
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4, metric=DT.InnerProduct))
+        d, i = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=12, scan_order=order))
+        assert self._recall(i, gt, k) >= 0.9
+        # similarities, descending; ids reproduce the values
+        assert np.all(np.diff(np.asarray(d), axis=1) <= 1e-5)
+        sims = np.take_along_axis(qn @ xn.T, np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), sims, rtol=1e-3,
+                                   atol=1e-2)
+
+    def test_ivf_flat_cosine(self, data, monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.distance.distance_types import DistanceType as DT
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        x, q = data
+        k = 8
+        xn = np.asarray(x)
+        qn = np.asarray(q)
+        xu = xn / np.linalg.norm(xn, axis=1, keepdims=True)
+        qu = qn / np.linalg.norm(qn, axis=1, keepdims=True)
+        gt = np.argsort(1 - qu @ xu.T, axis=1)[:, :k]
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4, metric=DT.CosineExpanded))
+        d, i = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=12, scan_order="list"))
+        assert self._recall(i, gt, k) >= 0.9
+        ref = 1 - np.take_along_axis(qu @ xu.T, np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-3,
+                                   atol=1e-2)
+
+    @pytest.mark.parametrize("mode", ["codes", "reconstruct", "lut"])
+    def test_ivf_pq_ip(self, data, mode, monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.distance.distance_types import DistanceType as DT
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        x, q = data
+        k = 8
+        xn, qn = np.asarray(x), np.asarray(q)
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=32, kmeans_n_iters=4, pq_dim=8,
+            metric=DT.InnerProduct))
+        d_l, i_l = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=12, scan_mode="lut"))
+        d, i = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=12, scan_mode=mode,
+            scan_order="probe" if mode == "reconstruct" else "auto"))
+        # all modes agree with the exact-LUT formulation
+        assert self._recall(i, i_l, k) >= 0.85, mode
+        assert np.all(np.diff(np.asarray(d), axis=1) <= 1e-4)
+
+    def test_distributed_ivf_flat_ip(self, data, devices):
+        import numpy as onp
+        from jax.sharding import Mesh
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel.ivf import (distributed_ivf_flat_search,
+                                           shard_ivf_flat)
+        from raft_tpu.distance.distance_types import DistanceType as DT
+        x, q = data
+        k = 8
+        mesh = Mesh(onp.asarray(devices[:4]).reshape(4, 1),
+                    ("data", "model"))
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4, metric=DT.InnerProduct))
+        sidx = shard_ivf_flat(idx, mesh, axis="data")
+        d, i = distributed_ivf_flat_search(
+            sidx, q, k, ivf_flat.SearchParams(n_probes=8), mesh=mesh,
+            axis="data")
+        xn, qn = np.asarray(x), np.asarray(q)
+        gt = np.argsort(-(qn @ xn.T), axis=1)[:, :k]
+        assert self._recall(i, gt, k) >= 0.9
+        assert np.all(np.diff(np.asarray(d), axis=1) <= 1e-5)
