@@ -109,28 +109,49 @@ def _coarse_scores(queries, centers, kind: str):
     return _l2_expanded(queries, centers, sqrt=False)
 
 
-def _bucketize(x, labels, n_lists: int, round_to: int = 8):
-    """Scatter rows into padded per-list buckets — static-shape layout."""
+def _bucketize_static(x, labels, row_ids, n_lists: int, max_list: int,
+                      counts=None):
+    """jit-safe core of :func:`_bucketize`: scatter rows into padded
+    per-list buckets of a caller-chosen static width. ``row_ids`` are
+    the ids stored for each row (global ids in sharded builds); rows
+    whose list position overflows ``max_list`` are dropped (cannot
+    happen when max_list ≥ the true max count). ``counts`` may be
+    passed by callers that already computed the per-list totals."""
     n, dim = x.shape
-    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
-                                 num_segments=n_lists)
-    max_list = int(jax.device_get(jnp.max(counts)))
-    max_list = max(round_to, (max_list + round_to - 1) // round_to * round_to)
-
+    if counts is None:
+        counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
+                                     num_segments=n_lists)
     order = jnp.argsort(labels, stable=True)
     sorted_labels = labels[order]
     # position of each row within its list
     pos = jnp.arange(n, dtype=jnp.int32) - jnp.cumsum(
         jnp.concatenate([jnp.zeros(1, jnp.int32), counts]))[sorted_labels]
-    flat_slot = sorted_labels * max_list + pos
-
-    data = jnp.zeros((n_lists * max_list, dim), x.dtype)
-    data = data.at[flat_slot].set(x[order])
-    idx = jnp.full((n_lists * max_list,), -1, jnp.int32)
-    idx = idx.at[flat_slot].set(order.astype(jnp.int32))
-    data = data.reshape(n_lists, max_list, dim)
-    idx = idx.reshape(n_lists, max_list)
+    flat_slot = jnp.where(pos < max_list, sorted_labels * max_list + pos,
+                          n_lists * max_list)
+    data = jnp.zeros((n_lists * max_list + 1, dim), x.dtype)
+    data = data.at[flat_slot].set(x[order], mode="drop")
+    idx = jnp.full((n_lists * max_list + 1,), -1, jnp.int32)
+    idx = idx.at[flat_slot].set(row_ids[order].astype(jnp.int32),
+                                mode="drop")
+    data = data[:-1].reshape(n_lists, max_list, dim)
+    idx = idx[:-1].reshape(n_lists, max_list)
     norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
+    norms = jnp.where(idx >= 0, norms, 0.0)
+    return data, idx, norms, counts
+
+
+def _bucketize(x, labels, n_lists: int, round_to: int = 8):
+    """Scatter rows into padded per-list buckets — static-shape layout.
+    The bucket width is sized from the observed max count (one host
+    sync); sharded builds pre-agree a width and call the static core."""
+    n = x.shape[0]
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
+                                 num_segments=n_lists)
+    max_list = int(jax.device_get(jnp.max(counts)))
+    max_list = max(round_to, (max_list + round_to - 1) // round_to * round_to)
+    data, idx, norms, counts = _bucketize_static(
+        x, labels, jnp.arange(n, dtype=jnp.int32), n_lists, max_list,
+        counts=counts)
     return data, idx, norms, counts
 
 

@@ -14,6 +14,12 @@ from raft_tpu.parallel.ivf import (
     shard_ivf_pq,
     distributed_ivf_flat_search,
     distributed_ivf_pq_search,
+    DistributedIvfFlat,
+    DistributedIvfPq,
+    distributed_ivf_flat_build,
+    distributed_ivf_flat_search_parts,
+    distributed_ivf_pq_build,
+    distributed_ivf_pq_search_parts,
 )
 
 __all__ = [
@@ -22,4 +28,7 @@ __all__ = [
     "distributed_kmeans_fit", "distributed_kmeans_step",
     "shard_ivf_flat", "shard_ivf_pq",
     "distributed_ivf_flat_search", "distributed_ivf_pq_search",
+    "DistributedIvfFlat", "DistributedIvfPq",
+    "distributed_ivf_flat_build", "distributed_ivf_flat_search_parts",
+    "distributed_ivf_pq_build", "distributed_ivf_pq_search_parts",
 ]

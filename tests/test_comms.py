@@ -346,3 +346,77 @@ class TestHostP2P:
             p2 = s.host_p2p()
             assert p1 is p2
             assert p1.session == "p2p-test"
+
+
+class TestDistributedIvfBuild:
+    """Row-sharded multi-part IVF built DIRECTLY on the mesh (VERDICT
+    round-1 item 6: no single-host index materialized; reference
+    ivf_pq_build.cuh:605 + SURVEY.md §3.3 MNMG note)."""
+
+    def _mesh(self):
+        from raft_tpu.parallel.mesh import make_mesh
+        return make_mesh((8,), ("data",))
+
+    def test_flat_build_search_full_probe_equals_exact(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.parallel import (distributed_ivf_flat_build,
+                                       distributed_ivf_flat_search_parts)
+        key = jax.random.key(0)
+        db = jax.random.normal(key, (2048, 24))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (32, 24))
+        mesh = self._mesh()
+        didx = distributed_ivf_flat_build(
+            db, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3),
+            mesh, axis="data")
+        # parts stay sharded over the data axis
+        assert didx.parts_data.shape[0] == 8
+        d, i = distributed_ivf_flat_search_parts(
+            didx, q, 8, ivf_flat.SearchParams(n_probes=16))
+        de, ie = brute_force_knn(db, q, 8, DistanceType.L2Expanded)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ie))
+        np.testing.assert_allclose(np.asarray(d), np.asarray(de),
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_flat_build_ids_are_global(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import distributed_ivf_flat_build
+        key = jax.random.key(1)
+        db = jax.random.normal(key, (1000, 8))  # not divisible by 8
+        mesh = self._mesh()
+        didx = distributed_ivf_flat_build(
+            db, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2),
+            mesh, axis="data")
+        ids = np.asarray(didx.parts_indices)
+        valid = ids[ids >= 0]
+        # every dataset row appears exactly once across all parts
+        assert sorted(valid.tolist()) == list(range(1000))
+
+    def test_pq_build_search_parts(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.parallel import (distributed_ivf_pq_build,
+                                       distributed_ivf_pq_search_parts)
+        key = jax.random.key(2)
+        db = jax.random.normal(key, (2048, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (32, 32))
+        k = 10
+        mesh = self._mesh()
+        didx = distributed_ivf_pq_build(
+            db, ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=3),
+            mesh, axis="data")
+        assert didx.parts_codes.dtype == jnp.uint8
+        d, i = distributed_ivf_pq_search_parts(
+            didx, q, k, ivf_pq.SearchParams(n_probes=16))
+        _, ie = brute_force_knn(db, q, k, DistanceType.L2Expanded)
+        ie, i = np.asarray(ie), np.asarray(i)
+        rec = np.mean([len(set(i[r]) & set(ie[r])) / k for r in range(32)])
+        assert rec >= 0.5, rec  # PQ-quantized exhaustive probe
