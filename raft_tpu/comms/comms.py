@@ -123,11 +123,14 @@ class Comms:
 
     # -- device collectives (valid inside shard_map) -----------------------
     #
-    # Subgroup note: shard_map's collectives don't accept
-    # axis_index_groups, so split communicators lower to full-axis
-    # gathers + host-known group tables (the group structure is static —
-    # XLA folds the masks; a ring within a subgroup uses ppermute with an
-    # explicit static pattern, which IS natively supported).
+    # Subgroup note: XLA's gather-family collectives accept
+    # ``axis_index_groups`` natively under shard_map (all_gather,
+    # psum_scatter, all_to_all lower to replica_groups = the subgroups —
+    # O(group) on the wire, matching ncclCommSplit semantics,
+    # std_comms.hpp:124-187). The reduce family (psum/pmax/pmin) has no
+    # grouped shard_map lowering, so subgroup reductions are a grouped
+    # all_gather + local reduce — still O(group) bandwidth, never a
+    # full-axis collective.
 
     def _my_group(self):
         """(group row of this rank, in-group rank) — device values."""
@@ -139,10 +142,14 @@ class Comms:
         pos = jnp.argmax(row == idx)
         return row, pos
 
+    def _group_gather(self, x):
+        """Grouped all_gather: this rank receives its OWN group's
+        (gsz, ...) stack — lowers to replica_groups=subgroups."""
+        return lax.all_gather(x, self.axis_name,
+                              axis_index_groups=self.axis_index_groups)
+
     def _group_reduce(self, x, op: ReduceOp):
-        g = lax.all_gather(x, self.axis_name)  # (n_ranks, ...)
-        row, _ = self._my_group()
-        mine = jnp.take(g, row, axis=0)        # (gsz, ...)
+        mine = self._group_gather(x)           # (gsz, ...)
         if op == ReduceOp.SUM:
             return jnp.sum(mine, axis=0)
         if op == ReduceOp.MAX:
@@ -170,11 +177,9 @@ class Comms:
 
     def bcast(self, x, root: int = 0):
         """Every rank receives root's value (root is the in-group rank)."""
-        g = lax.all_gather(x, self.axis_name)
         if self.axis_index_groups is None:
-            return g[root]
-        row, _ = self._my_group()
-        return jnp.take(g, row[root], axis=0)
+            return lax.all_gather(x, self.axis_name)[root]
+        return self._group_gather(x)[root]
 
     def reduce(self, x, root: int = 0, op: ReduceOp = ReduceOp.SUM):
         """Reduction valid on ``root``; other ranks receive zeros (the
@@ -186,9 +191,7 @@ class Comms:
     def allgather(self, x):
         if self.axis_index_groups is None:
             return lax.all_gather(x, self.axis_name)
-        g = lax.all_gather(x, self.axis_name)
-        row, _ = self._my_group()
-        return jnp.take(g, row, axis=0)
+        return self._group_gather(x)
 
     def allgatherv(self, x, counts: Sequence[int]):
         """Variable-size allgather: ranks pad to max(counts) then gather
@@ -215,13 +218,8 @@ class Comms:
         """Input length must be divisible by group size; rank r receives
         the r-th chunk of the elementwise reduction."""
         expects(op == ReduceOp.SUM, "reducescatter: SUM only (XLA psum_scatter)")
-        if self.axis_index_groups is None:
-            return lax.psum_scatter(x, self.axis_name, tiled=True)
-        red = self._group_reduce(x, op)
-        gsz = self.get_size()
-        chunk = x.shape[0] // gsz
-        _, pos = self._my_group()
-        return lax.dynamic_slice_in_dim(red, pos * chunk, chunk)
+        return lax.psum_scatter(x, self.axis_name, tiled=True,
+                                axis_index_groups=self.axis_index_groups)
 
     # -- p2p (core/comms.hpp device_send/recv; ppermute is the ICI path).
     # XLA needs the full (src, dst) pattern statically, so the tagged
@@ -248,18 +246,16 @@ class Comms:
 
     def alltoall(self, x):
         """all-to-all over the leading axis (the sequence/context-parallel
-        exchange primitive). Full-axis comms only: XLA's all_to_all has no
-        subgroup form, and emulating it for split comms would silently
-        de-optimize the one op whose point is ICI bandwidth."""
-        expects(self.axis_index_groups is None,
-                "alltoall is not supported on split communicators")
+        exchange primitive). On a split communicator the exchange runs
+        within each subgroup (native grouped all_to_all)."""
         n = self.get_size()
         expects(x.shape[0] % n == 0,
                 "alltoall: leading dim %d not divisible by %d ranks",
                 x.shape[0], n)
         return lax.all_to_all(x.reshape(n, -1, *x.shape[1:]),
-                              self.axis_name, 0, 0, tiled=False).reshape(
-                                  -1, *x.shape[1:])
+                              self.axis_name, 0, 0, tiled=False,
+                              axis_index_groups=self.axis_index_groups
+                              ).reshape(-1, *x.shape[1:])
 
     def barrier_value(self):
         """Device-side barrier: tiny psum every rank must reach (reference

@@ -323,6 +323,10 @@ def distributed_ivf_flat_build(
                               DistanceType.CosineExpanded),
             "distributed ivf_flat build: unsupported metric %s",
             params.metric)
+    expects(params.storage_dtype == "float32",
+            "distributed ivf_flat build: narrow list storage (%s) is not "
+            "implemented for sharded parts yet; use float32",
+            params.storage_dtype)
     x = as_array(x).astype(jnp.float32)
     if params.metric == DistanceType.CosineExpanded:
         x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True),
@@ -564,7 +568,6 @@ def distributed_ivf_pq_search_parts(
                              DistanceType.L2SqrtUnexpanded)
     comms = build_comms(mesh, axis)
     pq_dim = dindex.pq_dim
-    pq_len = dindex.pq_centers.shape[2]
 
     def local(centers, centers_rot, rot, books, pcodes, pidx, pnorms,
               q_rep):

@@ -420,3 +420,75 @@ class TestDistributedIvfBuild:
         ie, i = np.asarray(ie), np.asarray(i)
         rec = np.mean([len(set(i[r]) & set(ie[r])) / k for r in range(32)])
         assert rec >= 0.5, rec  # PQ-quantized exhaustive probe
+
+
+class TestSplitCommGroupedLowering:
+    """VERDICT round-1 item 7: split-communicator collectives must lower
+    to GROUPED collectives (replica_groups = the subgroups), not
+    full-axis gathers + masking (reference ncclCommSplit semantics,
+    std_comms.hpp:124-187)."""
+
+    def _split(self):
+        from jax.sharding import Mesh
+        from raft_tpu.comms import build_comms
+        mesh = Mesh(np.asarray(jax.devices()), ("x",))
+        comms = build_comms(mesh, "x")
+        return mesh, comms.comm_split([0, 0, 0, 0, 1, 1, 1, 1])
+
+    def test_allreduce_lowers_grouped(self):
+        from jax.sharding import PartitionSpec as P
+        mesh, split = self._split()
+
+        def f(a):
+            return split.allreduce(a)
+
+        lowered = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("x"), out_specs=P("x"))).lower(
+                jnp.arange(8.0))
+        txt = lowered.as_text()
+        grouped = [ln for ln in txt.splitlines() if "replica_groups" in ln]
+        assert grouped, "no collective in lowering"
+        for ln in grouped:
+            assert "[[0, 1, 2, 3], [4, 5, 6, 7]]" in ln, ln
+        # and it still computes the right thing
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("x"), out_specs=P("x")))(
+                jnp.arange(8.0))
+        np.testing.assert_allclose(
+            np.asarray(out), [6, 6, 6, 6, 22, 22, 22, 22])
+
+    def test_reducescatter_and_alltoall_grouped(self):
+        from jax.sharding import PartitionSpec as P
+        mesh, split = self._split()
+
+        def rs(a):
+            return split.reducescatter(a)
+
+        out = jax.jit(jax.shard_map(
+            rs, mesh=mesh, in_specs=P("x"), out_specs=P("x")))(
+                jnp.arange(32.0))
+        # group 0 sums rows {0..3}*4: chunk r of sum; verify group sums
+        g = np.arange(32.0).reshape(8, 4)
+        want0 = g[:4].sum(0)
+        want1 = g[4:].sum(0)
+        np.testing.assert_allclose(np.asarray(out)[:4], want0)
+        np.testing.assert_allclose(np.asarray(out)[4:], want1)
+
+        def a2a(a):
+            return split.alltoall(a)
+
+        out2 = jax.jit(jax.shard_map(
+            a2a, mesh=mesh, in_specs=P("x"), out_specs=P("x")))(
+                jnp.arange(32.0))
+        # within-group transpose of 1-element chunks: rank r (in-group
+        # pos p) ends with [chunk p of each member of its group]
+        arr = np.arange(32.0).reshape(8, 4)
+        want = np.concatenate(
+            [arr[g0:g0 + 4, p] for g0 in (0, 4) for p in range(4)])
+        np.testing.assert_allclose(np.asarray(out2), want)
+        txt = jax.jit(jax.shard_map(
+            a2a, mesh=mesh, in_specs=P("x"), out_specs=P("x"))).lower(
+                jnp.arange(32.0)).as_text()
+        grouped = [ln for ln in txt.splitlines() if "replica_groups" in ln]
+        for ln in grouped:
+            assert "[[0, 1, 2, 3], [4, 5, 6, 7]]" in ln, ln
