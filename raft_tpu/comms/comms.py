@@ -132,16 +132,6 @@ class Comms:
     # all_gather + local reduce — still O(group) bandwidth, never a
     # full-axis collective.
 
-    def _my_group(self):
-        """(group row of this rank, in-group rank) — device values."""
-        idx = lax.axis_index(self.axis_name)
-        groups = jnp.asarray(self.axis_index_groups)  # (n_groups, gsz)
-        member = (groups == idx[None, None]).any(axis=1)
-        gid = jnp.argmax(member)
-        row = groups[gid]
-        pos = jnp.argmax(row == idx)
-        return row, pos
-
     def _group_gather(self, x):
         """Grouped all_gather: this rank receives its OWN group's
         (gsz, ...) stack — lowers to replica_groups=subgroups."""

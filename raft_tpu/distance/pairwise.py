@@ -260,8 +260,43 @@ def _haversine(x, y):
 # Dispatch
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("metric", "metric_arg"))
+# elementwise-family metrics with a Pallas tile-kernel core
+# (ops/pallas_elementwise_dist.py): DistanceType → (kernel tag, sqrt)
+_ELT_KERNEL = {
+    DistanceType.L1: ("l1", False),
+    DistanceType.L2Unexpanded: ("l2unexp", False),
+    DistanceType.L2SqrtUnexpanded: ("l2unexp", True),
+    DistanceType.Linf: ("linf", False),
+    DistanceType.Canberra: ("canberra", False),
+    DistanceType.LpUnexpanded: ("minkowski", False),
+    DistanceType.BrayCurtis: ("braycurtis", False),
+    DistanceType.JensenShannon: ("jensen_shannon", False),
+    DistanceType.HammingUnexpanded: ("hamming", False),
+    DistanceType.KLDivergence: ("kl", False),
+}
+
+
 def _pairwise(x, y, metric: DistanceType, metric_arg: float) -> jax.Array:
+    # kernel-tier dispatch happens OUTSIDE the jitted body: baked into a
+    # jit cache it would survive RAFT_TPU_PALLAS changes for any
+    # already-traced shape (matching fused_l2_nn.py / selection.py)
+    use_elt_kernel = False
+    if metric in _ELT_KERNEL:
+        from raft_tpu.ops.dispatch import pallas_enabled
+        use_elt_kernel = pallas_enabled()
+    return _pairwise_jit(x, y, metric, metric_arg, use_elt_kernel)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "metric_arg",
+                                             "use_elt_kernel"))
+def _pairwise_jit(x, y, metric: DistanceType, metric_arg: float,
+                  use_elt_kernel: bool) -> jax.Array:
+    if use_elt_kernel:
+        from raft_tpu.ops.pallas_elementwise_dist import (
+            elementwise_dist_pallas)
+        tag, sqrt = _ELT_KERNEL[metric]
+        return elementwise_dist_pallas(_f32(x), _f32(y), tag,
+                                       p=metric_arg, sqrt=sqrt)
     if metric == DistanceType.L2Expanded:
         return _l2_expanded(x, y, sqrt=False)
     if metric == DistanceType.L2SqrtExpanded:

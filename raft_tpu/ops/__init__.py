@@ -35,6 +35,7 @@ __all__ = [
     "fused_knn_pallas",
     "select_k_pallas",
     "ivf_list_scan_pallas",
+    "elementwise_dist_pallas",
 ]
 
 _LAZY = {
@@ -42,6 +43,7 @@ _LAZY = {
     "fused_knn_pallas": "raft_tpu.ops.pallas_fused_knn",
     "select_k_pallas": "raft_tpu.ops.pallas_select_k",
     "ivf_list_scan_pallas": "raft_tpu.ops.pallas_ivf_scan",
+    "elementwise_dist_pallas": "raft_tpu.ops.pallas_elementwise_dist",
 }
 
 

@@ -40,24 +40,12 @@ from raft_tpu.ops._util import (BIG_I32 as _BIG_I32, VMEM_LIMIT as _VMEM_LIMIT,
 from raft_tpu.core.precision import kernel_matmul_mode
 
 
-def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
-                k: int, l_bins: int, metric: str, sqrt: bool,
-                precision):
-    j = pl.program_id(1)
-    x = x_ref[:]                                         # (TM, K)
-    y = y_ref[:]                                         # (TN, K)
-    tm = x.shape[0]
-    ip = dot_nt_f32(y, x, precision)
-    if metric == "l2":
-        xx = jnp.sum(x * x, axis=1, keepdims=True).T     # (1, TM)
-        yy = jnp.sum(y * y, axis=1, keepdims=True)       # (TN, 1)
-        d = jnp.maximum(yy + xx - 2.0 * ip, 0.0)
-    else:  # "ip": similarity → negate so smaller-is-better uniformly
-        d = -ip
-    row = jax.lax.broadcasted_iota(jnp.int32, (tn, tm), 0) + j * tn
-    if n % tn:  # only pay the padded-row masking pass when padding exists
-        d = jnp.where(row < n, d, jnp.inf)
-
+def _merge_epilogue(d, row, od_ref, oi_ref, *, j, gn: int, k: int,
+                    l_bins: int, metric: str, sqrt: bool):
+    """Shared tail of the 2-D and K-tiled kernels: binned partial top-1
+    candidates, filtered exact merge into the resident (k, TM) state,
+    final sqrt/negate pass."""
+    tn, tm = d.shape
     # (2) binned partial top-1: (TN, TM) → (L, TM) candidates
     b = tn // l_bins
     db_ = d.reshape(l_bins, b, tm)
@@ -109,28 +97,78 @@ def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
             od_ref[:] = -od_ref[:]
 
 
+def _knn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
+                k: int, l_bins: int, metric: str, sqrt: bool,
+                precision):
+    j = pl.program_id(1)
+    x = x_ref[:]                                         # (TM, K)
+    y = y_ref[:]                                         # (TN, K)
+    tm = x.shape[0]
+    ip = dot_nt_f32(y, x, precision)
+    if metric == "l2":
+        xx = jnp.sum(x * x, axis=1, keepdims=True).T     # (1, TM)
+        yy = jnp.sum(y * y, axis=1, keepdims=True)       # (TN, 1)
+        d = jnp.maximum(yy + xx - 2.0 * ip, 0.0)
+    else:  # "ip": similarity → negate so smaller-is-better uniformly
+        d = -ip
+    row = jax.lax.broadcasted_iota(jnp.int32, (tn, tm), 0) + j * tn
+    if n % tn:  # only pay the padded-row masking pass when padding exists
+        d = jnp.where(row < n, d, jnp.inf)
+    _merge_epilogue(d, row, od_ref, oi_ref, j=j, gn=gn, k=k,
+                    l_bins=l_bins, metric=metric, sqrt=sqrt)
+
+
+def _knn_kernel_ktiled(x_ref, y_ref, od_ref, oi_ref, acc_ref, xx_ref,
+                       yy_ref, *, n: int, tn: int, gn: int, gk: int,
+                       k: int, l_bins: int, metric: str, sqrt: bool,
+                       precision):
+    """K-staged variant (reference contractions.cuh:71-307): the
+    contraction dimension is tiled on the innermost grid axis and the
+    (TN, TM) partial products accumulate in VMEM scratch; the distance
+    epilogue + merge run on the final K step only. Lifts the dim ≤ 4096
+    cap — VMEM holds one (TM+TN)·KT operand pair per step, never the
+    full dim."""
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+    x = x_ref[:]                                         # (TM, KT)
+    y = y_ref[:]                                         # (TN, KT)
+    tm = x.shape[0]
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+        if metric == "l2":
+            xx_ref[:] = jnp.zeros(xx_ref.shape, jnp.float32)
+            yy_ref[:] = jnp.zeros(yy_ref.shape, jnp.float32)
+
+    acc_ref[:] += dot_nt_f32(y, x, precision)
+    if metric == "l2":
+        xx_ref[:] += jnp.sum(x * x, axis=1, keepdims=True).T  # (1, TM)
+        yy_ref[:] += jnp.sum(y * y, axis=1, keepdims=True)    # (TN, 1)
+
+    @pl.when(kk == gk - 1)
+    def _():
+        ip = acc_ref[:]
+        if metric == "l2":
+            d = jnp.maximum(yy_ref[:] + xx_ref[:] - 2.0 * ip, 0.0)
+        else:
+            d = -ip
+        row = jax.lax.broadcasted_iota(jnp.int32, (tn, tm), 0) + j * tn
+        if n % tn:
+            d = jnp.where(row < n, d, jnp.inf)
+        _merge_epilogue(d, row, od_ref, oi_ref, j=j, gn=gn, k=k,
+                        l_bins=l_bins, metric=metric, sqrt=sqrt)
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "k", "metric", "sqrt", "tm", "tn", "l_bins", "interpret"))
+    "k", "metric", "sqrt", "tm", "tn", "kt", "l_bins", "interpret"))
 def _fused_knn_call(x, y, k: int, metric: str, sqrt: bool, tm: int, tn: int,
-                    l_bins: int, interpret: bool):
+                    l_bins: int, interpret: bool, kt: int = 0):
     m, dim = x.shape
     n = y.shape[0]
     mp, np_ = _round_up(m, tm), _round_up(n, tn)
-    xp = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, 0)))
-    yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
     gm, gn = mp // tm, np_ // tn
-    kern = functools.partial(_knn_kernel, n=n, tn=tn, gn=gn, k=k,
-                             l_bins=l_bins, metric=metric, sqrt=sqrt,
-                             precision=kernel_matmul_mode(interpret))
-    od, oi = pl.pallas_call(
-        kern,
-        grid=(gm, gn),
-        in_specs=[pl.BlockSpec((tm, dim), lambda i, j: (i, 0)),
-                  pl.BlockSpec((tn, dim), lambda i, j: (j, 0))],
-        out_specs=[pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0)),
-                   pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0))],
-        out_shape=[jax.ShapeDtypeStruct((gm, k, tm), jnp.float32),
-                   jax.ShapeDtypeStruct((gm, k, tm), jnp.int32)],
+    common = dict(
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         cost_estimate=pl.CostEstimate(
@@ -139,7 +177,52 @@ def _fused_knn_call(x, y, k: int, metric: str, sqrt: bool, tm: int, tn: int,
                                 + 2 * mp * k),
             transcendentals=0),
         interpret=interpret,
-    )(xp, yp)
+    )
+    if kt <= 0 or kt >= dim:
+        xp = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, 0)))
+        yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
+        kern = functools.partial(_knn_kernel, n=n, tn=tn, gn=gn, k=k,
+                                 l_bins=l_bins, metric=metric, sqrt=sqrt,
+                                 precision=kernel_matmul_mode(interpret))
+        od, oi = pl.pallas_call(
+            kern,
+            grid=(gm, gn),
+            in_specs=[pl.BlockSpec((tm, dim), lambda i, j: (i, 0)),
+                      pl.BlockSpec((tn, dim), lambda i, j: (j, 0))],
+            out_specs=[pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0)),
+                       pl.BlockSpec((1, k, tm), lambda i, j: (i, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((gm, k, tm), jnp.float32),
+                       jax.ShapeDtypeStruct((gm, k, tm), jnp.int32)],
+            **common,
+        )(xp, yp)
+    else:
+        # K-staged path for large dim: pad the contraction dim to a KT
+        # multiple (zero pad — contributes nothing to ip or norms)
+        dp = _round_up(dim, kt)
+        gk = dp // kt
+        xp = jnp.pad(x.astype(jnp.float32),
+                     ((0, mp - m), (0, dp - dim)))
+        yp = jnp.pad(y.astype(jnp.float32),
+                     ((0, np_ - n), (0, dp - dim)))
+        kern = functools.partial(
+            _knn_kernel_ktiled, n=n, tn=tn, gn=gn, gk=gk, k=k,
+            l_bins=l_bins, metric=metric, sqrt=sqrt,
+            precision=kernel_matmul_mode(interpret))
+        od, oi = pl.pallas_call(
+            kern,
+            grid=(gm, gn, gk),
+            in_specs=[pl.BlockSpec((tm, kt), lambda i, j, kk: (i, kk)),
+                      pl.BlockSpec((tn, kt), lambda i, j, kk: (j, kk))],
+            out_specs=[
+                pl.BlockSpec((1, k, tm), lambda i, j, kk: (i, 0, 0)),
+                pl.BlockSpec((1, k, tm), lambda i, j, kk: (i, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((gm, k, tm), jnp.float32),
+                       jax.ShapeDtypeStruct((gm, k, tm), jnp.int32)],
+            scratch_shapes=[pltpu.VMEM((tn, tm), jnp.float32),
+                            pltpu.VMEM((1, tm), jnp.float32),
+                            pltpu.VMEM((tn, 1), jnp.float32)],
+            **common,
+        )(xp, yp)
     # (gm, k, TM) → (m, k)
     od = jnp.moveaxis(od, 1, 2).reshape(gm * tm, k)[:m]
     oi = jnp.moveaxis(oi, 1, 2).reshape(gm * tm, k)[:m]
@@ -164,10 +247,13 @@ def fused_knn_pallas(x, y, k: int, metric: str = "l2", sqrt: bool = False,
         raise ValueError(f"fused_knn_pallas: k={k} > n={n}")
     if m == 0:
         raise ValueError("fused_knn_pallas: empty query set")
+    kt = 0
     if dim > 4096:
-        raise ValueError(
-            f"fused_knn_pallas: dim={dim} > 4096 exceeds the VMEM tile "
-            "budget; use the exact scan path")
+        # K-staged kernel (reference contractions.cuh K tiles): the
+        # contraction dim streams through VMEM in KT-wide stages with a
+        # resident accumulator, so arbitrary dim runs fused
+        kt = 2048
+        tm, tn = (tm or 256), (tn or 1024)
     if tm <= 0 or tn <= 0:
         # VMEM heuristic: the (TN, TM) f32 distance block dominates —
         # 16 MiB at 4096×1024 — plus (tm+tn)·dim·4 operand blocks
@@ -188,4 +274,4 @@ def fused_knn_pallas(x, y, k: int, metric: str = "l2", sqrt: bool = False,
     while tn % l_bins:  # terminates: tn % tn == 0
         l_bins += 1
     return _fused_knn_call(x, y, int(k), metric, bool(sqrt), tm, tn,
-                           l_bins, pallas_interpret())
+                           l_bins, pallas_interpret(), kt=kt)

@@ -490,5 +490,6 @@ class TestSplitCommGroupedLowering:
             a2a, mesh=mesh, in_specs=P("x"), out_specs=P("x"))).lower(
                 jnp.arange(32.0)).as_text()
         grouped = [ln for ln in txt.splitlines() if "replica_groups" in ln]
+        assert grouped, "no collective in alltoall lowering"
         for ln in grouped:
             assert "[[0, 1, 2, 3], [4, 5, 6, 7]]" in ln, ln
