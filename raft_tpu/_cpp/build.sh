@@ -7,5 +7,5 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 mkdir -p ../_lib
-exec g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra \
+exec g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra -pthread \
     -o ../_lib/libraft_tpu_host.so raft_tpu_host.cpp

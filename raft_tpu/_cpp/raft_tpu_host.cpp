@@ -11,13 +11,26 @@
 // Build: cpp/build.sh → raft_tpu/_lib/libraft_tpu_host.so
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdarg>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <numeric>
+#include <string>
+#include <thread>
+#include <unordered_map>
 #include <vector>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
 
 extern "C" {
 
@@ -25,7 +38,7 @@ extern "C" {
 // Version
 // ---------------------------------------------------------------------------
 
-int rth_abi_version() { return 2; }
+int rth_abi_version() { return 3; }
 
 // ---------------------------------------------------------------------------
 // Logging core (reference core/logger.hpp:118-251: level gating + callback
@@ -231,6 +244,303 @@ int64_t rth_boruvka_mst(int64_t n, int64_t m, const int64_t* src,
   }
   for (int64_t v = 0; v < n; ++v) out_comp[v] = find(v);
   return n_out;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Tagged KV broker over TCP — the native host-p2p transport (the role of
+// the reference's UCX layer: comms/detail/ucp_helper.hpp + the tagged
+// isend/irecv endpoints in std_comms.hpp:209-305). One process (rank 0)
+// hosts the broker; every rank's HostP2P client PUTs tagged messages and
+// blocks on GETs with a timeout, giving the same waitall-with-timeout
+// failure semantics (std_comms.hpp:246-249) without routing host metadata
+// through the JAX coordination service.
+//
+// Wire protocol (all little-endian):
+//   request : u8 op (1=PUT overwrite, 2=GET consume, 3=PEEK keep)
+//             u32 key_len, key bytes
+//             PUT:  u64 val_len, val bytes
+//             GET/PEEK: u32 timeout_ms
+//   response: PUT: u8 status(0)
+//             GET/PEEK: u8 status (0=ok, 1=timeout), ok → u64 val_len, val
+// ---------------------------------------------------------------------------
+
+namespace {
+
+bool read_full(int fd, void* buf, size_t len) {
+  auto* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t r = ::recv(fd, p, len, 0);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t len) {
+  const auto* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t r = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct KvServer {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> store;
+  std::atomic<bool> stop{false};
+  int listen_fd = -1;
+  int port = 0;
+  std::thread acceptor;
+  // Connection threads are detached (per-op connections would otherwise
+  // accumulate unjoined std::thread objects for the broker's lifetime);
+  // shutdown_server() instead waits for active_conns to reach zero, and
+  // every path a worker takes after its final decrement touches no
+  // member state — so the object cannot be freed under a live worker.
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  std::vector<int> conn_fds;
+  int active_conns = 0;
+
+  void serve_conn(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      uint32_t klen;
+      if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!read_full(fd, key.data(), klen)) break;
+      if (op == 1) {  // PUT (overwrite)
+        uint64_t vlen;
+        if (!read_full(fd, &vlen, 8) || vlen > (1ull << 32)) break;
+        std::string val(vlen, '\0');
+        if (!read_full(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          store[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t st = 0;
+        if (!write_full(fd, &st, 1)) break;
+      } else if (op == 2 || op == 3) {  // GET / PEEK
+        uint32_t timeout_ms;
+        if (!read_full(fd, &timeout_ms, 4)) break;
+        std::string val;
+        bool ok = false;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          auto ready = [&] {
+            return stop.load() || store.count(key) > 0;
+          };
+          cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
+          auto it = store.find(key);
+          if (it != store.end()) {
+            val = it->second;
+            ok = true;
+            if (op == 2) store.erase(it);
+          }
+        }
+        uint8_t st = ok ? 0 : 1;
+        if (!write_full(fd, &st, 1)) break;
+        if (ok) {
+          uint64_t vlen = val.size();
+          if (!write_full(fd, &vlen, 8) ||
+              !write_full(fd, val.data(), val.size()))
+            break;
+        }
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+    {
+      // final touch of member state: decrement + notify under the lock,
+      // so shutdown_server() cannot pass its wait until we released it
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
+                     conn_fds.end());
+      --active_conns;
+      conn_cv.notify_all();
+    }
+  }
+
+  int start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(listen_fd, 64) < 0) {
+      ::close(listen_fd);
+      listen_fd = -1;
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    acceptor = std::thread([this] {
+      while (!stop.load()) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+          if (stop.load()) break;
+          continue;
+        }
+        {
+          std::lock_guard<std::mutex> lk(conn_mu);
+          conn_fds.push_back(fd);
+          ++active_conns;
+        }
+        std::thread(&KvServer::serve_conn, this, fd).detach();
+      }
+    });
+    return port;
+  }
+
+  void shutdown_server() {
+    stop.store(true);
+    cv.notify_all();  // wake GETs parked in wait_for (predicate sees stop)
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    if (acceptor.joinable()) acceptor.join();
+    std::unique_lock<std::mutex> lk(conn_mu);
+    // unblock recv()-parked connection threads, then wait them out
+    for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    conn_cv.wait(lk, [this] { return active_conns == 0; });
+  }
+};
+
+std::mutex g_kv_mutex;
+KvServer* g_kv_server = nullptr;
+
+int kv_connect(const char* host, int port) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", port);
+  if (::getaddrinfo(host, portstr, &hints, &res) != 0 || res == nullptr)
+    return -1;
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) < 0) {
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bound port of the process-global broker, or -1 when none is running —
+// lets callers distinguish "I created it" from "one already existed".
+int rth_kv_server_port() {
+  std::lock_guard<std::mutex> lk(g_kv_mutex);
+  return g_kv_server != nullptr ? g_kv_server->port : -1;
+}
+
+// Start the process-global broker on `port` (0 = ephemeral). Returns the
+// bound port (the existing broker's if one already runs), or -1 on bind
+// failure.
+int rth_kv_server_start(int port) {
+  std::lock_guard<std::mutex> lk(g_kv_mutex);
+  if (g_kv_server != nullptr) return g_kv_server->port;
+  auto* s = new KvServer();
+  int p = s->start(port);
+  if (p < 0) {
+    delete s;
+    return -1;
+  }
+  g_kv_server = s;
+  return p;
+}
+
+void rth_kv_server_stop() {
+  KvServer* s;
+  {
+    std::lock_guard<std::mutex> lk(g_kv_mutex);
+    s = g_kv_server;
+    g_kv_server = nullptr;
+  }
+  if (s != nullptr) {
+    s->shutdown_server();
+    delete s;
+  }
+}
+
+// PUT (overwrite). Returns 0, or -2 on connect/protocol failure.
+int rth_kv_put(const char* host, int port, const char* key,
+               const uint8_t* val, int64_t val_len) {
+  int fd = kv_connect(host, port);
+  if (fd < 0) return -2;
+  uint8_t op = 1;
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  uint64_t vlen = static_cast<uint64_t>(val_len);
+  uint8_t st = 1;
+  bool ok = write_full(fd, &op, 1) && write_full(fd, &klen, 4) &&
+            write_full(fd, key, klen) && write_full(fd, &vlen, 8) &&
+            write_full(fd, val, vlen) && read_full(fd, &st, 1) && st == 0;
+  ::close(fd);
+  return ok ? 0 : -2;
+}
+
+// GET (consume=1) / PEEK (consume=0) with timeout. Returns the value
+// length (written into out, up to cap), -1 on timeout, -2 on error, -3
+// if the value exceeded cap (value is lost for GET — size caps are the
+// caller's contract, as with UCX eager messages).
+int64_t rth_kv_get(const char* host, int port, const char* key,
+                   int timeout_ms, int consume, uint8_t* out, int64_t cap) {
+  int fd = kv_connect(host, port);
+  if (fd < 0) return -2;
+  uint8_t op = consume ? 2 : 3;
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  uint32_t tmo = static_cast<uint32_t>(timeout_ms < 0 ? 0 : timeout_ms);
+  int64_t rc = -2;
+  uint8_t st = 2;
+  if (write_full(fd, &op, 1) && write_full(fd, &klen, 4) &&
+      write_full(fd, key, klen) && write_full(fd, &tmo, 4) &&
+      read_full(fd, &st, 1)) {
+    if (st == 1) {
+      rc = -1;
+    } else if (st == 0) {
+      uint64_t vlen = 0;
+      if (read_full(fd, &vlen, 8)) {
+        if (static_cast<int64_t>(vlen) > cap) {
+          rc = -3;
+        } else {
+          std::string tmp(vlen, '\0');
+          if (read_full(fd, tmp.data(), vlen)) {
+            std::memcpy(out, tmp.data(), vlen);
+            rc = static_cast<int64_t>(vlen);
+          }
+        }
+      }
+    }
+  }
+  ::close(fd);
+  return rc;
 }
 
 }  // extern "C"

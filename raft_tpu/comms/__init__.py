@@ -27,6 +27,13 @@ from raft_tpu.comms.collective_checks import (
 )
 from raft_tpu.comms.bootstrap import Session, local_handle, initialize_distributed
 from raft_tpu.comms.host_p2p import HostP2P, Request
+from raft_tpu.comms.health import HealthMonitor
+from raft_tpu.comms.native_p2p import NativeKVClient, NativeKVServer
+from raft_tpu.comms.launcher import (
+    LauncherWorld,
+    build_launcher_resources,
+    detect_launcher,
+)
 
 __all__ = [
     "Comms", "ReduceOp", "Status", "build_comms", "inject_comms",
@@ -35,5 +42,7 @@ __all__ = [
     "test_collective_gather", "test_collective_reducescatter",
     "test_pointToPoint_simple_send_recv", "test_commsplit",
     "Session", "local_handle", "initialize_distributed",
-    "HostP2P", "Request",
+    "HostP2P", "Request", "HealthMonitor",
+    "NativeKVClient", "NativeKVServer",
+    "LauncherWorld", "build_launcher_resources", "detect_launcher",
 ]

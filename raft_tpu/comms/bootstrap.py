@@ -103,9 +103,25 @@ class Session:
                                      session=self.name)
         return self._host_p2p
 
+    def health(self, interval_s: float = 1.0, stale_after_s: float = 10.0):
+        """Process-level heartbeat monitor for this session's clique
+        (``comms.health.HealthMonitor``); feeds participant
+        identification into ``Comms.sync_stream(monitor=...)``. Started
+        on first call; one per Session."""
+        from raft_tpu.comms.health import HealthMonitor
+        expects(self.mesh is not None, "Session not initialized")
+        if getattr(self, "_health", None) is None:
+            self._health = HealthMonitor(
+                jax.process_index(), jax.process_count(), session=self.name,
+                interval_s=interval_s, stale_after_s=stale_after_s).start()
+        return self._health
+
     def destroy(self) -> None:
         with _lock:
             _sessions.pop(self.session_id, None)
+        if getattr(self, "_health", None) is not None:
+            self._health.stop()
+            self._health = None
         self._host_p2p = None
         self.mesh = None
         self.resources = None

@@ -253,25 +253,40 @@ class Comms:
         return self.allreduce(jnp.ones((), jnp.int32))
 
     # -- host-side sync with failure semantics -----------------------------
-    def sync_stream(self, *arrays, timeout_s: Optional[float] = None) -> Status:
+    def sync_stream(self, *arrays, timeout_s: Optional[float] = None,
+                    monitor=None) -> Status:
         """Block until device results materialize; ABORT on timeout
         (reference sync_stream polling + ncclCommGetAsyncError,
         comms/detail/util.hpp:109-143). Anything exposing ``is_ready()``
         is polled (duck-typed, like the reference polls any stream).
         Readiness is checked before the deadline, so already-complete work
-        never reports a false ABORT."""
+        never reports a false ABORT.
+
+        ``monitor`` (a :class:`raft_tpu.comms.health.HealthMonitor`)
+        upgrades the reference's anonymous ABORT: while polling, stale
+        peer heartbeats abort EARLY (the collective will never complete
+        without them), and on any abort ``monitor.last_suspects`` names
+        the failed participants (SURVEY.md hard part (e))."""
         timeout_s = timeout_s if timeout_s is not None else self.abort_timeout_s
         leaves = [l for l in jax.tree_util.tree_leaves(
             arrays, is_leaf=lambda v: hasattr(v, "is_ready"))
             if hasattr(l, "is_ready")]
         deadline = time.monotonic() + timeout_s
+        next_health = time.monotonic()  # first loop checks immediately
         while True:
             try:
                 if all(a.is_ready() for a in leaves):
                     return Status.SUCCESS
             except Exception:
                 return Status.ERROR
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if monitor is not None and now >= next_health:
+                next_health = now + max(monitor.interval_s, 0.05)
+                if monitor.suspect_ranks():
+                    return Status.ABORT
+            if now >= deadline:
+                if monitor is not None:
+                    monitor.suspect_ranks()
                 return Status.ABORT
             time.sleep(0.001)
 

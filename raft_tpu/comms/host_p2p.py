@@ -85,12 +85,22 @@ class HostP2P:
     """
 
     def __init__(self, rank: int, size: int, session: str = "default",
-                 registry: Optional[_InProcessRegistry] = None):
+                 registry: Optional[_InProcessRegistry] = None,
+                 client=None):
+        """``client`` overrides the transport: anything shaped like the
+        coordination-service client (``key_value_set`` /
+        ``blocking_key_value_get``) — e.g. the native C++ broker's
+        :class:`raft_tpu.comms.native_p2p.NativeKVClient`."""
         expects(0 <= rank < size, "HostP2P: bad rank")
         self.rank = rank
         self.size = size
         self.session = session
-        self._client = None if registry is not None else _coordination_client()
+        if client is not None:
+            self._client = client
+            registry = None
+        else:
+            self._client = (None if registry is not None
+                            else _coordination_client())
         self._registry = registry
         if self._client is None and self._registry is None:
             self._registry = _default_registry

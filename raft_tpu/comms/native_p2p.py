@@ -1,0 +1,102 @@
+"""Native TCP transport for tagged host p2p (the UCX role, in C++).
+
+Reference: ``comms/detail/ucp_helper.hpp`` (259 LoC C++ wrapping UCP tag
+send/recv) + the UCX endpoints in ``std_comms.hpp:209-305``. The TPU
+framework's equivalent native transport is the C++ KV broker in
+``_cpp/raft_tpu_host.cpp`` (``rth_kv_*``): rank 0 hosts a TCP broker;
+every rank's :class:`~raft_tpu.comms.host_p2p.HostP2P` talks to it
+through :class:`NativeKVClient`, which duck-types the JAX
+coordination-service client (``key_value_set`` /
+``blocking_key_value_get``) so the two transports are interchangeable:
+
+    server = NativeKVServer().start()          # on rank 0
+    ch = HostP2P(rank, size, client=NativeKVClient("host0", server.port))
+
+Timeouts surface exactly like the coordination client's (an exception
+naming DEADLINE), so HostP2P's ABORT semantics are transport-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raft_tpu.core import native
+from raft_tpu.core.error import expects
+
+
+class NativeKVServer:
+    """Process-global C++ TCP broker (one per process; rank 0 hosts).
+
+    If a broker is already running in this process, :meth:`start` adopts
+    it (same port) WITHOUT taking ownership: only the instance that
+    actually created the broker tears it down on :meth:`stop` — an
+    adopter's stop() must not yank the transport from under every rank
+    still using it.
+    """
+
+    def __init__(self, port: int = 0):
+        self._want_port = port
+        self.port: Optional[int] = None
+        self.owner = False
+
+    def start(self) -> "NativeKVServer":
+        expects(native.available(), "native host library unavailable")
+        existing = native.kv_server_port()
+        p = native.kv_server_start(self._want_port)
+        expects(p is not None, "native kv broker failed to bind")
+        self.port = p
+        self.owner = existing is None
+        return self
+
+    def stop(self) -> None:
+        if self.owner:
+            native.kv_server_stop()
+        self.port = None
+        self.owner = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class NativeKVClient:
+    """Coordination-client-shaped facade over the C++ broker.
+
+    ``max_len`` caps message size on BOTH sides (the broker consumes a
+    value on GET, so an oversized receive would destroy the message):
+    oversized sends are rejected eagerly at the sender, mirroring UCX's
+    eager-protocol size contract.
+    """
+
+    def __init__(self, host: str, port: int, max_len: int = 1 << 22):
+        self.host = host
+        self.port = int(port)
+        self.max_len = int(max_len)
+
+    def key_value_set(self, key: str, value: str,
+                      allow_overwrite: bool = True) -> None:
+        del allow_overwrite  # native PUT always overwrites
+        payload = value.encode("latin-1")
+        if len(payload) > self.max_len:
+            raise ValueError(
+                f"native kv put: payload {len(payload)} B exceeds the "
+                f"transport cap {self.max_len} B (raise max_len on both "
+                "ends to send larger messages)")
+        ok = native.kv_put(self.host, self.port, key, payload)
+        if not ok:
+            raise OSError(f"native kv put to {self.host}:{self.port} failed")
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int) -> str:
+        out = native.kv_get(self.host, self.port, key, timeout_ms,
+                            consume=True, max_len=self.max_len)
+        if out is None:
+            raise TimeoutError(
+                f"DEADLINE_EXCEEDED: native kv get({key!r}, {timeout_ms}ms)")
+        return out.decode("latin-1")
+
+    def key_value_try_get(self, key: str) -> Optional[str]:
+        out = native.kv_get(self.host, self.port, key, 0, consume=False,
+                            max_len=self.max_len)
+        return None if out is None else out.decode("latin-1")

@@ -19,7 +19,7 @@ from typing import Optional
 import numpy as np
 
 _LIB_NAME = "libraft_tpu_host.so"
-_ABI = 2  # must match rth_abi_version() in _cpp/raft_tpu_host.cpp
+_ABI = 3  # must match rth_abi_version() in _cpp/raft_tpu_host.cpp
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
@@ -70,6 +70,20 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rth_boruvka_mst.argtypes = [
         ctypes.c_int64, ctypes.c_int64, i64p, i64p, f64p, f64p,
         i64p, i64p, f64p, i64p]
+    lib.rth_kv_server_port.restype = ctypes.c_int
+    lib.rth_kv_server_port.argtypes = []
+    lib.rth_kv_server_start.restype = ctypes.c_int
+    lib.rth_kv_server_start.argtypes = [ctypes.c_int]
+    lib.rth_kv_server_stop.restype = None
+    lib.rth_kv_server_stop.argtypes = []
+    lib.rth_kv_put.restype = ctypes.c_int
+    lib.rth_kv_put.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib.rth_kv_get.restype = ctypes.c_int64
+    lib.rth_kv_get.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
     return lib
 
 
@@ -194,6 +208,57 @@ def boruvka_mst(n: int, src, dst, altered_w, orig_w):
     if rc < 0:
         raise ValueError(f"boruvka_mst: invalid edges (rc={rc})")
     return out_s[:rc], out_d[:rc], out_w[:rc], out_c[:int(n)]
+
+
+def kv_server_port():
+    """Bound port of the running process-global broker, or None."""
+    lib = load()
+    if lib is None:
+        return None
+    p = lib.rth_kv_server_port()
+    return int(p) if p > 0 else None
+
+
+def kv_server_start(port: int = 0):
+    """Start the native TCP KV broker (the UCX-endpoint role,
+    comms/detail/ucp_helper.hpp). Returns the bound port, or None when
+    the native lib is unavailable / bind failed."""
+    lib = load()
+    if lib is None:
+        return None
+    p = lib.rth_kv_server_start(int(port))
+    return int(p) if p > 0 else None
+
+
+def kv_server_stop() -> None:
+    lib = load()
+    if lib is not None:
+        lib.rth_kv_server_stop()
+
+
+def kv_put(host: str, port: int, key: str, value: bytes) -> bool:
+    lib = load()
+    if lib is None:
+        return False
+    return lib.rth_kv_put(host.encode(), int(port), key.encode(),
+                          value, len(value)) == 0
+
+
+def kv_get(host: str, port: int, key: str, timeout_ms: int,
+           consume: bool = True, max_len: int = 1 << 22):
+    """Blocking tagged GET. Returns the value bytes, None on timeout;
+    raises OSError on transport errors or an overflowing value."""
+    lib = load()
+    if lib is None:
+        raise OSError("native kv broker unavailable")
+    buf = ctypes.create_string_buffer(max_len)
+    rc = lib.rth_kv_get(host.encode(), int(port), key.encode(),
+                        int(timeout_ms), 1 if consume else 0, buf, max_len)
+    if rc >= 0:
+        return buf.raw[:rc]
+    if rc == -1:
+        return None
+    raise OSError(f"native kv get failed (rc={rc})")
 
 
 def log(level: int, msg: str) -> bool:

@@ -1,0 +1,77 @@
+"""Shared per-metric cores for the elementwise distance family.
+
+Reference: ``distance/detail/pairwise_distance_base.cuh`` — one tiled
+kernel, per-metric ``core_op``/``fin_op`` lambdas. This module is the
+single definition of those lambdas for every TPU tier: the XLA
+``lax.map`` tiling (``distance/pairwise.py``), the Pallas tile kernel
+(``ops/pallas_elementwise_dist.py``), and the column-tiled wide sparse
+path (``sparse/distance.py``). Fix one metric here, every tier follows.
+
+Tags: l1 | l2unexp | linf | canberra | minkowski | hamming |
+jensen_shannon | kl | braycurtis. Every combine maps (0, 0) → 0, which
+the Pallas pad lanes and the sparse explicit zeros both rely on.
+``braycurtis`` is the one pair-accumulator metric: combine returns
+(numerator, denominator) terms and finalize divides.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# metrics whose k-reduction is max instead of sum
+MAX_REDUCE = ("linf",)
+# metrics needing two running sums (combine returns a tuple)
+PAIR_ACCUM = ("braycurtis",)
+
+
+def combine(metric: str, a, b, p: float):
+    """Per-coordinate term(s); reduced over the feature axis by sum (or
+    max for MAX_REDUCE metrics)."""
+    if metric in ("l1", "linf"):
+        return jnp.abs(a - b)
+    if metric == "l2unexp":
+        d = a - b
+        return d * d
+    if metric == "canberra":
+        num = jnp.abs(a - b)
+        den = jnp.abs(a) + jnp.abs(b)
+        return jnp.where(den == 0.0, 0.0,
+                         num / jnp.where(den == 0.0, 1.0, den))
+    if metric == "minkowski":
+        return jnp.abs(a - b) ** p
+    if metric == "hamming":
+        return (a != b).astype(jnp.float32)
+    if metric == "jensen_shannon":
+        m = 0.5 * (a + b)
+        safe_m = jnp.where(m > 0.0, m, 1.0)
+        ta = jnp.where(a > 0.0,
+                       a * jnp.log(jnp.where(a > 0.0, a, 1.0) / safe_m),
+                       0.0)
+        tb = jnp.where(b > 0.0,
+                       b * jnp.log(jnp.where(b > 0.0, b, 1.0) / safe_m),
+                       0.0)
+        return ta + tb
+    if metric == "kl":
+        num = jnp.where(a > 0.0, a, 1.0)
+        den = jnp.where(b > 0.0, b, 1.0)
+        return jnp.where(a > 0.0, a * jnp.log(num / den), 0.0)
+    if metric == "braycurtis":
+        return jnp.abs(a - b), jnp.abs(a + b)
+    raise ValueError(f"elementwise core: unknown metric {metric!r}")
+
+
+def finalize(metric: str, d, p: float, dim: int, sqrt: bool):
+    """Post-reduction op. For PAIR_ACCUM metrics ``d`` is the tuple of
+    reduced accumulators."""
+    if metric == "braycurtis":
+        num, den = d
+        return num / jnp.where(den == 0.0, 1.0, den)
+    if metric == "l2unexp" and sqrt:
+        return jnp.sqrt(jnp.maximum(d, 0.0))
+    if metric == "minkowski":
+        return d ** (1.0 / p)
+    if metric == "hamming":
+        return d / float(dim)
+    if metric == "jensen_shannon":
+        return jnp.sqrt(jnp.maximum(0.5 * d, 0.0))
+    return d

@@ -146,9 +146,13 @@ def _row_tile(m: int, n: int, k: int) -> int:
     return t
 
 
-def _pairwise_elementwise(x, y, combine, reduce_fn, finalize=None):
-    """Compute D[i,j] = finalize(reduce_k(combine(x_ik, y_jk))) over row
-    tiles of x, keeping peak memory ≈ tile*n*k."""
+def _elementwise_xla(x, y, tag: str, p: float, sqrt: bool) -> jax.Array:
+    """D[i,j] = finalize(reduce_k(combine(x_ik, y_jk))) over row tiles of
+    x, keeping peak memory ≈ tile·n·k. The per-metric cores come from
+    the shared table (``distance/_elementwise_cores.py``) so this tier,
+    the Pallas kernel, and the wide sparse path can never diverge."""
+    from raft_tpu.distance import _elementwise_cores as cores
+
     m, k = x.shape
     n = y.shape[0]
     t = _row_tile(m, n, k)
@@ -156,92 +160,21 @@ def _pairwise_elementwise(x, y, combine, reduce_fn, finalize=None):
     xp = jnp.pad(_f32(x), ((0, pad), (0, 0))) if pad else _f32(x)
     yf = _f32(y)
     xt = xp.reshape(-1, t, k)
+    pair = tag in cores.PAIR_ACCUM
+    inner = jnp.max if tag in cores.MAX_REDUCE else jnp.sum
 
     def one_tile(xtile):
-        e = combine(xtile[:, None, :], yf[None, :, :])  # (t, n, k)
-        return reduce_fn(e, axis=2)
+        e = cores.combine(tag, xtile[:, None, :], yf[None, :, :], p)
+        if pair:
+            return tuple(jnp.sum(q, axis=2) for q in e)
+        return inner(e, axis=2)
 
-    d = lax.map(one_tile, xt).reshape(-1, n)
-    d = d[:m] if pad else d
-    return finalize(d) if finalize is not None else d
-
-
-def _l1(x, y):
-    return _pairwise_elementwise(x, y, lambda a, b: jnp.abs(a - b), jnp.sum)
-
-
-def _l2_unexpanded(x, y, sqrt: bool):
-    d = _pairwise_elementwise(x, y, lambda a, b: (a - b) ** 2, jnp.sum)
-    return jnp.sqrt(d) if sqrt else d
-
-
-def _linf(x, y):
-    return _pairwise_elementwise(x, y, lambda a, b: jnp.abs(a - b), jnp.max)
-
-
-def _canberra(x, y):
-    def combine(a, b):
-        num = jnp.abs(a - b)
-        den = jnp.abs(a) + jnp.abs(b)
-        return jnp.where(den == 0.0, 0.0, num / jnp.where(den == 0.0, 1.0, den))
-    return _pairwise_elementwise(x, y, combine, jnp.sum)
-
-
-def _minkowski(x, y, p: float):
-    return _pairwise_elementwise(
-        x, y, lambda a, b: jnp.abs(a - b) ** p, jnp.sum,
-        finalize=lambda d: d ** (1.0 / p),
-    )
-
-
-def _hamming(x, y):
-    # proportion of disagreeing coordinates (detail/hamming.cuh: sum(x!=y)/k)
-    k = x.shape[1]
-    return _pairwise_elementwise(
-        x, y, lambda a, b: (a != b).astype(jnp.float32), jnp.sum,
-        finalize=lambda d: d / float(k),
-    )
-
-
-def _jensen_shannon(x, y):
-    # sqrt(0.5 * sum(x log(x/m) + y log(y/m))), m = (x+y)/2, 0log0 := 0
-    def combine(a, b):
-        m = 0.5 * (a + b)
-        safe_m = jnp.where(m > 0.0, m, 1.0)
-        ta = jnp.where(a > 0.0, a * jnp.log(jnp.where(a > 0.0, a, 1.0) / safe_m), 0.0)
-        tb = jnp.where(b > 0.0, b * jnp.log(jnp.where(b > 0.0, b, 1.0) / safe_m), 0.0)
-        return ta + tb
-    return _pairwise_elementwise(
-        x, y, combine, jnp.sum,
-        finalize=lambda d: jnp.sqrt(jnp.maximum(0.5 * d, 0.0)),
-    )
-
-
-def _kl_divergence(x, y):
-    # sum x log(x/y), 0log0 := 0 (detail/kl_divergence.cuh)
-    def combine(a, b):
-        num = jnp.where(a > 0.0, a, 1.0)
-        den = jnp.where(b > 0.0, b, 1.0)
-        return jnp.where(a > 0.0, a * jnp.log(num / den), 0.0)
-    return _pairwise_elementwise(x, y, combine, jnp.sum)
-
-
-def _braycurtis(x, y):
-    m, k = x.shape
-    n = y.shape[0]
-    t = _row_tile(m, n, k)
-    pad = (-m) % t
-    xp = jnp.pad(_f32(x), ((0, pad), (0, 0))) if pad else _f32(x)
-    yf = _f32(y)
-    xt = xp.reshape(-1, t, k)
-
-    def one_tile(xtile):
-        diff = jnp.sum(jnp.abs(xtile[:, None, :] - yf[None, :, :]), axis=2)
-        ssum = jnp.sum(jnp.abs(xtile[:, None, :] + yf[None, :, :]), axis=2)
-        return diff / jnp.where(ssum == 0.0, 1.0, ssum)
-
-    d = lax.map(one_tile, xt).reshape(-1, n)
-    return d[:m] if pad else d
+    d = lax.map(one_tile, xt)
+    if pair:
+        d = tuple(q.reshape(-1, n)[:m] for q in d)
+    else:
+        d = d.reshape(-1, n)[:m]
+    return cores.finalize(tag, d, p, k, sqrt)
 
 
 def _haversine(x, y):
@@ -283,7 +216,10 @@ def _pairwise(x, y, metric: DistanceType, metric_arg: float) -> jax.Array:
     use_elt_kernel = False
     if metric in _ELT_KERNEL:
         from raft_tpu.ops.dispatch import pallas_enabled
-        use_elt_kernel = pallas_enabled()
+        from raft_tpu.ops.pallas_elementwise_dist import MAX_DIM
+        # the tile kernel holds full (tile, dim) operand blocks in VMEM
+        # (no K-staging): very wide dims stay on the XLA tiling
+        use_elt_kernel = pallas_enabled() and x.shape[1] <= MAX_DIM
     return _pairwise_jit(x, y, metric, metric_arg, use_elt_kernel)
 
 
@@ -291,32 +227,22 @@ def _pairwise(x, y, metric: DistanceType, metric_arg: float) -> jax.Array:
                                              "use_elt_kernel"))
 def _pairwise_jit(x, y, metric: DistanceType, metric_arg: float,
                   use_elt_kernel: bool) -> jax.Array:
-    if use_elt_kernel:
-        from raft_tpu.ops.pallas_elementwise_dist import (
-            elementwise_dist_pallas)
+    if metric in _ELT_KERNEL:
         tag, sqrt = _ELT_KERNEL[metric]
-        return elementwise_dist_pallas(_f32(x), _f32(y), tag,
-                                       p=metric_arg, sqrt=sqrt)
+        if use_elt_kernel:
+            from raft_tpu.ops.pallas_elementwise_dist import (
+                elementwise_dist_pallas)
+            return elementwise_dist_pallas(_f32(x), _f32(y), tag,
+                                           p=metric_arg, sqrt=sqrt)
+        return _elementwise_xla(x, y, tag, metric_arg, sqrt)
     if metric == DistanceType.L2Expanded:
         return _l2_expanded(x, y, sqrt=False)
     if metric == DistanceType.L2SqrtExpanded:
         return _l2_expanded(x, y, sqrt=True)
     if metric == DistanceType.CosineExpanded:
         return _cosine(x, y)
-    if metric == DistanceType.L1:
-        return _l1(x, y)
-    if metric == DistanceType.L2Unexpanded:
-        return _l2_unexpanded(x, y, sqrt=False)
-    if metric == DistanceType.L2SqrtUnexpanded:
-        return _l2_unexpanded(x, y, sqrt=True)
     if metric == DistanceType.InnerProduct:
         return _inner_product(x, y)
-    if metric == DistanceType.Linf:
-        return _linf(x, y)
-    if metric == DistanceType.Canberra:
-        return _canberra(x, y)
-    if metric == DistanceType.LpUnexpanded:
-        return _minkowski(x, y, metric_arg)
     if metric == DistanceType.CorrelationExpanded:
         return _correlation(x, y)
     if metric == DistanceType.JaccardExpanded:
@@ -325,14 +251,6 @@ def _pairwise_jit(x, y, metric: DistanceType, metric_arg: float,
         return _hellinger(x, y)
     if metric == DistanceType.Haversine:
         return _haversine(x, y)
-    if metric == DistanceType.BrayCurtis:
-        return _braycurtis(x, y)
-    if metric == DistanceType.JensenShannon:
-        return _jensen_shannon(x, y)
-    if metric == DistanceType.HammingUnexpanded:
-        return _hamming(x, y)
-    if metric == DistanceType.KLDivergence:
-        return _kl_divergence(x, y)
     if metric == DistanceType.RusselRaoExpanded:
         return _russellrao(x, y)
     if metric == DistanceType.DiceExpanded:

@@ -74,23 +74,38 @@ def _member_dists(landmarks, data, idx, metric):
 
 
 def knn_query(index: BallCoverIndex, queries, k: int, n_probes: int = 0,
-              res=None) -> Tuple[jax.Array, jax.Array]:
-    """k-NN via ball cover (reference rbc_knn_query). ``n_probes=0`` picks
-    the 2·√n heuristic; pass ``index.n_landmarks`` for exhaustive-exact."""
+              prune: bool = True, res=None) -> Tuple[jax.Array, jax.Array]:
+    """k-NN via ball cover (reference rbc_knn_query).
+
+    Two-pass pruned search (reference ``ball_cover.cuh`` /
+    ``ball_cover/registers.cuh`` triangle-inequality scheme), re-designed
+    for TPU: balls are ranked by the lower bound ``d(q, L) - radius_L``
+    and scanned rank-by-rank in a ``lax.while_loop`` that terminates as
+    soon as **every** query's next ball is excluded by
+    ``lower_bound > kth_best`` — the same per-query prune as the
+    reference's pass 2, batched over the query set. With
+    ``n_probes = n_landmarks`` (the default here) results are exact, yet
+    typically only a few balls are scanned.
+
+    ``n_probes`` caps the scan depth (``0`` → all landmarks when pruning,
+    else the 2·√n heuristic); ``prune=False`` restores the fixed-budget
+    scan.
+    """
     q = as_array(queries).astype(jnp.float32)
     nq = q.shape[0]
     n_l = index.n_landmarks
     if n_probes <= 0:
-        n_probes = min(n_l, max(1, 2 * int(math.isqrt(n_l)) + 1))
+        n_probes = n_l if prune else min(n_l, max(1, 2 * int(math.isqrt(n_l)) + 1))
+    n_probes = min(n_probes, n_l)
     metric = index.metric
 
     # rank balls by triangle-inequality lower bound
     d_ql = _pairwise(q, index.landmarks, metric, 2.0)     # (nq, n_l)
     lower = jnp.maximum(d_ql - index.radii[None, :], 0.0)
-    _, order = lax.top_k(-lower, n_probes)                # (nq, n_probes)
+    neg_lb, order = lax.top_k(-lower, n_probes)           # (nq, n_probes)
+    lb_ordered = -neg_lb                                  # ascending bounds
 
-    def probe_step(carry, p):
-        best_d, best_i = carry
+    def probe_step(p, best_d, best_i):
         ball = order[:, p]
         vecs = index.lists_data[ball]                      # (nq, max_list, dim)
         ids = index.lists_indices[ball]
@@ -100,11 +115,30 @@ def knn_query(index: BallCoverIndex, queries, k: int, n_probes: int = 0,
         cat_d = jnp.concatenate([best_d, d], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
         nd, sel = lax.top_k(-cat_d, k)
-        return (-nd, jnp.take_along_axis(cat_i, sel, axis=1)), None
+        return -nd, jnp.take_along_axis(cat_i, sel, axis=1)
 
-    init = (jnp.full((nq, k), jnp.inf, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
-    (d, i), _ = lax.scan(probe_step, init, jnp.arange(n_probes))
+    init_d = jnp.full((nq, k), jnp.inf, jnp.float32)
+    init_i = jnp.full((nq, k), -1, jnp.int32)
+
+    if not prune:
+        def scan_body(carry, p):
+            return probe_step(p, *carry), None
+        (d, i), _ = lax.scan(scan_body, (init_d, init_i),
+                             jnp.arange(n_probes))
+        return d, i
+
+    def cond(state):
+        p, best_d, _ = state
+        # any query whose next-ranked ball could still hold a closer point
+        live = lb_ordered[:, jnp.minimum(p, n_probes - 1)] < best_d[:, k - 1]
+        return (p < n_probes) & jnp.any(live)
+
+    def body(state):
+        p, best_d, best_i = state
+        best_d, best_i = probe_step(p, best_d, best_i)
+        return p + 1, best_d, best_i
+
+    _, d, i = lax.while_loop(cond, body, (jnp.int32(0), init_d, init_i))
     return d, i
 
 

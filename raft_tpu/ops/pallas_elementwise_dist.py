@@ -27,53 +27,18 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.ops.dispatch import pallas_interpret
 from raft_tpu.ops._util import (VMEM_LIMIT as _VMEM_LIMIT,
                                 round_up as _round_up)
+# single source of truth for the per-metric cores — shared with the XLA
+# tier and the wide sparse path (distance/_elementwise_cores.py)
+from raft_tpu.distance._elementwise_cores import (
+    MAX_REDUCE as _MAX_REDUCE,
+    combine as _combine,
+    finalize as _finalize,
+)
 
-# metrics whose reduction is max instead of sum
-_MAX_REDUCE = ("linf",)
-
-
-def _combine(metric: str, a, b, p: float):
-    if metric in ("l1", "linf"):
-        return jnp.abs(a - b)
-    if metric == "l2unexp":
-        d = a - b
-        return d * d
-    if metric == "canberra":
-        num = jnp.abs(a - b)
-        den = jnp.abs(a) + jnp.abs(b)
-        return jnp.where(den == 0.0, 0.0,
-                         num / jnp.where(den == 0.0, 1.0, den))
-    if metric == "minkowski":
-        return jnp.abs(a - b) ** p
-    if metric == "hamming":
-        return (a != b).astype(jnp.float32)
-    if metric == "jensen_shannon":
-        m = 0.5 * (a + b)
-        safe_m = jnp.where(m > 0.0, m, 1.0)
-        ta = jnp.where(a > 0.0,
-                       a * jnp.log(jnp.where(a > 0.0, a, 1.0) / safe_m),
-                       0.0)
-        tb = jnp.where(b > 0.0,
-                       b * jnp.log(jnp.where(b > 0.0, b, 1.0) / safe_m),
-                       0.0)
-        return ta + tb
-    if metric == "kl":
-        num = jnp.where(a > 0.0, a, 1.0)
-        den = jnp.where(b > 0.0, b, 1.0)
-        return jnp.where(a > 0.0, a * jnp.log(num / den), 0.0)
-    raise ValueError(f"elementwise kernel: unknown metric {metric!r}")
-
-
-def _finalize(metric: str, d, p: float, dim: int, sqrt: bool):
-    if metric == "l2unexp" and sqrt:
-        return jnp.sqrt(jnp.maximum(d, 0.0))
-    if metric == "minkowski":
-        return d ** (1.0 / p)
-    if metric == "hamming":
-        return d / float(dim)
-    if metric == "jensen_shannon":
-        return jnp.sqrt(jnp.maximum(0.5 * d, 0.0))
-    return d
+# operand blocks are (tm+tn, dp) f32, double-buffered; beyond this
+# feature dim the caller must fall back to the XLA tiling (the kernel
+# has no K-staging) — see MAX_DIM users in distance/pairwise.py
+MAX_DIM = 16384
 
 
 def _elt_kernel(x_ref, y_ref, od_ref, *, tm: int, metric: str, p: float,

@@ -2,35 +2,233 @@
 
 Reference: ``raft/sparse/distance/distance.cuh:68-81`` — all dense metric
 families over CSR inputs via a load-balanced generalized COO SpMV with
-smem strategies (``detail/coo_spmv.cuh``), expanded metrics via sparse
-inner products.
+two smem strategies (``detail/coo_spmv.cuh:48-192``): a dense-smem
+strategy for narrow feature dims and a **hash-table strategy for wide
+rows** (``detail/coo_spmv_strategies/hash_strategy.cuh``) so 100k-dim
+sparse features never materialize densely.
 
-TPU design: the CUDA strategies exist to keep irregular per-row work
-balanced across warps. On TPU the winning move is the opposite —
-**densify row tiles and ride the MXU**: a (tile, k) dense block gathered
-from CSR costs one scatter per tile and turns every metric into the
-already-optimized dense kernel from ``raft_tpu.distance.pairwise``. For
-the feature dims RAFT targets (≤ a few thousand) this is strictly faster
-than any gather-based sparse walk on TPU; the tile size bounds peak
-memory exactly like the reference's batched smem staging.
+TPU design — two tiers, split by feature width:
+
+* **Narrow tier** (``k`` small enough that a dense (rows, k) block fits
+  the scratch budget): densify row tiles and ride the MXU — a (tile, k)
+  dense block gathered from CSR costs one scatter per tile and turns
+  every metric into the already-optimized dense kernel from
+  ``raft_tpu.distance.pairwise``.
+
+* **Wide tier** (the hash-strategy slot): never densify the full feature
+  dim. Both operands are scattered **one column tile at a time**
+  (``lax.fori_loop`` over ``ceil(k / tile)`` tiles, O(nnz) scatter-drop
+  per tile) and per-tile partial results accumulate:
+
+  - MXU family (L2/cosine/correlation/IP/Hellinger/Jaccard/...):
+    ``ip += Xt @ Ytᵀ`` per tile; the rank-1 row statistics the epilogues
+    need (norms, sums, nonzero counts) come straight from the CSR values
+    via ``segment_sum`` — no densification at all.
+  - Elementwise family (L1/Linf/Canberra/JS/KL/...): per-tile
+    ``reduce_k(combine(x, y))`` partials combined with ``+`` (or ``max``
+    for Linf), final op applied once at the end. Every combine maps
+    (0, 0) → 0, so explicit zeros inside a tile are exact.
+
+  Peak memory is O(nnz + m·n + tiles) — nnz-bounded in the feature dim,
+  which is precisely what the reference's hash strategy buys.
 """
 
 from __future__ import annotations
 
+import functools
+from typing import Callable, NamedTuple, Optional
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
+from raft_tpu.core.precision import matmul_precision
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import distance as dense_distance
 from raft_tpu.sparse.csr import CSR
 
 # peak densified scratch, in f32 elements (matches pairwise's budget scale)
 _TILE_BUDGET_ELEMS = 1 << 23
+# column-tile width for the wide tier; multiple of the 128-lane register
+_WIDE_COL_TILE = 2048
 
 
 def _densify(csr: CSR) -> jax.Array:
     return csr.todense().astype(jnp.float32)
 
+
+# ---------------------------------------------------------------------------
+# Wide tier: column-tiled accumulation (the hash-strategy slot)
+# ---------------------------------------------------------------------------
+
+class _CsrF32(NamedTuple):
+    """CSR unpacked for tile scatters: per-nnz (row, col, val) in f32."""
+    rows: jax.Array
+    cols: jax.Array
+    vals: jax.Array
+    n_rows: int
+
+
+def _unpack(csr: CSR) -> _CsrF32:
+    return _CsrF32(csr.row_ids(), csr.indices.astype(jnp.int32),
+                   csr.data.astype(jnp.float32), csr.shape[0])
+
+
+def _tile_of(c: _CsrF32, start, width: int, transform=None) -> jax.Array:
+    """Dense (n_rows, width) block of columns [start, start+width): one
+    O(nnz) scatter; out-of-tile nonzeros are routed to column ``width``
+    (always out of bounds, dropped) — a plain ``cols - start`` would let
+    JAX wrap negative indices back into the tile."""
+    vals = c.vals if transform is None else transform(c.vals)
+    in_tile = (c.cols >= start) & (c.cols < start + width)
+    local = jnp.where(in_tile, c.cols - start, width)
+    out = jnp.zeros((c.n_rows, width), jnp.float32)
+    return out.at[c.rows, local].add(vals, mode="drop")
+
+
+def _row_stat(c: _CsrF32, fn) -> jax.Array:
+    """O(nnz) per-row statistic straight off the CSR values."""
+    return jax.ops.segment_sum(fn(c.vals), c.rows, num_segments=c.n_rows)
+
+
+def _accumulate_ip(x: _CsrF32, y: _CsrF32, k: int, tile: int,
+                   transform=None) -> jax.Array:
+    """Σ_tiles Xt @ Ytᵀ with fp32 accumulation; never holds more than one
+    (rows, tile) dense block per operand."""
+    n_tiles = -(-k // tile)
+
+    def body(i, acc):
+        start = i * tile
+        xt = _tile_of(x, start, tile, transform)
+        yt = _tile_of(y, start, tile, transform)
+        return acc + lax.dot_general(
+            xt, yt, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=matmul_precision())
+
+    init = jnp.zeros((x.n_rows, y.n_rows), jnp.float32)
+    return lax.fori_loop(0, n_tiles, body, init)
+
+
+def _accumulate_elt(x: _CsrF32, y: _CsrF32, k: int, tile: int,
+                    combine: Callable, reduce_fn, n_acc: int = 1):
+    """Accumulate reduce_k(combine(xt, yt)) across column tiles.
+    ``reduce_fn`` is ``jnp.add`` (sum metrics) or ``jnp.maximum`` (Linf);
+    it serves as both the within-tile k-reduction and the cross-tile
+    combiner, which is exact because both are associative+commutative.
+    ``combine`` may return a tuple of ``n_acc`` arrays (BrayCurtis needs
+    two sums)."""
+    n_tiles = -(-k // tile)
+    inner = jnp.max if reduce_fn is jnp.maximum else jnp.sum
+
+    def body(i, accs):
+        start = i * tile
+        xt = _tile_of(x, start, tile)
+        yt = _tile_of(y, start, tile)
+        parts = combine(xt[:, None, :], yt[None, :, :])
+        if n_acc == 1:
+            parts = (parts,)
+        return tuple(reduce_fn(a, inner(p, axis=2))
+                     for a, p in zip(accs, parts))
+
+    init = tuple(jnp.zeros((x.n_rows, y.n_rows), jnp.float32)
+                 for _ in range(n_acc))
+    out = lax.fori_loop(0, n_tiles, body, init)
+    return out[0] if n_acc == 1 else out
+
+
+_EPS_DIV = lambda d: jnp.where(d == 0.0, 1.0, d)
+
+
+def _wide_mxu(x: _CsrF32, y: _CsrF32, k: int, tile: int,
+              metric: DistanceType) -> jax.Array:
+    if metric in (DistanceType.JaccardExpanded, DistanceType.DiceExpanded):
+        ind = lambda v: (v != 0).astype(jnp.float32)
+        inter = _accumulate_ip(x, y, k, tile, transform=ind)
+        nx = _row_stat(x, ind)
+        ny = _row_stat(y, ind)
+        if metric == DistanceType.JaccardExpanded:
+            union = nx[:, None] + ny[None, :] - inter
+            return 1.0 - inter / _EPS_DIV(union)
+        denom = nx[:, None] + ny[None, :]
+        return 1.0 - 2.0 * inter / _EPS_DIV(denom)
+
+    if metric == DistanceType.HellingerExpanded:
+        ip = _accumulate_ip(x, y, k, tile,
+                            transform=lambda v: jnp.sqrt(jnp.abs(v)))
+        return jnp.sqrt(jnp.maximum(1.0 - jnp.minimum(ip, 1.0), 0.0))
+
+    ip = _accumulate_ip(x, y, k, tile)
+    if metric == DistanceType.InnerProduct:
+        return ip
+    if metric == DistanceType.RusselRaoExpanded:
+        return (k - ip) / float(k)
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        xx = _row_stat(x, lambda v: v * v)
+        yy = _row_stat(y, lambda v: v * v)
+        d = jnp.maximum(xx[:, None] + yy[None, :] - 2.0 * ip, 0.0)
+        return jnp.sqrt(d) if metric == DistanceType.L2SqrtExpanded else d
+    if metric == DistanceType.CosineExpanded:
+        xn = jnp.sqrt(_row_stat(x, lambda v: v * v))
+        yn = jnp.sqrt(_row_stat(y, lambda v: v * v))
+        return 1.0 - ip / _EPS_DIV(xn[:, None] * yn[None, :])
+    if metric == DistanceType.CorrelationExpanded:
+        sx, sy = _row_stat(x, lambda v: v), _row_stat(y, lambda v: v)
+        x2, y2 = _row_stat(x, lambda v: v * v), _row_stat(y, lambda v: v * v)
+        numer = k * ip - sx[:, None] * sy[None, :]
+        dx = jnp.sqrt(jnp.maximum(k * x2 - sx * sx, 0.0))
+        dy = jnp.sqrt(jnp.maximum(k * y2 - sy * sy, 0.0))
+        return 1.0 - numer / _EPS_DIV(dx[:, None] * dy[None, :])
+    raise ValueError(f"wide sparse: unhandled MXU metric {metric}")
+
+
+def _wide_elt(x: _CsrF32, y: _CsrF32, k: int, tile: int,
+              metric: DistanceType, metric_arg: float) -> jax.Array:
+    """Column-tiled accumulation of the shared per-metric cores
+    (``distance/_elementwise_cores.py``): per-tile sums/maxes combine
+    exactly because every reduce is associative and every combine maps
+    (0, 0) → 0."""
+    from raft_tpu.distance import _elementwise_cores as cores
+    from raft_tpu.distance.pairwise import _ELT_KERNEL
+
+    tag, sqrt = _ELT_KERNEL[metric]
+    p = float(metric_arg)
+    pair = tag in cores.PAIR_ACCUM
+    reduce_fn = jnp.maximum if tag in cores.MAX_REDUCE else jnp.add
+    d = _accumulate_elt(x, y, k, tile,
+                        lambda a, b: cores.combine(tag, a, b, p),
+                        reduce_fn, n_acc=2 if pair else 1)
+    return cores.finalize(tag, d, p, k, sqrt)
+
+
+_WIDE_MXU_METRICS = frozenset({
+    DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+    DistanceType.CosineExpanded, DistanceType.CorrelationExpanded,
+    DistanceType.InnerProduct, DistanceType.HellingerExpanded,
+    DistanceType.RusselRaoExpanded, DistanceType.JaccardExpanded,
+    DistanceType.DiceExpanded,
+})
+_WIDE_ELT_METRICS = frozenset({
+    DistanceType.L1, DistanceType.L2Unexpanded,
+    DistanceType.L2SqrtUnexpanded, DistanceType.Linf, DistanceType.Canberra,
+    DistanceType.LpUnexpanded, DistanceType.HammingUnexpanded,
+    DistanceType.JensenShannon, DistanceType.KLDivergence,
+    DistanceType.BrayCurtis,
+})
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "tile", "metric", "metric_arg"))
+def _wide_pairwise(x: CSR, y: CSR, k: int, tile: int, metric: DistanceType,
+                   metric_arg: float) -> jax.Array:
+    xu, yu = _unpack(x), _unpack(y)
+    if metric in _WIDE_MXU_METRICS:
+        return _wide_mxu(xu, yu, k, tile, metric)
+    return _wide_elt(xu, yu, k, tile, metric, metric_arg)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
 
 def pairwise_distance(
     x: CSR,
@@ -38,13 +236,30 @@ def pairwise_distance(
     metric: DistanceType = DistanceType.L2Expanded,
     metric_arg: float = 2.0,
     res=None,
+    col_tile: Optional[int] = None,
 ) -> jax.Array:
-    """All-pairs distance between CSR row sets → dense (m, n) matrix."""
+    """All-pairs distance between CSR row sets → dense (m, n) matrix.
+
+    Narrow feature dims densify row tiles onto the dense kernels; wide
+    dims (or an explicit ``col_tile``) take the column-tiled accumulation
+    path whose memory is bounded by nnz, never by ``m×k``.
+    """
     if x.shape[1] != y.shape[1]:
         raise ValueError("sparse pairwise: feature dim mismatch")
     metric = DistanceType(metric)
     m, k = x.shape
     n = y.shape[0]
+
+    wide_capable = metric in _WIDE_MXU_METRICS or metric in _WIDE_ELT_METRICS
+    force_wide = col_tile is not None
+    # wide when densifying the operands would blow the scratch budget —
+    # the reference's dense-smem vs hash-strategy split
+    auto_wide = (m + n) * k > _TILE_BUDGET_ELEMS and k > _WIDE_COL_TILE
+    if wide_capable and (force_wide or auto_wide):
+        tile = int(col_tile) if col_tile else _WIDE_COL_TILE
+        tile = min(tile, k)
+        return _wide_pairwise(x, y, k, tile, metric, float(metric_arg))
+
     yd = _densify(y)
     tile = max(1, min(m, _TILE_BUDGET_ELEMS // max(1, k)))
     if tile >= m:

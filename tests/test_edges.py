@@ -1,0 +1,170 @@
+"""Edge-shape and dtype-grid tests (VERDICT round 1, weak #10).
+
+The reference's parameterized gtests sweep odd sizes, k at the extremes,
+and input dtypes (SURVEY.md §4); this file is that sweep for the TPU
+build: odd/tiny dims, k == n, single-row operands, empty IVF lists,
+bf16/int8 inputs.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.distance.pairwise import pairwise_distance, distance
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors.selection import select_k
+from raft_tpu.cluster import kmeans
+
+
+def _ref_l2(x, y):
+    return np.sqrt(np.maximum(
+        (x * x).sum(1)[:, None] + (y * y).sum(1)[None, :]
+        - 2.0 * x @ y.T, 0.0))
+
+
+class TestOddShapes:
+    @pytest.mark.parametrize("dim", [1, 3, 257])
+    def test_pairwise_odd_dims(self, rng_np, dim):
+        x = rng_np.random((7, dim)).astype(np.float32)
+        y = rng_np.random((5, dim)).astype(np.float32)
+        got = np.asarray(distance(x, y, DistanceType.L2SqrtExpanded))
+        np.testing.assert_allclose(got, _ref_l2(x, y), rtol=1e-4, atol=1e-4)
+
+    def test_pairwise_single_rows(self, rng_np):
+        x = rng_np.random((1, 16)).astype(np.float32)
+        y = rng_np.random((1, 16)).astype(np.float32)
+        got = np.asarray(distance(x, y, DistanceType.L1))
+        np.testing.assert_allclose(
+            got, np.abs(x - y).sum()[None, None], rtol=1e-5)
+
+    def test_knn_k_equals_n(self, rng_np):
+        x = rng_np.random((9, 8)).astype(np.float32)
+        q = rng_np.random((4, 8)).astype(np.float32)
+        d, i = brute_force.brute_force_knn(x, q, k=9)
+        # every db row appears exactly once per query
+        for row in np.asarray(i):
+            assert sorted(row.tolist()) == list(range(9))
+        ref = _ref_l2(q, x)
+        np.testing.assert_allclose(np.sort(np.asarray(d), axis=1),
+                                   np.sort(ref, axis=1), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_knn_singleton_db_and_query(self, rng_np):
+        x = rng_np.random((1, 5)).astype(np.float32)
+        q = rng_np.random((1, 5)).astype(np.float32)
+        d, i = brute_force.brute_force_knn(x, q, k=1)
+        assert i.shape == (1, 1) and int(i[0, 0]) == 0
+        np.testing.assert_allclose(np.asarray(d)[0, 0],
+                                   np.linalg.norm(x - q), rtol=1e-5)
+
+    def test_select_k_extremes(self, rng_np):
+        v = rng_np.random((3, 17)).astype(np.float32)
+        # k == n_cols: a permutation of the row
+        d, i = select_k(v, k=17)
+        np.testing.assert_allclose(np.asarray(d), np.sort(v, axis=1),
+                                   rtol=1e-6)
+        # k == 1: the argmin
+        d1, i1 = select_k(v, k=1)
+        np.testing.assert_array_equal(np.asarray(i1)[:, 0],
+                                      np.argmin(v, axis=1))
+
+    def test_select_k_with_ties(self):
+        v = np.zeros((2, 8), np.float32)
+        v[:, 4:] = 1.0
+        d, i = select_k(v, k=4)
+        # all four zeros selected, each index once
+        assert np.asarray(d).max() == 0.0
+        for row in np.asarray(i):
+            assert sorted(row.tolist()) == [0, 1, 2, 3]
+
+
+class TestEmptyListsIVF:
+    def test_ivf_flat_with_empty_lists(self, rng_np):
+        # two tight far-apart blobs + n_lists=8 → most lists empty after
+        # balanced training collapses onto the blobs
+        a = rng_np.normal(0, 0.01, (40, 8)).astype(np.float32)
+        b = rng_np.normal(100, 0.01, (40, 8)).astype(np.float32)
+        x = np.concatenate([a, b])
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                     kmeans_n_iters=4))
+        # probing every list (incl. empties) must stay valid and exact
+        q = x[:5] + rng_np.normal(0, 0.005, (5, 8)).astype(np.float32)
+        d, i = ivf_flat.search(idx, q, k=3,
+                               params=ivf_flat.SearchParams(n_probes=8))
+        assert (np.asarray(i) >= 0).all()
+        ref = _ref_l2(q, x)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.argmin(ref, axis=1))
+
+    def test_ivf_pq_with_empty_lists(self, rng_np):
+        a = rng_np.normal(0, 0.01, (130, 8)).astype(np.float32)
+        b = rng_np.normal(50, 0.01, (130, 8)).astype(np.float32)
+        x = np.concatenate([a, b])
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=8, pq_dim=4, pq_bits=8, kmeans_n_iters=4))
+        q = x[:4]
+        d, i = ivf_pq.search(idx, q, k=2,
+                             params=ivf_pq.SearchParams(n_probes=8))
+        assert (np.asarray(i) >= 0).all()
+        # blob membership must be right even under PQ quantization
+        assert (np.asarray(i)[:4, 0] < 130).all()
+
+
+class TestDtypeGrid:
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_pairwise_narrow_float(self, rng_np, dtype):
+        x = rng_np.random((12, 32)).astype(np.float32)
+        y = rng_np.random((9, 32)).astype(np.float32)
+        got = np.asarray(distance(jnp.asarray(x, dtype), jnp.asarray(y, dtype),
+                                  DistanceType.L2SqrtExpanded),
+                         dtype=np.float32)
+        np.testing.assert_allclose(got, _ref_l2(x, y), rtol=3e-2, atol=3e-2)
+
+    def test_knn_int8_inputs(self, rng_np):
+        x8 = rng_np.integers(-100, 100, (50, 16)).astype(np.int8)
+        q8 = x8[:6]
+        d, i = brute_force.brute_force_knn(x8, q8, k=1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(6))
+
+    def test_ivf_flat_storage_dtypes(self, rng_np):
+        x = rng_np.random((600, 16)).astype(np.float32)
+        q = x[:8]
+        exact = _ref_l2(q, x)
+        for storage in ("float32", "bfloat16", "int8"):
+            idx = ivf_flat.build(
+                x, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4,
+                                        storage_dtype=storage))
+            d, i = ivf_flat.search(idx, q, k=1,
+                                   params=ivf_flat.SearchParams(n_probes=8))
+            np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                          np.argmin(exact, axis=1))
+
+
+class TestKmeansExtremes:
+    def test_k_equals_one(self, rng_np):
+        x = rng_np.random((50, 4)).astype(np.float32)
+        centroids, inertia, _ = kmeans.fit(
+            x, kmeans.KMeansParams(n_clusters=1, max_iter=4))
+        np.testing.assert_allclose(np.asarray(centroids)[0], x.mean(0),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_k_equals_n(self, rng_np):
+        x = (10.0 * rng_np.random((12, 4))).astype(np.float32)
+        _, inertia, _ = kmeans.fit(
+            x, kmeans.KMeansParams(n_clusters=12, max_iter=8, n_init=4))
+        # every point its own cluster: inertia ~ 0
+        assert float(inertia) < 1e-3
+
+
+class TestMergePartsEdge:
+    def test_merge_with_all_padded_part(self):
+        d0 = np.array([[0.1, 0.2, 0.3]], np.float32)
+        i0 = np.array([[4, 5, 6]], np.int32)
+        d1 = np.full((1, 3), np.inf, np.float32)
+        i1 = np.full((1, 3), -1, np.int32)
+        d, i = brute_force.knn_merge_parts(
+            jnp.stack([jnp.asarray(d0), jnp.asarray(d1)]),
+            jnp.stack([jnp.asarray(i0), jnp.asarray(i1)]), k=3)
+        np.testing.assert_array_equal(np.asarray(i)[0], [4, 5, 6])
+        np.testing.assert_allclose(np.asarray(d)[0], d0[0], rtol=1e-6)

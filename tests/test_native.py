@@ -139,3 +139,77 @@ class TestBoruvkaNative:
         s, d, wts, comp = boruvka_mst_edges(5, src, dst, w)
         assert len(s) == 3
         assert len(np.unique(comp)) == 2
+
+
+class TestNativeKVBroker:
+    """C++ TCP tagged-KV broker (the ucp_helper/UCX role,
+    _cpp/raft_tpu_host.cpp rth_kv_*)."""
+
+    @pytest.fixture()
+    def broker(self):
+        from raft_tpu.comms.native_p2p import NativeKVServer
+        with NativeKVServer() as s:
+            yield s
+
+    def test_put_get_timeout_overwrite(self, broker):
+        p = broker.port
+        assert native.kv_put("127.0.0.1", p, "a", b"v1")
+        assert native.kv_get("127.0.0.1", p, "a", 500) == b"v1"
+        # consumed: second read times out
+        assert native.kv_get("127.0.0.1", p, "a", 50) is None
+        # overwrite + non-consuming peek
+        native.kv_put("127.0.0.1", p, "hb", b"1")
+        native.kv_put("127.0.0.1", p, "hb", b"2")
+        assert native.kv_get("127.0.0.1", p, "hb", 50, consume=False) == b"2"
+        assert native.kv_get("127.0.0.1", p, "hb", 50, consume=False) == b"2"
+
+    def test_blocking_get_sees_later_put(self, broker):
+        import threading
+        p = broker.port
+        out = {}
+
+        def getter():
+            out["v"] = native.kv_get("127.0.0.1", p, "late", 3000)
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time_mod = __import__("time"); time_mod.sleep(0.15)
+        native.kv_put("127.0.0.1", p, "late", b"arrived")
+        t.join(5)
+        assert out["v"] == b"arrived"
+
+    def test_host_p2p_over_native_transport(self, broker):
+        from raft_tpu.comms import HostP2P, NativeKVClient, Status
+        cl = NativeKVClient("127.0.0.1", broker.port)
+        a = HostP2P(0, 2, session="native-t", client=cl)
+        b = HostP2P(1, 2, session="native-t", client=cl)
+        a.isend(b"payload-x", dest=1, tag=3)
+        req = b.irecv(source=0, tag=3)
+        assert req.wait(5.0) == Status.SUCCESS
+        assert req.payload == b"payload-x"
+        # ordering by seq for same (src, dst, tag)
+        a.isend(b"m0", dest=1, tag=0)
+        a.isend(b"m1", dest=1, tag=0)
+        r0, r1 = b.irecv(0, 0), b.irecv(0, 0)
+        assert b.waitall([r0, r1], timeout_s=5.0) == Status.SUCCESS
+        assert (r0.payload, r1.payload) == (b"m0", b"m1")
+        # missing message -> ABORT, not hang
+        dead = b.irecv(source=0, tag=9)
+        assert dead.wait(0.1) == Status.ABORT
+
+    def test_health_monitor_over_native_transport(self, broker):
+        import time as _t
+        from raft_tpu.comms import HealthMonitor, NativeKVClient
+        cl = NativeKVClient("127.0.0.1", broker.port)
+        m0 = HealthMonitor(0, 2, session="native-h", interval_s=0.05,
+                           stale_after_s=0.3, client=cl).start()
+        m1 = HealthMonitor(1, 2, session="native-h", interval_s=0.05,
+                           stale_after_s=0.3, client=cl).start()
+        try:
+            _t.sleep(0.15)
+            assert m0.suspect_ranks() == []
+            m1.stop()
+            _t.sleep(0.5)
+            assert m0.suspect_ranks() == [1]
+        finally:
+            m0.stop(); m1.stop()

@@ -248,6 +248,26 @@ class TestBallCover:
         _, iref = nn.kneighbors(q)
         assert recall(np.asarray(i), iref) > 0.999
 
+    def test_pruned_default_is_exact(self, dataset):
+        # the while-loop prune (reference 2-pass, registers.cuh role) must
+        # terminate early yet return the exact k-NN set
+        x, q = dataset
+        index = ball_cover.build(x)
+        d, i = ball_cover.knn_query(index, q, 10)  # prune=True default
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        dref, iref = nn.kneighbors(q)
+        assert recall(np.asarray(i), iref) > 0.999
+        np.testing.assert_allclose(np.asarray(d), dref, rtol=1e-3, atol=1e-3)
+
+    def test_prune_matches_fixed_budget(self, dataset):
+        x, q = dataset
+        index = ball_cover.build(x, n_landmarks=16)
+        d_p, i_p = ball_cover.knn_query(index, q, 5, prune=True)
+        d_f, i_f = ball_cover.knn_query(index, q, 5, n_probes=16,
+                                        prune=False)
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_f),
+                                   rtol=1e-5, atol=1e-5)
+
 
 class TestSerialize:
     """Index save/load round-trip (raft_tpu/neighbors/serialize.py — the

@@ -153,6 +153,66 @@ class TestSparseDistance:
         expect = np.asarray(dense_distance(x, y, metric))
         np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
 
+    # wide tier (reference hash_strategy.cuh role): column-tiled path,
+    # forced via col_tile so the k-loop really runs multiple tiles
+    @pytest.mark.parametrize(
+        "metric",
+        [
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.CosineExpanded,
+            DistanceType.CorrelationExpanded,
+            DistanceType.InnerProduct,
+            DistanceType.HellingerExpanded,
+            DistanceType.JaccardExpanded,
+            DistanceType.DiceExpanded,
+            DistanceType.L1,
+            DistanceType.L2Unexpanded,
+            DistanceType.Linf,
+            DistanceType.Canberra,
+            DistanceType.LpUnexpanded,
+            DistanceType.HammingUnexpanded,
+            DistanceType.JensenShannon,
+            DistanceType.KLDivergence,
+            DistanceType.BrayCurtis,
+        ],
+    )
+    def test_wide_tier_vs_dense(self, rng_np, metric):
+        k = 257  # odd, not a tile multiple: exercises the ragged last tile
+        x = _random_sparse(rng_np, 19, k, density=0.1)
+        y = _random_sparse(rng_np, 13, k, density=0.1)
+        if metric in (DistanceType.HellingerExpanded,
+                      DistanceType.JensenShannon, DistanceType.KLDivergence):
+            # distribution-valued metrics: rows must be prob vectors
+            x = x / np.maximum(x.sum(1, keepdims=True), 1e-6)
+            y = y / np.maximum(y.sum(1, keepdims=True), 1e-6)
+        cx, cy = sp.dense_to_csr(x), sp.dense_to_csr(y)
+        got = np.asarray(sp.pairwise_distance(cx, cy, metric, col_tile=64))
+        expect = np.asarray(dense_distance(x, y, metric))
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+    def test_wide_100k_dim_vs_scipy(self, rng_np):
+        # the reference's own use case for the hash strategy: very wide
+        # sparse features, nnz-bounded memory (never densifies m×k)
+        from scipy.spatial.distance import cdist
+
+        m, n, k, nnz = 24, 17, 100_000, 40
+        def make(rows):
+            d = np.zeros((rows, k), np.float32)
+            for i in range(rows):
+                cols = rng_np.choice(k, size=nnz, replace=False)
+                d[i, cols] = rng_np.random(nnz).astype(np.float32)
+            return d
+        x, y = make(m), make(n)
+        cx, cy = sp.dense_to_csr(x), sp.dense_to_csr(y)
+        got = np.asarray(sp.pairwise_distance(
+            cx, cy, DistanceType.L2SqrtExpanded, col_tile=4096))
+        np.testing.assert_allclose(got, cdist(x, y), rtol=1e-3, atol=1e-4)
+        got_cos = np.asarray(sp.pairwise_distance(
+            cx, cy, DistanceType.CosineExpanded, col_tile=4096))
+        np.testing.assert_allclose(got_cos, cdist(x, y, "cosine"),
+                                   rtol=1e-3, atol=1e-4)
+
 
 class TestSparseNeighbors:
     def test_brute_force_knn(self, rng_np):
