@@ -360,16 +360,20 @@ struct KvServer {
         break;
       }
     }
-    ::close(fd);
     {
-      // final touch of member state: decrement + notify under the lock,
-      // so shutdown_server() cannot pass its wait until we released it
+      // deregister BEFORE close: once closed, the fd number can be
+      // reused by an unrelated descriptor, and a concurrent shutdown
+      // sweep must never shutdown() a stale conn_fds entry. This is
+      // also the final touch of member state: decrement + notify under
+      // the lock, so shutdown_server() cannot pass its wait (and free
+      // the object) until we released it.
       std::lock_guard<std::mutex> lk(conn_mu);
       conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
                      conn_fds.end());
       --active_conns;
       conn_cv.notify_all();
     }
+    ::close(fd);
   }
 
   int start(int want_port) {

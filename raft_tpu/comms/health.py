@@ -33,6 +33,10 @@ from typing import Dict, List, Optional, Tuple
 from raft_tpu.core.logger import logger as _log
 from raft_tpu.comms.host_p2p import _coordination_client
 
+# sequence-key fallback: heartbeat keys at multiples of this survive
+# retirement forever, so lagging readers always have a resync point
+_CHECKPOINT = 256
+
 
 class _InProcessBoard:
     """Heartbeat board for ranks in one process (test cliques). Keyed by
@@ -117,12 +121,15 @@ class HealthMonitor:
                         self._overwrite_ok = False
                 self._client.key_value_set(
                     self._key(self.rank, self._seq), str(self._seq))
-                # bound the KV footprint: retire a key peers have long
-                # advanced past (best-effort; not every transport can)
-                if self._seq > 8:
+                # bound the KV footprint: retire old keys, but keep every
+                # multiple of _CHECKPOINT forever so a reader arbitrarily
+                # far behind can always resync by probing checkpoint
+                # multiples (best-effort; not every transport can delete)
+                r = self._seq - 1024
+                if r >= 1 and r % _CHECKPOINT != 0:
                     try:
                         self._client.key_value_delete(
-                            self._key(self.rank, self._seq - 8))
+                            self._key(self.rank, r))
                     except Exception:
                         pass
             except Exception:
@@ -179,12 +186,22 @@ class HealthMonitor:
                 return int(v)
             except ValueError:
                 return None
-        # sequence-key fallback: catch up from the last probed seq
+        # sequence-key fallback: catch up from the last probed seq, and
+        # when the sequential probe misses (keys below seq-1024 are
+        # retired), resync via the permanent _CHECKPOINT multiples — a
+        # reader arbitrarily far behind advances ≥ _CHECKPOINT per hit
         nxt = self._peer_next_seq.get(rank, 1)
         seen = nxt - 1 if nxt > 1 else None
-        while self._try_get(self._key(rank, nxt)) is not None:
-            seen = nxt
-            nxt += 1
+        for _ in range(64):  # bound probes per refresh; resumes next call
+            if self._try_get(self._key(rank, nxt)) is not None:
+                seen = nxt
+                nxt += 1
+                continue
+            cp = ((nxt // _CHECKPOINT) + 1) * _CHECKPOINT
+            if self._try_get(self._key(rank, cp)) is None:
+                break
+            seen = cp
+            nxt = cp + 1
         self._peer_next_seq[rank] = nxt
         return seen
 

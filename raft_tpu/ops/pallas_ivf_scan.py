@@ -187,9 +187,13 @@ class _Layout:
                        constant_values=-1)
 
     def merge(self, cd, ci, probes, k: int, sqrt: bool):
-        from raft_tpu.neighbors._ivf_scan import merge_candidates
         cd = jnp.swapaxes(cd, 1, 2)                # (n_lists, cap, B)
         ci = jnp.swapaxes(ci, 1, 2)
+        return self.merge_cap_major(cd, ci, probes, k, sqrt)
+
+    def merge_cap_major(self, cd, ci, probes, k: int, sqrt: bool):
+        """Merge candidate blocks already in (n_lists, cap, B) layout."""
+        from raft_tpu.neighbors._ivf_scan import merge_candidates
         return merge_candidates(
             cd[:, :self.cap].astype(jnp.float32), ci[:, :self.cap],
             probes, self.inv_pos, k, sqrt, use_pallas_select=True)
@@ -226,13 +230,111 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     return lay.merge(cd, ci, probes, k, sqrt)
 
 
-def _pq_chunk(n_lists: int, max_list: int, rot_dim: int, itemsize: int,
-              budget_bytes: int = 32 << 20) -> int:
-    """Lists per decode chunk: the transient decode tile
-    (chunk·max_list·rot_dim·itemsize) stays under ``budget_bytes``."""
-    from raft_tpu.neighbors._ivf_scan import largest_divisor_at_most
-    want = max(1, budget_bytes // max(1, max_list * rot_dim * itemsize))
-    return largest_divisor_at_most(n_lists, want)
+def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
+                    cd_ref, ci_ref, *, bins: int, metric: str, pq_dim: int,
+                    pq_len: int, n_codes: int, lut_dtype):
+    """One IVF list per grid cell, scored straight from its u8 codes.
+
+    Decode is one-hot × codebook on the MXU, **lanes-major over list
+    rows**: per subquantizer, ``oh = (iota == codes_s)`` is a
+    (n_codes, ML) mask and ``books_sᵀ @ oh`` a (pq_len, ML) decode strip
+    — the wide axis (ML) rides the lanes, so the narrow pq_len only pads
+    sublanes. The strips concatenate into the transient decode tile
+    dec_t (rot_dim, ML) that lives and dies in VMEM (the reference's
+    smem-LUT property, ivf_pq_search.cuh:593), and ONE K=rot_dim matmul
+    scores all probing queries against it.
+    """
+    q = qsub_ref[0]                                      # (cap, rot_dim)
+    # codes arrive as i8 bitcast of the u8 store (1 B/code of HBM
+    # traffic); recover 0..255 with a mask after widening
+    codes = codes_ref[0].astype(jnp.int32) & 0xFF        # (ML, pq_dim)
+    ml = codes.shape[0]
+    cap = q.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n_codes, ml), 0)
+    # bf16 LUT = single MXU pass (the reference's fp16-LUT speed tier);
+    # f32 LUT = HIGHEST-precision passes (its fp32 accuracy tier)
+    f32_lut = jnp.dtype(lut_dtype) == jnp.dtype(jnp.float32)
+    operand = jnp.float32 if f32_lut else jnp.bfloat16
+    prec = jax.lax.Precision.HIGHEST if f32_lut else None
+
+    strips = []
+    for s in range(pq_dim):
+        oh = (iota == codes[:, s][None, :]).astype(operand)  # (C, ML)
+        strips.append(jax.lax.dot_general(
+            books_ref[s].astype(operand), oh,
+            (((0,), (0,)), ((), ())), precision=prec,
+            preferred_element_type=jnp.float32))         # (pq_len, ML)
+    dec_t = jnp.concatenate(strips, axis=0)              # (rot_dim, ML)
+
+    ip = jax.lax.dot_general(
+        q.astype(operand), dec_t.astype(operand),
+        (((1,), (0,)), ((), ())), precision=prec,
+        preferred_element_type=jnp.float32)              # (cap, ML)
+    ids = ids_ref[0]                                     # (ML,)
+    ids_b = jnp.broadcast_to(ids[None, :], (cap, ml))
+    if metric == "ip":
+        d = jnp.where(ids_b >= 0, -ip, jnp.inf)
+    else:
+        rr = jnp.sum(q * q, axis=1)[:, None]             # (cap, 1)
+        d = rr + norms_ref[0][None, :] - 2.0 * ip
+        d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
+
+    # strided bins along the row axis (row r → bin r % B), row-major
+    # reshape (cap, w, B): element [., i, b] = row i·B + b
+    w = ml // bins
+    db_ = d.reshape(cap, w, bins)
+    cd = jnp.min(db_, axis=1)                            # (cap, B)
+    rb = ids_b.reshape(cap, w, bins)
+    ci = jnp.min(jnp.where(db_ == cd[:, None, :], rb, _BIG_I32), axis=1)
+    ci = jnp.where(ci == _BIG_I32, -1, ci)
+    cd_ref[0] = cd.astype(cd_ref.dtype)
+    ci_ref[0] = ci
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "metric", "out_dtype",
+                                             "lut_dtype", "interpret",
+                                             "split"))
+def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
+                  interpret: bool, metric: str, lut_dtype,
+                  out_dtype=jnp.float32, split: int = 1):
+    """``split`` > 1: codes/norms/ids carry ``split`` sub-lists per
+    original list (leading dim n_lists·split); the query blocks stay
+    per-ORIGINAL-list and are shared across a list's sub-cells via the
+    index map — no duplicated HBM."""
+    n_lists, cap, rot_dim = qsub.shape
+    n_cells, max_list = codes.shape[:2]
+    pq_dim, n_codes, pq_len = books.shape
+    kern = functools.partial(
+        _pq_scan_kernel, bins=bins, metric=metric, pq_dim=pq_dim,
+        pq_len=pq_len, n_codes=n_codes,
+        lut_dtype=jnp.dtype(lut_dtype))
+    cd, ci = pl.pallas_call(
+        kern,
+        grid=(n_cells,),
+        in_specs=[pl.BlockSpec((1, cap, rot_dim),
+                               lambda g: (g // split, 0, 0)),
+                  pl.BlockSpec((1, max_list, pq_dim), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((1, max_list), lambda g: (g, 0)),
+                  pl.BlockSpec((1, max_list), lambda g: (g, 0)),
+                  pl.BlockSpec((pq_dim, n_codes, pq_len),
+                               lambda g: (0, 0, 0))],
+        out_specs=[pl.BlockSpec((1, cap, bins), lambda g: (g, 0, 0)),
+                   pl.BlockSpec((1, cap, bins), lambda g: (g, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_cells, cap, bins), out_dtype),
+                   jax.ShapeDtypeStruct((n_cells, cap, bins), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_cells * max_list * cap * rot_dim
+            + 2 * n_cells * max_list * n_codes * rot_dim,
+            bytes_accessed=(n_cells * max_list * pq_dim
+                            + 4 * n_lists * cap * rot_dim
+                            + 8 * n_cells * cap * bins),
+            transcendentals=0),
+        interpret=interpret,
+    )(qsub, jax.lax.bitcast_convert_type(codes, jnp.int8), norms, ids,
+      books)
+    return cd, ci
 
 
 def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
@@ -245,19 +347,21 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
 
     Reference ``ivf_pq_search.cuh:593`` scans the bit-packed
     ``pq_dataset`` against a smem LUT. Per-lane LUT gathers are hostile
-    to the TPU vector unit, so the TPU formulation decodes each chunk of
-    lists on the fly — codes (u8, pq_dim B/vector) are the only
-    persistent payload; the decoded (chunk, max_list, rot_dim) tile is
-    transient (the "on-the-fly decode tile that never persists") and
-    feeds the same fused list-scan kernel as IVF-Flat, with each list's
-    probing queries pre-offset by its rotated center so the kernel
-    scores ``||(q_rot − c_l) − decoded||²``.
+    to the TPU (XLA lowers them to the scalar core), so the TPU
+    formulation decodes **inside the kernel** with one-hot × codebook
+    MXU matmuls (``_pq_scan_kernel``): the u8 codes (pq_dim B/vector)
+    are the only persistent payload; the (rot_dim, max_list) decode tile
+    lives and dies in VMEM — the "on-the-fly decode tile that never
+    persists". For L2 each list's probing queries are pre-offset by its
+    rotated center so the kernel scores ``||(q_rot − c_l) − decoded||²``;
+    IP adds the center term to the decode tile instead.
 
     The reference's LUT-precision variants (``ivf_pq_search.cuh:
     780-1004``, fp32/fp16/fp8 LUT × fp32/fp16 internal) map to
-    ``lut_dtype`` — the decode-tile dtype (bf16 = one MXU pass, f32 =
-    bf16x3 split) — and ``internal_distance_dtype`` — the candidate
-    score dtype carried to the merge (bf16 halves candidate HBM).
+    ``lut_dtype`` — the decode/score operand dtype (bf16 = one MXU pass,
+    f32 = highest-precision passes) — and ``internal_distance_dtype`` —
+    the candidate score dtype carried to the merge (bf16 halves
+    candidate HBM).
 
     ``code_norms`` are exact: PQ subspaces concatenate orthogonally, so
     ``||decoded_i||² = Σ_s ||book_s[c_is]||²`` is computed once at build
@@ -266,47 +370,62 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
     nq = q_rot.shape[0]
     n_lists, max_list, pq_dim = codes.shape
     _, n_codes, pq_len = pq_centers.shape
-    rot_dim = pq_dim * pq_len
-    itemsize = jnp.dtype(lut_dtype).itemsize
     lay = _Layout(probes, n_lists, max_list, cap, bins, k)
     codes = lay.pad_lists(codes, max_list)
     code_norms = lay.pad_lists(code_norms, max_list)
     lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
-    mlp, capp = lay.mlp, lay.capp
     qg = q_rot[jnp.clip(lay.padded_qmap(), 0, nq - 1)]
     if metric == "ip":
-        # IP has no residual form: q·y = q_rot·(c_rot + dec) — decode
-        # FULL rotated vectors (center added to the transient tile) and
-        # score plain rotated queries against them
+        # IP decomposes linearly: q·(c_l + dec) = q·c_l + q·dec. The
+        # kernel scores plain rotated queries against decoded residuals
+        # (-q·dec); the per-(list, query) center term is a rank-1
+        # correction applied to the candidate blocks after the scan.
         qsub = qg
     else:
         # per-list probing queries, residual form: (n_lists, cap, rot_dim)
         qsub = qg - centers_rot[:, None, :]
 
-    chunk = _pq_chunk(n_lists, mlp, rot_dim, itemsize)
-    lc = _pick_lc(chunk, mlp, capp, rot_dim, itemsize)
-    n_chunks = n_lists // chunk
-    interpret = pallas_interpret()
+    # VMEM bound: per grid cell the one-hot (n_codes, sub_ml), decode
+    # tile (rot_dim, sub_ml) and score block (cap, sub_ml) all scale with
+    # the list length — split oversized lists into `split` sub-lists
+    # (extra grid cells sharing the list's probing queries) so skewed or
+    # low-n_lists indexes still compile (the old chunked path's
+    # decode-tile budget, per-row form).
+    rot_dim = pq_dim * pq_len
+    itemsize = jnp.dtype(lut_dtype).itemsize
+    per_row = (n_codes * itemsize + rot_dim * 4 + lay.capp * 4
+               + pq_dim * 4)
+    row_budget = max(lay.bins, (_VMEM_LIMIT // 3) // per_row)
+    split = -(-lay.mlp // _round_up(row_budget, lay.bins))
+    sub_ml = _round_up(-(-lay.mlp // split), lay.bins)
+    mlp2 = sub_ml * split
+    if mlp2 != lay.mlp:
+        pad = [(0, 0), (0, mlp2 - lay.mlp)]
+        codes = jnp.pad(codes, pad + [(0, 0)])
+        code_norms = jnp.pad(code_norms, pad)
+        lists_indices = jnp.pad(lists_indices, pad, constant_values=-1)
 
-    def one_chunk(args):
-        codes_c, norms_c, ids_c, qsub_c, crot_c = args
-        flat = codes_c.reshape(-1, pq_dim).astype(jnp.int32)
-        # decode: one row-gather per subquantizer (O(N·pq_len) each)
-        dec = jnp.concatenate(
-            [pq_centers[s][flat[:, s]] for s in range(pq_dim)], axis=1)
-        dec = dec.reshape(chunk, mlp, rot_dim)
-        if metric == "ip":
-            dec = dec + crot_c[:, None, :]
-        dec = dec.astype(lut_dtype)
-        return _list_scan_call(qsub_c, dec, norms_c, ids_c, lay.bins, lc,
-                               1.0, interpret, metric=metric,
-                               out_dtype=internal_distance_dtype)
+    def as_sub(a):
+        return a.reshape(n_lists * split, sub_ml, *a.shape[2:])
 
-    cd, ci = jax.lax.map(one_chunk, (
-        codes.reshape(n_chunks, chunk, mlp, pq_dim),
-        code_norms.reshape(n_chunks, chunk, mlp),
-        lists_indices.reshape(n_chunks, chunk, mlp),
-        qsub.reshape(n_chunks, chunk, capp, rot_dim),
-        centers_rot.reshape(n_chunks, chunk, rot_dim)))
-    return lay.merge(cd.reshape(n_lists, lay.bins, capp),
-                     ci.reshape(n_lists, lay.bins, capp), probes, k, sqrt)
+    cd, ci = _pq_scan_call(qsub, as_sub(codes), as_sub(code_norms),
+                           as_sub(lists_indices), pq_centers, lay.bins,
+                           pallas_interpret(), metric=metric,
+                           lut_dtype=lut_dtype,
+                           out_dtype=internal_distance_dtype, split=split)
+    if split > 1:
+        # sub-lists of a list are contiguous: fold them back into a
+        # wider candidate block per original list
+        cd = cd.reshape(n_lists, split, lay.capp, lay.bins) \
+               .transpose(0, 2, 1, 3).reshape(n_lists, lay.capp, -1)
+        ci = ci.reshape(n_lists, split, lay.capp, lay.bins) \
+               .transpose(0, 2, 1, 3).reshape(n_lists, lay.capp, -1)
+    if metric == "ip":
+        # kernel scored -q·dec; the true negated similarity is
+        # -(q·dec + q·c_l): shift each (list, query) candidate row
+        from raft_tpu.core.precision import matmul_precision
+        corr = jnp.einsum("lqd,ld->lq", qsub, centers_rot,
+                          precision=matmul_precision(),
+                          preferred_element_type=jnp.float32)
+        cd = cd.astype(jnp.float32) - corr[:, :, None]
+    return lay.merge_cap_major(cd, ci, probes, k, sqrt)

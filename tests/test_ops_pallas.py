@@ -316,6 +316,24 @@ class TestIvfPqCodeScanPallas:
                                    np.asarray(d_r)[:, :k // 2],
                                    rtol=0.05, atol=0.5)
 
+    def test_vmem_split_path_agrees(self, pq_setup, monkeypatch):
+        # tiny VMEM budget forces the sub-list split (skewed/low-n_lists
+        # indexes); results must match the unsplit scan
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.ops import pallas_ivf_scan as pis
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = pq_setup
+        k = 8
+        d0, i0 = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes"))
+        monkeypatch.setattr(pis, "_VMEM_LIMIT", 1 << 18)  # force split>1
+        d1, i1 = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes"))
+        assert self._recall(i1, i0, k) >= 0.95
+        np.testing.assert_allclose(np.asarray(d1)[:, :k // 2],
+                                   np.asarray(d0)[:, :k // 2],
+                                   rtol=0.05, atol=0.5)
+
     def test_lut_and_internal_dtype_knobs_live(self, pq_setup,
                                                monkeypatch):
         from raft_tpu.neighbors import ivf_pq
