@@ -207,19 +207,31 @@ PERF_GATES = {
 }
 
 
-def check_gates(results):
-    """Compare a results table against PERF_GATES → list of failures."""
+def check_gates(results, require_all=True):
+    """Compare a results table against PERF_GATES → list of failures.
+    With ``require_all`` (full-suite gate runs), a gated metric that
+    produced no value (case errored, name drifted) is itself a failure —
+    a gate must never pass by not running. Case-filtered runs set it
+    False so unselected gates aren't charged."""
     failures = []
+    seen = set()
     for r in results:
         gate = PERF_GATES.get(r.get("metric"))
         if gate is None or "value" not in r:
             continue
+        seen.add(r["metric"])
         is_rate = r.get("metric", "").endswith("qps")
         ok = r["value"] >= gate if is_rate else r["value"] <= gate
         if not ok:
             failures.append({"metric": r["metric"], "value": r["value"],
                              "gate": gate,
                              "kind": "floor" if is_rate else "ceiling"})
+    if require_all:
+        for metric in PERF_GATES:
+            if metric not in seen:
+                failures.append({"metric": metric, "value": None,
+                                 "gate": PERF_GATES[metric],
+                                 "kind": "missing"})
     return failures
 
 
@@ -233,7 +245,7 @@ if __name__ == "__main__":
     for r in results:
         print(json.dumps(r))
     if gate:
-        fails = check_gates(results)
+        fails = check_gates(results, require_all=not args)
         for f in fails:
             print(json.dumps({"gate_failure": f}))
         print(json.dumps({"gates_checked": True, "failures": len(fails)}))

@@ -96,6 +96,43 @@ void rth_log(int level, const char* msg) {
 }
 
 // ---------------------------------------------------------------------------
+// Interruptible token registry (reference core/interruptible.hpp:66-163):
+// per-thread cancellation flags settable from any thread. The Python
+// layer polls check-and-clear at its sync points (the cudaStreamQuery
+// poll analogue); keeping the registry native matches the reference's
+// placement of interruptible in the C++ core runtime.
+// ---------------------------------------------------------------------------
+
+namespace {
+std::mutex g_intr_mutex;
+std::unordered_map<uint64_t, bool>& intr_flags() {
+  static auto* m = new std::unordered_map<uint64_t, bool>();
+  return *m;
+}
+}  // namespace
+
+void rth_interrupt_cancel(uint64_t thread_id) {
+  std::lock_guard<std::mutex> lk(g_intr_mutex);
+  intr_flags()[thread_id] = true;
+}
+
+// Returns 1 and clears the flag if `thread_id` was cancelled, else 0.
+int rth_interrupt_check_and_clear(uint64_t thread_id) {
+  std::lock_guard<std::mutex> lk(g_intr_mutex);
+  auto& m = intr_flags();
+  auto it = m.find(thread_id);
+  if (it == m.end() || !it->second) return 0;
+  it->second = false;
+  return 1;
+}
+
+// Drop a thread's registry entry (scope exit / thread death).
+void rth_interrupt_release(uint64_t thread_id) {
+  std::lock_guard<std::mutex> lk(g_intr_mutex);
+  intr_flags().erase(thread_id);
+}
+
+// ---------------------------------------------------------------------------
 // Dendrogram union-find (reference build_dendrogram_host,
 // cluster/detail/agglomerative.cuh:103): merge weight-sorted MST edges;
 // emit scipy-linkage-style (children, heights, sizes).

@@ -112,8 +112,11 @@ def build_launcher_resources(
         expects(w.coordinator is not None,
                 "launcher comms: multi-process world needs "
                 "RAFT_TPU_COORDINATOR=host:port (the ncclUniqueId analogue)")
-        already = jax.process_count() == w.num_processes
-        if not already:
+        # probe the coordination client WITHOUT touching the backend:
+        # jax.process_count() would initialise XLA, and jax.distributed
+        # must run first (multi-process rendezvous precedes device init)
+        from raft_tpu.comms.host_p2p import _coordination_client
+        if _coordination_client() is None:
             jax.distributed.initialize(coordinator_address=w.coordinator,
                                        num_processes=w.num_processes,
                                        process_id=w.process_id)

@@ -10,6 +10,11 @@ readiness (``is_ready()``) so a hung device program can be abandoned by the
 waiting host thread. Exposed to users as the ``interruptible`` context
 manager, mirroring ``pylibraft.common.interruptible.cuda_interruptible``
 (reference ``python/pylibraft/pylibraft/common/interruptible.pyx:32-77``).
+
+The token registry itself lives in the native C++ host runtime when
+available (``_cpp/raft_tpu_host.cpp`` ``rth_interrupt_*`` — matching the
+reference's placement of interruptible in the C++ core), with this
+module's pure-Python Event registry as the fallback.
 """
 
 from __future__ import annotations
@@ -20,6 +25,8 @@ import time
 from typing import Dict
 
 import jax
+
+from raft_tpu.core import native as _native
 
 
 class InterruptedException(RuntimeError):
@@ -52,14 +59,15 @@ def _get_token(thread_id: int | None = None) -> _Token:
 def yield_() -> None:
     """Check the current thread's cancellation flag; raise if set
     (reference interruptible::yield :99)."""
-    tok = _get_token()
-    if tok.flag.is_set():
-        tok.flag.clear()
+    if yield_no_throw():
         raise InterruptedException("interruptible::yield: cancelled")
 
 
 def yield_no_throw() -> bool:
-    """Non-throwing check; returns True if cancelled (reference :107)."""
+    """Non-throwing check-and-clear; True if cancelled (reference :107)."""
+    hit = _native.interrupt_check_and_clear(threading.get_ident())
+    if hit is not None:
+        return hit
     tok = _get_token()
     if tok.flag.is_set():
         tok.flag.clear()
@@ -69,6 +77,8 @@ def yield_no_throw() -> bool:
 
 def cancel(thread_id: int) -> None:
     """Flag the given thread's next yield to raise (reference :135)."""
+    if _native.interrupt_cancel(thread_id):
+        return
     _get_token(thread_id).flag.set()
 
 
@@ -94,4 +104,5 @@ def interruptible():
         yield
     finally:
         # Drop any unconsumed cancellation so it cannot leak into later scopes
+        _native.interrupt_release(threading.get_ident())
         _get_token().flag.clear()

@@ -70,6 +70,12 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rth_boruvka_mst.argtypes = [
         ctypes.c_int64, ctypes.c_int64, i64p, i64p, f64p, f64p,
         i64p, i64p, f64p, i64p]
+    lib.rth_interrupt_cancel.restype = None
+    lib.rth_interrupt_cancel.argtypes = [ctypes.c_uint64]
+    lib.rth_interrupt_check_and_clear.restype = ctypes.c_int
+    lib.rth_interrupt_check_and_clear.argtypes = [ctypes.c_uint64]
+    lib.rth_interrupt_release.restype = None
+    lib.rth_interrupt_release.argtypes = [ctypes.c_uint64]
     lib.rth_kv_server_port.restype = ctypes.c_int
     lib.rth_kv_server_port.argtypes = []
     lib.rth_kv_server_start.restype = ctypes.c_int
@@ -208,6 +214,29 @@ def boruvka_mst(n: int, src, dst, altered_w, orig_w):
     if rc < 0:
         raise ValueError(f"boruvka_mst: invalid edges (rc={rc})")
     return out_s[:rc], out_d[:rc], out_w[:rc], out_c[:int(n)]
+
+
+def interrupt_cancel(thread_id: int) -> bool:
+    lib = load()
+    if lib is None:
+        return False
+    lib.rth_interrupt_cancel(int(thread_id))
+    return True
+
+
+def interrupt_check_and_clear(thread_id: int):
+    """True/False = flag state from the native registry; None when the
+    native lib is unavailable (caller falls back to Python tokens)."""
+    lib = load()
+    if lib is None:
+        return None
+    return bool(lib.rth_interrupt_check_and_clear(int(thread_id)))
+
+
+def interrupt_release(thread_id: int) -> None:
+    lib = load()
+    if lib is not None:
+        lib.rth_interrupt_release(int(thread_id))
 
 
 def kv_server_port():

@@ -41,27 +41,35 @@ from raft_tpu.distance._elementwise_cores import (
 MAX_DIM = 16384
 
 
+# rows of x processed together per inner step: a (RC, TN, dp) broadcast
+# keeps all 8 sublanes busy instead of one row's worth of VPU work
+_ROW_CHUNK = 8
+
+
 def _elt_kernel(x_ref, y_ref, od_ref, *, tm: int, metric: str, p: float,
                 dim: int, sqrt: bool):
     y = y_ref[:]                                         # (TN, dp)
 
-    def row(a, _):
-        xa = x_ref[pl.dslice(a, 1), :]                   # (1, dp)
+    def chunk(a, _):
+        base = a * _ROW_CHUNK
+        xa = x_ref[pl.dslice(base, _ROW_CHUNK), :]       # (RC, dp)
+        xa3 = xa[:, None, :]                             # (RC, 1, dp)
+        y3 = y[None, :, :]                               # (1, TN, dp)
         if metric == "braycurtis":
-            diff = jnp.sum(jnp.abs(xa - y), axis=1, keepdims=True)
-            ssum = jnp.sum(jnp.abs(xa + y), axis=1, keepdims=True)
+            diff = jnp.sum(jnp.abs(xa3 - y3), axis=2)    # (RC, TN)
+            ssum = jnp.sum(jnp.abs(xa3 + y3), axis=2)
             r = diff / jnp.where(ssum == 0.0, 1.0, ssum)
         else:
-            e = _combine(metric, xa, y, p)               # (TN, dp)
+            e = _combine(metric, xa3, y3, p)             # (RC, TN, dp)
             if metric in _MAX_REDUCE:
-                r = jnp.max(e, axis=1, keepdims=True)    # (TN, 1)
+                r = jnp.max(e, axis=2)                   # (RC, TN)
             else:
-                r = jnp.sum(e, axis=1, keepdims=True)
+                r = jnp.sum(e, axis=2)
             r = _finalize(metric, r, p, dim, sqrt)
-        od_ref[pl.dslice(a, 1), :] = r.T                 # (1, TN)
+        od_ref[pl.dslice(base, _ROW_CHUNK), :] = r
         return _
 
-    jax.lax.fori_loop(0, tm, row, 0, unroll=False)
+    jax.lax.fori_loop(0, tm // _ROW_CHUNK, chunk, 0, unroll=False)
 
 
 @functools.partial(jax.jit, static_argnames=("metric", "p", "sqrt", "tm",
@@ -105,6 +113,7 @@ def elementwise_dist_pallas(x, y, metric: str, p: float = 2.0,
     """
     m, dim = x.shape
     n = y.shape[0]
+    dp = _round_up(dim, 128)
     if tm <= 0 or tn <= 0:
         # operand blocks (tm+tn)·dp·4 double-buffered + (tm, tn) out;
         # deep-ish TN so the lane reduction amortizes
@@ -112,7 +121,14 @@ def elementwise_dist_pallas(x, y, metric: str, p: float = 2.0,
             tm, tn = 256, 512
         else:
             tm, tn = 128, 256
+    # the row-chunked combine materializes a (_ROW_CHUNK, TN, dp) f32
+    # transient: cap TN so it stays well inside VMEM at wide dims
+    tn_cap = max(8, (32 << 20) // (4 * _ROW_CHUNK * dp))
+    tn = min(tn, max(8, tn_cap - tn_cap % 8))
     tm = min(tm, _round_up(m, 8))
     tn = min(tn, _round_up(n, 8))
+    # the kernel loop strides whole row chunks: tm must be a multiple
+    # of _ROW_CHUNK or trailing block rows would never be written
+    tm = _round_up(tm, _ROW_CHUNK)
     return _elt_call(x, y, metric, float(p), bool(sqrt), tm, tn,
                      pallas_interpret())

@@ -116,22 +116,36 @@ def _accumulate_elt(x: _CsrF32, y: _CsrF32, k: int, tile: int,
     it serves as both the within-tile k-reduction and the cross-tile
     combiner, which is exact because both are associative+commutative.
     ``combine`` may return a tuple of ``n_acc`` arrays (BrayCurtis needs
-    two sums)."""
+    two sums).
+
+    The (rows_x, rows_y, tile) combine broadcast is itself row-tiled
+    over x (``lax.map``) so peak transient memory stays bounded by the
+    scratch budget however large the row counts get — the same bound
+    the dense elementwise tier enforces."""
+    m, n = x.n_rows, y.n_rows
     n_tiles = -(-k // tile)
     inner = jnp.max if reduce_fn is jnp.maximum else jnp.sum
+    rt = max(1, min(m, _TILE_BUDGET_ELEMS // max(1, n * tile)))
+    mp = -(-m // rt) * rt
 
     def body(i, accs):
         start = i * tile
         xt = _tile_of(x, start, tile)
         yt = _tile_of(y, start, tile)
-        parts = combine(xt[:, None, :], yt[None, :, :])
-        if n_acc == 1:
-            parts = (parts,)
-        return tuple(reduce_fn(a, inner(p, axis=2))
-                     for a, p in zip(accs, parts))
+        if mp != m:
+            xt = jnp.pad(xt, ((0, mp - m), (0, 0)))
 
-    init = tuple(jnp.zeros((x.n_rows, y.n_rows), jnp.float32)
-                 for _ in range(n_acc))
+        def row_chunk(xc):  # (rt, tile) → n_acc × (rt, n)
+            parts = combine(xc[:, None, :], yt[None, :, :])
+            if n_acc == 1:
+                parts = (parts,)
+            return tuple(inner(p, axis=2) for p in parts)
+
+        parts = lax.map(row_chunk, xt.reshape(-1, rt, tile))
+        parts = tuple(p.reshape(mp, n)[:m] for p in parts)
+        return tuple(reduce_fn(a, p) for a, p in zip(accs, parts))
+
+    init = tuple(jnp.zeros((m, n), jnp.float32) for _ in range(n_acc))
     out = lax.fori_loop(0, n_tiles, body, init)
     return out[0] if n_acc == 1 else out
 

@@ -187,11 +187,10 @@ class TestLauncherBackend:
         mesh = res.mesh
 
         import jax
-        from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
-        f = shard_map(lambda x: c.allreduce(x),
-                      mesh=mesh, in_specs=P("data"), out_specs=P())
+        f = jax.shard_map(lambda x: c.allreduce(x),
+                          mesh=mesh, in_specs=P("data"), out_specs=P())
         out = f(jnp.arange(8, dtype=jnp.float32).reshape(4, 2).reshape(-1))
         assert float(out[0]) >= 0  # executes without error
 
@@ -437,6 +436,67 @@ class TestHostP2P:
                                   stderr=subprocess.PIPE, env=env)
                  for i in range(2)]
         outs = [p.communicate(timeout=120) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, (out, err[-2000:])
+            assert b"OK" in out
+
+    def test_multiprocess_launcher_backend_collective(self, tmp_path):
+        """Two OS processes bootstrap comms purely from launcher env vars
+        (the mpi_comms deployment path) and run a real cross-process
+        psum over the global mesh, plus heartbeat health checks."""
+        import subprocess, sys, textwrap, socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        prog = textwrap.dedent("""
+            import os, time
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import PartitionSpec as P
+            from raft_tpu.comms import (build_launcher_resources,
+                                        detect_launcher, HealthMonitor)
+            w = detect_launcher()
+            assert w.kind == "explicit" and w.num_processes == 2, w
+            res = build_launcher_resources(world=w)
+            mesh = res.mesh
+            assert res.get_comms().get_size() == 2
+            c = res.get_comms()
+            f = jax.jit(jax.shard_map(lambda x: c.allreduce(x),
+                                      mesh=mesh, in_specs=P("data"),
+                                      out_specs=P()))
+            # global input: each process contributes its local shard
+            arr = jax.make_array_from_process_local_data(
+                jax.NamedSharding(mesh, P("data")),
+                np.full((1,), float(w.process_id + 1), np.float32),
+                (2,))
+            out = f(arr)
+            total = float(np.asarray(jax.device_get(out))[0])
+            assert total == 3.0, total  # 1 + 2
+            m = HealthMonitor(w.process_id, 2, session="mp",
+                              interval_s=0.1, stale_after_s=5.0).start()
+            time.sleep(0.5)
+            assert m.suspect_ranks() == [], m.last_suspects
+            m.stop()
+            print("OK", w.process_id)
+        """)
+        f = tmp_path / "launcher_worker.py"
+        f.write_text(prog)
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = []
+        for i in range(2):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       RAFT_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       RAFT_TPU_NUM_PROCS="2", RAFT_TPU_PROC_ID=str(i),
+                       PYTHONPATH=repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            env.pop("XLA_FLAGS", None)  # one CPU device per process
+            procs.append(subprocess.Popen(
+                [sys.executable, str(f)], stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env))
+        outs = [p.communicate(timeout=180) for p in procs]
         for p, (out, err) in zip(procs, outs):
             assert p.returncode == 0, (out, err[-2000:])
             assert b"OK" in out

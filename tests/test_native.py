@@ -213,3 +213,42 @@ class TestNativeKVBroker:
             assert m0.suspect_ranks() == [1]
         finally:
             m0.stop(); m1.stop()
+
+
+class TestNativeInterruptible:
+    """C++ token registry behind core.interruptible (rth_interrupt_*)."""
+
+    def test_cross_thread_cancel_via_native(self):
+        import importlib
+        import threading
+        intr = importlib.import_module("raft_tpu.core.interruptible")
+
+        state = {}
+
+        def worker():
+            state["tid"] = threading.get_ident()
+            state["ready"].set()
+            try:
+                while True:
+                    intr.yield_()
+                    import time
+                    time.sleep(0.005)
+            except intr.InterruptedException:
+                state["cancelled"] = True
+
+        state["ready"] = threading.Event()
+        t = threading.Thread(target=worker)
+        t.start()
+        state["ready"].wait(2)
+        intr.cancel(state["tid"])
+        t.join(5)
+        assert state.get("cancelled") is True
+
+    def test_flag_cleared_after_consume(self):
+        import importlib
+        import threading
+        intr = importlib.import_module("raft_tpu.core.interruptible")
+        tid = threading.get_ident()
+        intr.cancel(tid)
+        assert intr.yield_no_throw() is True
+        assert intr.yield_no_throw() is False  # consumed, not sticky

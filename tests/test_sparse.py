@@ -191,6 +191,19 @@ class TestSparseDistance:
         expect = np.asarray(dense_distance(x, y, metric))
         np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
 
+    def test_wide_elt_row_tiling_bounded(self, rng_np, monkeypatch):
+        # shrink the scratch budget: the row-tiled wide path must still
+        # be exact when (m, n, tile) cannot materialize at once
+        from raft_tpu.sparse import distance as sd
+        monkeypatch.setattr(sd, "_TILE_BUDGET_ELEMS", 1 << 12)
+        x = _random_sparse(rng_np, 37, 300, density=0.05)
+        y = _random_sparse(rng_np, 23, 300, density=0.05)
+        cx, cy = sp.dense_to_csr(x), sp.dense_to_csr(y)
+        got = np.asarray(sp.pairwise_distance(
+            cx, cy, DistanceType.L1, col_tile=64))
+        expect = np.asarray(dense_distance(x, y, DistanceType.L1))
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
     def test_wide_100k_dim_vs_scipy(self, rng_np):
         # the reference's own use case for the hash strategy: very wide
         # sparse features, nnz-bounded memory (never densifies m×k)
