@@ -184,6 +184,15 @@ def run_all(cases=None):
     results = []
     selected = _CASES if not cases else [
         c for c in _CASES if c.__name__.removeprefix("bench_") in cases]
+    if cases:
+        known = {c.__name__.removeprefix("bench_") for c in _CASES}
+        bad = [c for c in cases if c not in known]
+        if bad:
+            # an unknown case name must never yield a silent empty run
+            # (a typo'd --gate invocation would exit green having
+            # measured nothing)
+            raise SystemExit(f"bench_suite: unknown case(s) {bad}; "
+                             f"available: {sorted(known)}")
     for case in selected:
         try:
             case(results)

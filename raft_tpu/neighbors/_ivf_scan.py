@@ -224,3 +224,46 @@ def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
     cand_d = cand_d.reshape(n_lists, cap, kk)
     cand_i = cand_i.reshape(n_lists, cap, kk)
     return merge_candidates(cand_d, cand_i, probes, inv_pos, k, sqrt)
+
+
+def gather_query_rows(queries, qmap, mode: str = ""):
+    """Build the per-list query blocks (n_lists, cap, dim) from the probe
+    inversion table.
+
+    Two strategies, switchable via ``RAFT_TPU_GATHER`` (A/B-able on
+    hardware):
+
+    * ``rows`` (default) — plain XLA row gather.
+    * ``onehot`` — one-hot × queries on the MXU in list chunks, with a
+      bf16x2 (hi + lo) split: rows are near-f32 (~2^-16 relative, the
+      kernel tier's accuracy class), NOT bitwise-exact. XLA lowers big
+      row gathers through the scalar core, which has repeatedly been the
+      slow path on TPU (BASELINE.md: LUT-gather scans); this trades them
+      for matmul FLOPs.
+    """
+    import os
+
+    mode = mode or os.environ.get("RAFT_TPU_GATHER", "rows")
+    nq = queries.shape[0]
+    safe = jnp.clip(qmap, 0, nq - 1)
+    if mode != "onehot":
+        return queries[safe]
+
+    n_lists, cap = qmap.shape
+    # chunk so the (chunk, cap, nq) one-hot stays modest
+    chunk = largest_divisor_at_most(
+        n_lists, max(1, (64 << 20) // max(1, cap * nq * 2)))
+
+    qh = queries.astype(jnp.bfloat16)
+    ql = (queries - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    def one_chunk(idx_c):
+        oh = jax.nn.one_hot(idx_c, nq, dtype=jnp.bfloat16)  # (c, cap, nq)
+        hi = jnp.einsum("lcq,qd->lcd", oh, qh,
+                        preferred_element_type=jnp.float32)
+        lo = jnp.einsum("lcq,qd->lcd", oh, ql,
+                        preferred_element_type=jnp.float32)
+        return hi + lo
+
+    out = jax.lax.map(one_chunk, safe.reshape(-1, chunk, cap))
+    return out.reshape(n_lists, cap, queries.shape[1])

@@ -22,3 +22,31 @@ class IndexParams:
 @dataclass
 class SearchParams:
     pass
+
+
+# Reference ivf_pq_search.cuh:1234 get_max_batch_size: searches run in
+# query batches so per-batch scratch (probe tables, candidate blocks)
+# stays bounded however many queries arrive at once.
+MAX_QUERY_BATCH = 4096
+
+
+def batched_search(search_one_batch, queries, max_batch: int = 0):
+    """Run ``search_one_batch(q_slice) -> (d, i)`` over query batches and
+    concatenate (the reference's search batching loop)."""
+    import jax.numpy as jnp
+
+    mb = max_batch if max_batch > 0 else MAX_QUERY_BATCH
+    nq = queries.shape[0]
+    if nq <= mb:
+        return search_one_batch(queries)
+    outs = [search_one_batch(queries[s:s + mb]) for s in range(0, nq, mb)]
+    d, i = zip(*outs)
+    return jnp.concatenate(d, axis=0), jnp.concatenate(i, axis=0)
+
+
+def list_order_auto(nq: int, n_probes: int, n_lists: int) -> bool:
+    """The single definition of the probe-major vs list-major auto
+    heuristic (reuse factor nq·n_probes/n_lists): shared by the inline
+    scan dispatch and the query-batching pin so batched and unbatched
+    searches always take the same path."""
+    return nq >= 64 and nq * n_probes >= 4 * n_lists

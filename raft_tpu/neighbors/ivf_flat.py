@@ -342,6 +342,22 @@ def search(index: Index, queries, k: int,
     expects(q.shape[1] == index.dim, "ivf_flat.search: dim mismatch")
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_flat.search: unknown scan_order {params.scan_order!r}")
+    from raft_tpu.neighbors.ann_types import MAX_QUERY_BATCH, batched_search
+    if q.shape[0] > MAX_QUERY_BATCH:
+        # reference search batching (ivf_pq_search.cuh:1234 role). Pin
+        # "auto" choices from the FULL query count first so every batch
+        # takes the same scan path (and returns identical results to an
+        # unbatched call modulo batching itself).
+        import dataclasses
+        from raft_tpu.neighbors.ann_types import list_order_auto
+        so = params.scan_order
+        if so == "auto":
+            n_pr = min(params.n_probes, index.n_lists)
+            so = ("list" if list_order_auto(q.shape[0], n_pr,
+                                            index.n_lists) else "probe")
+        pinned = dataclasses.replace(params, scan_order=so)
+        return batched_search(
+            lambda qb: search(index, qb, k, pinned, res=res), q)
     n_probes = min(params.n_probes, index.n_lists)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
@@ -353,11 +369,12 @@ def search(index: Index, queries, k: int,
     nq = q.shape[0]
     # the XLA-tier list scan only has the l2 core; don't pay the coarse
     # phase + probe_cap host sync just to fall through to probe-major
+    from raft_tpu.neighbors.ann_types import list_order_auto
     use_list = ((pallas_enabled() or kind == "l2")
                 and (params.scan_order == "list"
                      or (params.scan_order == "auto"
-                         and nq >= 64
-                         and nq * n_probes >= 4 * index.n_lists)))
+                         and list_order_auto(nq, n_probes,
+                                             index.n_lists))))
     if use_list:
         from raft_tpu.neighbors import _ivf_scan
         probes = _ivf_scan.coarse_probes(q, index.centers, n_probes,

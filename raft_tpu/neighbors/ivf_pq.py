@@ -478,6 +478,25 @@ def search(index: Index, queries, k: int,
     expects(q.shape[1] == index.dim, "ivf_pq.search: dim mismatch")
     expects(params.scan_mode in ("auto", "codes", "reconstruct", "lut"),
             f"ivf_pq.search: unknown scan_mode {params.scan_mode!r}")
+    from raft_tpu.neighbors.ann_types import MAX_QUERY_BATCH, batched_search
+    if q.shape[0] > MAX_QUERY_BATCH:
+        # reference batching loop (ivf_pq_search.cuh:1251/:1234). Pin
+        # "auto" choices from the FULL query count so every batch takes
+        # the same scan path.
+        import dataclasses
+        mode = params.scan_mode
+        if mode == "auto":
+            from raft_tpu.ops.dispatch import pallas_enabled
+            mode = "codes" if pallas_enabled() else "reconstruct"
+        from raft_tpu.neighbors.ann_types import list_order_auto
+        so = params.scan_order
+        if so == "auto" and mode == "reconstruct":
+            n_pr = min(params.n_probes, index.n_lists)
+            so = ("list" if list_order_auto(q.shape[0], n_pr,
+                                            index.n_lists) else "probe")
+        pinned = dataclasses.replace(params, scan_mode=mode, scan_order=so)
+        return batched_search(
+            lambda qb: search(index, qb, k, pinned, res=res), q)
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_pq.search: unknown scan_order {params.scan_order!r}")
     n_probes = min(params.n_probes, index.n_lists)
@@ -521,11 +540,12 @@ def search(index: Index, queries, k: int,
                     index.codes, index.pq_centers, index.lists_indices)
             index.decoded_norms = index.code_norms
         nq = q.shape[0]
+        from raft_tpu.neighbors.ann_types import list_order_auto
         use_list = (kind == "l2"
                     and (params.scan_order == "list"
                          or (params.scan_order == "auto"
-                             and nq >= 64
-                             and nq * n_probes >= 4 * index.n_lists)))
+                             and list_order_auto(nq, n_probes,
+                                                 index.n_lists))))
         if use_list:
             from raft_tpu.neighbors import _ivf_scan
             probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)

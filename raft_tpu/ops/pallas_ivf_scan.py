@@ -219,9 +219,11 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     lists_norms = lay.pad_lists(lists_norms, max_list)
     lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
 
-    # XLA pre-gather: each list's probing queries → (n_lists, cap, dim).
+    # pre-gather: each list's probing queries → (n_lists, cap, dim).
     # ~cap/mean-probes ≤ 2× the query bytes; read once by the kernel.
-    qsub = queries[jnp.clip(lay.padded_qmap(), 0, nq - 1)]
+    # Strategy (row gather vs one-hot MXU) via RAFT_TPU_GATHER.
+    from raft_tpu.neighbors._ivf_scan import gather_query_rows
+    qsub = gather_query_rows(queries, lay.padded_qmap())
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim,
                   lists_data.dtype.itemsize)
     cd, ci = _list_scan_call(qsub, lists_data, lists_norms, lists_indices,
@@ -374,7 +376,8 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
     codes = lay.pad_lists(codes, max_list)
     code_norms = lay.pad_lists(code_norms, max_list)
     lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
-    qg = q_rot[jnp.clip(lay.padded_qmap(), 0, nq - 1)]
+    from raft_tpu.neighbors._ivf_scan import gather_query_rows
+    qg = gather_query_rows(q_rot, lay.padded_qmap())
     if metric == "ip":
         # IP decomposes linearly: q·(c_l + dec) = q·c_l + q·dec. The
         # kernel scores plain rotated queries against decoded residuals

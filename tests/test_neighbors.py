@@ -489,3 +489,37 @@ class TestIvfFlatQuantizedStorage:
         d2, i2 = ivf_flat.search(idx2, db[:10], 3,
                                  ivf_flat.SearchParams(n_probes=4))
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestQueryBatching:
+    """Reference search batching (get_max_batch_size role,
+    ivf_pq_search.cuh:1234): >MAX_QUERY_BATCH queries split into batches
+    whose concatenated results equal the unbatched ones."""
+
+    def test_ivf_flat_batched_equals_unbatched(self, dataset, monkeypatch):
+        import raft_tpu.neighbors.ann_types as at
+        from raft_tpu.neighbors import ivf_flat
+        x, q = dataset
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16,
+                                                     kmeans_n_iters=4))
+        sp = ivf_flat.SearchParams(n_probes=16)
+        d0, i0 = ivf_flat.search(idx, q, 5, sp)
+        monkeypatch.setattr(at, "MAX_QUERY_BATCH", 7)  # force batching
+        d1, i1 = ivf_flat.search(idx, q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_ivf_pq_batched_equals_unbatched(self, dataset, monkeypatch):
+        import raft_tpu.neighbors.ann_types as at
+        from raft_tpu.neighbors import ivf_pq
+        x, q = dataset
+        idx = ivf_pq.build(x[:1500], ivf_pq.IndexParams(
+            n_lists=8, pq_dim=8, kmeans_n_iters=4))
+        sp = ivf_pq.SearchParams(n_probes=8)
+        d0, i0 = ivf_pq.search(idx, q, 5, sp)
+        monkeypatch.setattr(at, "MAX_QUERY_BATCH", 9)
+        d1, i1 = ivf_pq.search(idx, q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-4, atol=1e-4)

@@ -564,3 +564,30 @@ class TestFusedKnnKTiled:
         np.testing.assert_allclose(np.asarray(d),
                                    -np.sort(-sims, 1)[:, :5],
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestGatherStrategies:
+    def test_onehot_gather_matches_rows(self, rng_np):
+        import jax.numpy as jnp
+        from raft_tpu.neighbors._ivf_scan import gather_query_rows
+        q = jnp.asarray(rng_np.random((100, 32)).astype(np.float32))
+        qmap = jnp.asarray(
+            rng_np.integers(-1, 100, (16, 8)).astype(np.int32))
+        a = gather_query_rows(q, qmap, "rows")
+        b = gather_query_rows(q, qmap, "onehot")
+        # bf16x2 split: ~2^-17 relative (the kernel tier's contract)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ivf_flat_search_with_onehot_gather(self, rng_np, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_GATHER", "onehot")
+        import jax.numpy as jnp
+        from raft_tpu.neighbors import ivf_flat
+        x = rng_np.random((800, 16)).astype(np.float32)
+        q = x[:64]
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                     kmeans_n_iters=4))
+        d, i = ivf_flat.search(idx, q, 3, ivf_flat.SearchParams(
+            n_probes=8, scan_order="list"))
+        assert (np.asarray(i)[:, 0] == np.arange(64)).mean() > 0.95
