@@ -1,0 +1,35 @@
+"""Distributed (MNMG) algorithms over a device mesh.
+
+Single host this runs over the local devices (set
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu to
+try an 8-way virtual mesh); multi-host, either launch with a Session
+(raft-dask analogue) or purely from launcher env vars (mpi_comms
+analogue):
+
+    RAFT_TPU_COORDINATOR=host0:1234 RAFT_TPU_NUM_PROCS=2 \
+    RAFT_TPU_PROC_ID=$RANK python examples/03_distributed.py
+"""
+import numpy as np
+
+from raft_tpu.comms import Session, detect_launcher, build_launcher_resources
+from raft_tpu.parallel import distributed_knn, distributed_kmeans_fit
+from raft_tpu.cluster import KMeansParams
+from raft_tpu.random import make_blobs
+
+world = detect_launcher()
+if world.num_processes > 1:
+    res = build_launcher_resources(world=world)   # launcher-driven path
+    mesh = res.mesh
+else:
+    session = Session(axis_names=("data",)).init()
+    res, mesh = session.resources, session.mesh
+
+X, _ = make_blobs(n_samples=40_000, n_features=32, centers=16, seed=0)
+Q = np.asarray(X)[:64]
+
+d, i = distributed_knn(X, Q, k=8, mesh=mesh)
+print("sharded exact knn:", i.shape)
+
+centroids, inertia, n_iter = distributed_kmeans_fit(
+    X, KMeansParams(n_clusters=16, max_iter=10), mesh=mesh)
+print(f"MNMG kmeans: inertia={float(inertia):.1f} after {int(n_iter)} iters")

@@ -247,6 +247,53 @@ class Comms:
                               axis_index_groups=self.axis_index_groups
                               ).reshape(-1, *x.shape[1:])
 
+    def allreduce_quantized(self, x, bits: int = 8):
+        """Bandwidth-compressed SUM allreduce (EQuARX-style, arXiv
+        2506.17615): both wire stages move int8 blocks + f32 per-block
+        scales instead of f32 payloads — ~4× less ICI/DCN traffic.
+
+        Stage 1: per-rank max-abs block quantization + ``all_to_all``
+        (each rank collects every rank's copy of its block); local
+        dequantize-sum. Stage 2: requantize the partial and
+        ``all_gather``. Relative error is ~n_ranks/2^(bits-1) worst
+        case; use plain :meth:`allreduce` where exactness matters
+        (metrics, convergence checks).
+
+        The leading-dim size must make the flattened length divisible by
+        the group size (pad upstream if not).
+        """
+        expects(bits == 8, "allreduce_quantized: int8 wire format only")
+        n = self.get_size()
+        shape = x.shape
+        flat = x.astype(jnp.float32).reshape(-1)
+        expects(flat.shape[0] % n == 0,
+                "allreduce_quantized: %d elements not divisible by %d "
+                "ranks", flat.shape[0], n)
+        blocks = flat.reshape(n, -1)                      # (n, blk)
+        qmax = jnp.float32(127.0)
+
+        def quant(v):
+            s = jnp.max(jnp.abs(v), axis=-1, keepdims=True) / qmax
+            s = jnp.where(s == 0.0, 1.0, s)
+            q = jnp.clip(jnp.round(v / s), -127, 127).astype(jnp.int8)
+            return q, s[..., 0]
+
+        q1, s1 = quant(blocks)                            # (n, blk), (n,)
+        # every rank receives all ranks' copy of its own block index:
+        # row r of the result is rank r's quantized copy of my block
+        qx = lax.all_to_all(q1, self.axis_name, 0, 0,
+                            axis_index_groups=self.axis_index_groups)
+        sx = lax.all_to_all(s1, self.axis_name, 0, 0,
+                            axis_index_groups=self.axis_index_groups)
+        part = jnp.sum(qx.astype(jnp.float32) * sx[:, None], axis=0)
+        q2, s2 = quant(part[None, :])                     # (1, blk), (1,)
+        g = lax.all_gather(q2[0], self.axis_name,
+                           axis_index_groups=self.axis_index_groups)
+        sg = lax.all_gather(s2, self.axis_name,
+                            axis_index_groups=self.axis_index_groups)
+        out = (g.astype(jnp.float32) * sg.reshape(-1, 1)).reshape(-1)
+        return out.reshape(shape).astype(x.dtype)
+
     def barrier_value(self):
         """Device-side barrier: tiny psum every rank must reach (reference
         std_comms barrier :189 — allreduce on a scalar)."""

@@ -243,7 +243,11 @@ def gather_query_rows(queries, qmap, mode: str = ""):
     """
     import os
 
+    from raft_tpu.core.error import expects
+
     mode = mode or os.environ.get("RAFT_TPU_GATHER", "rows")
+    expects(mode in ("rows", "onehot"),
+            "RAFT_TPU_GATHER=%s: want rows|onehot", mode)
     nq = queries.shape[0]
     safe = jnp.clip(qmap, 0, nq - 1)
     if mode != "onehot":

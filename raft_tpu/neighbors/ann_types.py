@@ -32,16 +32,40 @@ MAX_QUERY_BATCH = 4096
 
 def batched_search(search_one_batch, queries, max_batch: int = 0):
     """Run ``search_one_batch(q_slice) -> (d, i)`` over query batches and
-    concatenate (the reference's search batching loop)."""
+    concatenate (the reference's search batching loop). The ragged last
+    slice is padded to the batch size (last row repeated) and trimmed, so
+    every batch reuses ONE compiled shape."""
     import jax.numpy as jnp
 
     mb = max_batch if max_batch > 0 else MAX_QUERY_BATCH
     nq = queries.shape[0]
     if nq <= mb:
         return search_one_batch(queries)
-    outs = [search_one_batch(queries[s:s + mb]) for s in range(0, nq, mb)]
+    outs = []
+    for s in range(0, nq, mb):
+        qb = queries[s:s + mb]
+        short = mb - qb.shape[0]
+        if short:
+            fill = jnp.broadcast_to(qb[-1:], (short,) + qb.shape[1:])
+            d, i = search_one_batch(jnp.concatenate([qb, fill], axis=0))
+            outs.append((d[:mb - short], i[:mb - short]))
+        else:
+            outs.append(search_one_batch(qb))
     d, i = zip(*outs)
     return jnp.concatenate(d, axis=0), jnp.concatenate(i, axis=0)
+
+
+def pin_scan_order(params, nq: int, n_lists: int):
+    """Resolve ``scan_order='auto'`` from the FULL query count (the
+    shared batching pin for ivf_flat/ivf_pq): every batch then takes the
+    same scan path, keeping batched results identical to unbatched."""
+    import dataclasses
+
+    if getattr(params, "scan_order", None) != "auto":
+        return params
+    n_pr = min(params.n_probes, n_lists)
+    so = "list" if list_order_auto(nq, n_pr, n_lists) else "probe"
+    return dataclasses.replace(params, scan_order=so)
 
 
 def list_order_auto(nq: int, n_probes: int, n_lists: int) -> bool:

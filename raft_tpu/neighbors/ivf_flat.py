@@ -342,20 +342,13 @@ def search(index: Index, queries, k: int,
     expects(q.shape[1] == index.dim, "ivf_flat.search: dim mismatch")
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_flat.search: unknown scan_order {params.scan_order!r}")
-    from raft_tpu.neighbors.ann_types import MAX_QUERY_BATCH, batched_search
+    from raft_tpu.neighbors.ann_types import (MAX_QUERY_BATCH,
+                                              batched_search,
+                                              pin_scan_order)
     if q.shape[0] > MAX_QUERY_BATCH:
-        # reference search batching (ivf_pq_search.cuh:1234 role). Pin
-        # "auto" choices from the FULL query count first so every batch
-        # takes the same scan path (and returns identical results to an
-        # unbatched call modulo batching itself).
-        import dataclasses
-        from raft_tpu.neighbors.ann_types import list_order_auto
-        so = params.scan_order
-        if so == "auto":
-            n_pr = min(params.n_probes, index.n_lists)
-            so = ("list" if list_order_auto(q.shape[0], n_pr,
-                                            index.n_lists) else "probe")
-        pinned = dataclasses.replace(params, scan_order=so)
+        # reference search batching (ivf_pq_search.cuh:1234 role); pin
+        # "auto" choices from the FULL query count first
+        pinned = pin_scan_order(params, q.shape[0], index.n_lists)
         return batched_search(
             lambda qb: search(index, qb, k, pinned, res=res), q)
     n_probes = min(params.n_probes, index.n_lists)

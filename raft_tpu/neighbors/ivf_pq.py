@@ -478,23 +478,19 @@ def search(index: Index, queries, k: int,
     expects(q.shape[1] == index.dim, "ivf_pq.search: dim mismatch")
     expects(params.scan_mode in ("auto", "codes", "reconstruct", "lut"),
             f"ivf_pq.search: unknown scan_mode {params.scan_mode!r}")
-    from raft_tpu.neighbors.ann_types import MAX_QUERY_BATCH, batched_search
+    from raft_tpu.neighbors.ann_types import (MAX_QUERY_BATCH,
+                                              batched_search,
+                                              pin_scan_order)
     if q.shape[0] > MAX_QUERY_BATCH:
-        # reference batching loop (ivf_pq_search.cuh:1251/:1234). Pin
-        # "auto" choices from the FULL query count so every batch takes
-        # the same scan path.
+        # reference batching loop (ivf_pq_search.cuh:1251/:1234); pin
+        # "auto" choices from the FULL query count first
         import dataclasses
         mode = params.scan_mode
         if mode == "auto":
             from raft_tpu.ops.dispatch import pallas_enabled
             mode = "codes" if pallas_enabled() else "reconstruct"
-        from raft_tpu.neighbors.ann_types import list_order_auto
-        so = params.scan_order
-        if so == "auto" and mode == "reconstruct":
-            n_pr = min(params.n_probes, index.n_lists)
-            so = ("list" if list_order_auto(q.shape[0], n_pr,
-                                            index.n_lists) else "probe")
-        pinned = dataclasses.replace(params, scan_mode=mode, scan_order=so)
+        pinned = pin_scan_order(dataclasses.replace(params, scan_mode=mode),
+                                q.shape[0], index.n_lists)
         return batched_search(
             lambda qb: search(index, qb, k, pinned, res=res), q)
     expects(params.scan_order in ("auto", "probe", "list"),

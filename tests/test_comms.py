@@ -86,6 +86,52 @@ class TestCommsObject:
         assert c.sync_stream(Never(), timeout_s=0.05) == Status.ABORT
 
 
+class TestQuantizedAllreduce:
+    """EQuARX-style compressed allreduce: int8 wire, bounded error."""
+
+    def test_close_to_exact(self, mesh):
+        from jax.sharding import PartitionSpec as P
+        c = build_comms(mesh)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(0, 5.0, (8, 256)).astype(np.float32))
+
+        def body(v):
+            return c.allreduce_quantized(v), c.allreduce(v)
+
+        fq = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                   out_specs=(P(), P()),
+                                   check_vma=False))
+        approx, exact = fq(x)
+        err = np.abs(np.asarray(approx) - np.asarray(exact))
+        rel = err.max() / (np.abs(np.asarray(exact)).max() + 1e-9)
+        assert rel < 0.05, rel
+
+    def test_split_comm_groups(self, mesh):
+        from jax.sharding import PartitionSpec as P
+        c = build_comms(mesh).comm_split([r % 2 for r in range(8)])
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(0, 1.0, (8, 64)).astype(np.float32))
+
+        def body(v):
+            return c.allreduce_quantized(v), c.allreduce(v)
+
+        fq = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                   out_specs=(P("data"), P("data")),
+                                   check_vma=False))
+        approx, exact = fq(x)
+        err = np.abs(np.asarray(approx) - np.asarray(exact)).max()
+        assert err < 0.05 * (np.abs(np.asarray(exact)).max() + 1e-9)
+
+    def test_indivisible_rejected(self, mesh):
+        from jax.sharding import PartitionSpec as P
+        c = build_comms(mesh)
+        with pytest.raises(Exception):
+            jax.jit(jax.shard_map(lambda v: c.allreduce_quantized(v),
+                                  mesh=mesh, in_specs=P("data"),
+                                  out_specs=P(),
+                                  check_vma=False))(jnp.ones((8, 3)))
+
+
 class TestHealthMonitor:
     """Heartbeat failure detection (SURVEY.md hard part (e)): ABORT with
     participant identification, reference util.hpp:109-143 upgraded."""
