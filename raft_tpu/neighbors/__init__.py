@@ -23,11 +23,12 @@ from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.neighbors import serialize
 from raft_tpu.neighbors import processing
+from raft_tpu.neighbors import host_memory
 
 __all__ = [
     "IndexParams", "SearchParams",
     "select_k", "knn", "brute_force_knn", "knn_merge_parts", "fused_l2_knn",
     "haversine_knn",
     "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ball_cover", "refine",
-    "serialize", "processing",
+    "serialize", "processing", "host_memory",
 ]

@@ -1,0 +1,134 @@
+"""Host-memory-resident ANN indexes (the reference's host-transfer axis).
+
+Reference: the knn bench's NO_COPY / MAP_PINNED / MANAGED host-memory
+strategies (``cpp/bench/neighbors/knn.cuh:380-389``) — indexes larger
+than device memory live in host RAM and the working set migrates per
+batch. TPU-native equivalent: inverted lists stay in **host numpy**
+(51 GB of 100M×128 f32 does not fit a 16 GB v5e chip); per search batch,
+only the UNION OF PROBED LISTS is shipped to HBM and scored with the
+same fine-phase GEMM as the resident index. For online/small-batch
+serving the union is a small fraction of the database, so HBM holds
+O(probed) bytes instead of O(n).
+
+Complements (not replaces) the sharded path: `raft_tpu.parallel.ivf`
+scales by adding chips; this scales a single chip beyond its HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_flat as _ivf_flat
+from raft_tpu.neighbors.ivf_flat import (
+    Index,
+    SearchParams,
+    _coarse_scores,
+    _fine_phase,
+    _metric_kind,
+    _postprocess,
+)
+
+
+@dataclass
+class HostIvfFlat:
+    """IVF-Flat index with device-resident centers and host-resident
+    lists. Build normally (possibly shard-by-shard), then `to_host`."""
+
+    centers: jax.Array              # (n_lists, dim) — stays on device
+    lists_data: np.ndarray          # (n_lists, max_list, dim) host
+    lists_norms: np.ndarray         # (n_lists, max_list) host
+    lists_indices: np.ndarray       # (n_lists, max_list) host
+    metric: DistanceType
+    size: int
+    scale: float = 1.0
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+
+def to_host(index: Index) -> HostIvfFlat:
+    """Demote an IVF-Flat index's lists to host memory (device keeps only
+    the coarse centers, O(n_lists·dim))."""
+    return HostIvfFlat(
+        centers=index.centers,
+        lists_data=np.asarray(index.lists_data),
+        lists_norms=np.asarray(index.lists_norms),
+        lists_indices=np.asarray(index.lists_indices),
+        metric=index.metric, size=index.size, scale=index.scale)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "sqrt", "kind"))
+def _probe_scan(queries, sub_data, sub_norms, sub_indices, probe_pos,
+                scale, k: int, sqrt: bool, kind: str):
+    """The shared probe-major fine phase over the fetched sub-lists."""
+    return _fine_phase(queries, sub_data, sub_norms, sub_indices,
+                       probe_pos, scale, k, sqrt, kind)
+
+
+def search(index: HostIvfFlat, queries, k: int,
+           params: SearchParams = SearchParams(), res=None
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Search a host-resident index: coarse phase on device, fetch the
+    union of probed lists host→HBM, fine phase on device (the shared
+    ``ivf_flat._fine_phase`` with probe ids remapped into the union).
+
+    Peak HBM per batch: ``n_unique_probed · max_list · dim`` bytes —
+    bounded by the probe working set, never by the database size. Query
+    sets above MAX_QUERY_BATCH are batched (each batch fetches its own
+    union, keeping the bound per batch); the fetched union is padded to
+    the next power of two of unique lists so jit shapes bucket instead
+    of recompiling per batch.
+    """
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == index.dim, "host ivf search: dim mismatch")
+    from raft_tpu.neighbors.ann_types import (MAX_QUERY_BATCH,
+                                              batched_search)
+    if q.shape[0] > MAX_QUERY_BATCH:
+        return batched_search(
+            lambda qb: search(index, qb, k, params, res=res), q)
+    n_probes = min(params.n_probes, index.n_lists)
+    kind = _metric_kind(index.metric)
+    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
+                            DistanceType.L2SqrtUnexpanded)
+    if index.metric == DistanceType.CosineExpanded:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                            1e-30)
+
+    # coarse phase on device (centers are resident)
+    coarse = _coarse_scores(q, index.centers, kind)
+    _, probes = lax.top_k(-coarse, n_probes)      # (nq, n_probes)
+    probes_np = np.asarray(probes)
+
+    # host side: union of probed lists, fetched once per batch
+    uniq, inv = np.unique(probes_np, return_inverse=True)
+    u = len(uniq)
+    up = 1 << max(u - 1, 0).bit_length() if u else 1   # pow2 bucket
+    pad = up - u
+    sel = np.concatenate([uniq, np.zeros(pad, uniq.dtype)]) if pad else uniq
+    sub_data = jnp.asarray(index.lists_data[sel])
+    sub_norms = jnp.asarray(index.lists_norms[sel])
+    sub_idx = np.asarray(index.lists_indices[sel])
+    if pad:
+        sub_idx = sub_idx.copy()
+        sub_idx[u:] = -1                           # pad lists never match
+    probe_pos = jnp.asarray(inv.reshape(probes_np.shape).astype(np.int32))
+
+    d, i = _probe_scan(q, sub_data, sub_norms, jnp.asarray(sub_idx),
+                       probe_pos, jnp.float32(index.scale), k=k,
+                       sqrt=sqrt, kind=kind)
+    return _postprocess(d, index.metric), i

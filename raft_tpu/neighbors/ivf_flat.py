@@ -301,20 +301,16 @@ def _score_probe(queries, qq, lists_data, lists_norms, lists_indices,
     return jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf), ids
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("k", "n_probes", "sqrt", "kind"))
-def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
-                 scale, k: int, n_probes: int, sqrt: bool,
-                 kind: str = "l2"):
-    nq, dim = queries.shape
-
-    # ---- coarse phase (reference ivf_flat_search.cuh:1070-1147):
-    # query×centers GEMM + top-k probes
+def _fine_phase(queries, lists_data, lists_norms, lists_indices, probes,
+                scale, k: int, sqrt: bool, kind: str):
+    """Probe-major fine phase: scan over probe rank, each rank one
+    batched GEMM + top-k merge. ``probes`` may hold list ids OR positions
+    into a fetched sub-list table (the host-memory path) — the math is
+    identical, which is why this is the single shared definition."""
+    nq = queries.shape[0]
+    n_probes = probes.shape[1]
     qq = jnp.sum(queries * queries, axis=1)
-    coarse = _coarse_scores(queries, centers, kind)
-    _, probes = lax.top_k(-coarse, n_probes)  # (nq, n_probes)
 
-    # ---- fine phase: scan over probe rank; each rank is one batched GEMM
     def probe_step(carry, p):
         best_d, best_i = carry
         d, ids = _score_probe(queries, qq, lists_data, lists_norms,
@@ -331,6 +327,19 @@ def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
     if sqrt:
         d = jnp.sqrt(jnp.maximum(d, 0.0))
     return d, i
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "n_probes", "sqrt", "kind"))
+def _search_impl(queries, centers, lists_data, lists_indices, lists_norms,
+                 scale, k: int, n_probes: int, sqrt: bool,
+                 kind: str = "l2"):
+    # ---- coarse phase (reference ivf_flat_search.cuh:1070-1147):
+    # query×centers GEMM + top-k probes
+    coarse = _coarse_scores(queries, centers, kind)
+    _, probes = lax.top_k(-coarse, n_probes)  # (nq, n_probes)
+    return _fine_phase(queries, lists_data, lists_norms, lists_indices,
+                       probes, scale, k, sqrt, kind)
 
 
 def search(index: Index, queries, k: int,
