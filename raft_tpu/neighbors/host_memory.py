@@ -28,15 +28,21 @@ from jax import lax
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.neighbors import ivf_flat as _ivf_flat
 from raft_tpu.neighbors.ivf_flat import (
     Index,
+    IndexParams,
     SearchParams,
     _coarse_scores,
     _fine_phase,
     _metric_kind,
     _postprocess,
 )
+
+
+def _fetch(a):
+    """Host→device transfer point (module-local so tests can observe
+    fetch sizes without touching jax.numpy globally)."""
+    return jnp.asarray(a)
 
 
 @dataclass
@@ -63,13 +69,74 @@ class HostIvfFlat:
 
 def to_host(index: Index) -> HostIvfFlat:
     """Demote an IVF-Flat index's lists to host memory (device keeps only
-    the coarse centers, O(n_lists·dim))."""
+    the coarse centers, O(n_lists·dim)). For datasets that never fit the
+    device in the first place, use :func:`build` instead."""
     return HostIvfFlat(
         centers=index.centers,
         lists_data=np.asarray(index.lists_data),
         lists_norms=np.asarray(index.lists_norms),
         lists_indices=np.asarray(index.lists_indices),
         metric=index.metric, size=index.size, scale=index.scale)
+
+
+def build(dataset, params: IndexParams = IndexParams(),
+          chunk_rows: int = 1 << 20, train_rows: int = 1 << 18,
+          seed: int = 0, res=None) -> HostIvfFlat:
+    """Build a host-resident index WITHOUT ever materializing the dataset
+    (or the lists) on device — the construction path for indexes larger
+    than HBM.
+
+    The coarse centers train on a ``train_rows`` device subsample; then
+    the dataset streams through the chip in ``chunk_rows`` slices (label
+    + norm per chunk on device, O(chunk) HBM), while the inverted lists
+    assemble **on the host** in numpy. Labeling shares the same
+    ``predict`` as the resident build, so with equal centers the list
+    membership is identical.
+    """
+    from raft_tpu.cluster import kmeans_balanced
+
+    x = np.asarray(dataset, dtype=np.float32)
+    n, dim = x.shape
+    expects(params.n_lists <= n, "host ivf build: n_lists > n_samples")
+
+    rng = np.random.default_rng(seed)
+    t_rows = min(n, train_rows)
+    sub = x[rng.choice(n, t_rows, replace=False)] if t_rows < n else x
+    centers = kmeans_balanced.build_hierarchical(
+        jnp.asarray(sub), params.n_lists, params.kmeans_n_iters, res=res)
+
+    per_list_rows = [[] for _ in range(params.n_lists)]
+    per_list_ids = [[] for _ in range(params.n_lists)]
+    for start in range(0, n, chunk_rows):
+        chunk = x[start:start + chunk_rows]
+        labels = np.asarray(
+            kmeans_balanced.predict(jnp.asarray(chunk), centers, res=res))
+        order = np.argsort(labels, kind="stable")
+        sorted_labels = labels[order]
+        bounds = np.searchsorted(sorted_labels,
+                                 np.arange(params.n_lists + 1))
+        for l in range(params.n_lists):
+            rows = order[bounds[l]:bounds[l + 1]]
+            if rows.size:
+                per_list_rows[l].append(chunk[rows])
+                per_list_ids[l].append((start + rows).astype(np.int32))
+
+    counts = np.asarray([sum(a.shape[0] for a in r)
+                         for r in per_list_rows], np.int32)
+    max_list = max(8, int(-(-int(counts.max()) // 8) * 8))
+    lists_data = np.zeros((params.n_lists, max_list, dim), np.float32)
+    lists_idx = np.full((params.n_lists, max_list), -1, np.int32)
+    for l in range(params.n_lists):
+        if per_list_rows[l]:
+            rows = np.concatenate(per_list_rows[l], axis=0)
+            ids = np.concatenate(per_list_ids[l])
+            lists_data[l, :rows.shape[0]] = rows
+            lists_idx[l, :rows.shape[0]] = ids
+    norms = (lists_data.astype(np.float64) ** 2).sum(-1).astype(np.float32)
+    norms[lists_idx < 0] = 0.0
+    return HostIvfFlat(centers=centers, lists_data=lists_data,
+                       lists_norms=norms, lists_indices=lists_idx,
+                       metric=params.metric, size=n, scale=1.0)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "sqrt", "kind"))
@@ -87,12 +154,11 @@ def search(index: HostIvfFlat, queries, k: int,
     union of probed lists host→HBM, fine phase on device (the shared
     ``ivf_flat._fine_phase`` with probe ids remapped into the union).
 
-    Peak HBM per batch: ``n_unique_probed · max_list · dim`` bytes —
-    bounded by the probe working set, never by the database size. Query
-    sets above MAX_QUERY_BATCH are batched (each batch fetches its own
-    union, keeping the bound per batch); the fetched union is padded to
-    the next power of two of unique lists so jit shapes bucket instead
-    of recompiling per batch.
+    Peak HBM per batch: ``pow2_ceil(n_unique_probed) · max_list · dim``
+    bytes (the pow2 ceiling — up to 2× the unique count — buys jit shape
+    bucketing; pad slots transfer zeros) — bounded by the probe working
+    set, never by the database size. Query sets above MAX_QUERY_BATCH
+    are batched, each batch fetching its own union.
     """
     q = as_array(queries).astype(jnp.float32)
     expects(q.shape[1] == index.dim, "host ivf search: dim mismatch")
@@ -114,21 +180,30 @@ def search(index: HostIvfFlat, queries, k: int,
     _, probes = lax.top_k(-coarse, n_probes)      # (nq, n_probes)
     probes_np = np.asarray(probes)
 
-    # host side: union of probed lists, fetched once per batch
+    # host side: union of probed lists, fetched once per batch; pad
+    # slots (pow2 bucketing) transfer zeros with -1 ids, never real data
     uniq, inv = np.unique(probes_np, return_inverse=True)
     u = len(uniq)
     up = 1 << max(u - 1, 0).bit_length() if u else 1   # pow2 bucket
     pad = up - u
-    sel = np.concatenate([uniq, np.zeros(pad, uniq.dtype)]) if pad else uniq
-    sub_data = jnp.asarray(index.lists_data[sel])
-    sub_norms = jnp.asarray(index.lists_norms[sel])
-    sub_idx = np.asarray(index.lists_indices[sel])
+    sub_data_np = index.lists_data[uniq]
+    sub_norms_np = index.lists_norms[uniq]
+    sub_idx_np = index.lists_indices[uniq]
     if pad:
-        sub_idx = sub_idx.copy()
-        sub_idx[u:] = -1                           # pad lists never match
+        zshape = (pad,) + sub_data_np.shape[1:]
+        sub_data_np = np.concatenate(
+            [sub_data_np, np.zeros(zshape, sub_data_np.dtype)])
+        sub_norms_np = np.concatenate(
+            [sub_norms_np, np.zeros((pad,) + sub_norms_np.shape[1:],
+                                    sub_norms_np.dtype)])
+        sub_idx_np = np.concatenate(
+            [sub_idx_np, np.full((pad,) + sub_idx_np.shape[1:], -1,
+                                 sub_idx_np.dtype)])
+    sub_data = _fetch(sub_data_np)
+    sub_norms = _fetch(sub_norms_np)
     probe_pos = jnp.asarray(inv.reshape(probes_np.shape).astype(np.int32))
 
-    d, i = _probe_scan(q, sub_data, sub_norms, jnp.asarray(sub_idx),
+    d, i = _probe_scan(q, sub_data, sub_norms, _fetch(sub_idx_np),
                        probe_pos, jnp.float32(index.scale), k=k,
                        sqrt=sqrt, kind=kind)
     return _postprocess(d, index.metric), i

@@ -1,0 +1,78 @@
+"""Tests for raft_tpu.util (the reference's raft/util device helpers:
+Pow2, Cache, scatter, seive — SURVEY.md §2.1 row 8)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.util.pow2_utils import (Pow2, is_pow2, round_up_pow2,
+                                      round_down_pow2)
+from raft_tpu.util.scatter import scatter, scatter_if
+from raft_tpu.util.seive import Seive
+from raft_tpu.util.cache import VecCache
+
+
+class TestPow2:
+    def test_predicates_and_rounding(self):
+        assert is_pow2(64) and not is_pow2(48) and not is_pow2(0)
+        assert round_up_pow2(65, 64) == 128
+        assert round_down_pow2(65, 64) == 64
+        assert round_up_pow2(64, 64) == 64
+
+    def test_pow2_ops(self):
+        p = Pow2(16)
+        assert p.mask == 15 and p.log2 == 4
+        assert p.round_up(17) == 32 and p.round_down(17) == 16
+        assert p.mod(19) == 3 and p.div(35) == 2
+        assert p.is_multiple(48) and not p.is_multiple(50)
+        with pytest.raises(Exception):
+            Pow2(12)
+
+
+class TestScatter:
+    def test_scatter_and_scatter_if(self):
+        vals = jnp.asarray([10.0, 20.0, 30.0])
+        idx = jnp.asarray([2, 0, 1])
+        out = np.asarray(scatter(vals, idx))
+        np.testing.assert_allclose(out, [20.0, 30.0, 10.0])
+        pred = jnp.asarray([True, False, True])
+        out = np.asarray(scatter_if(vals, idx, pred, out_len=4, fill=-1.0))
+        np.testing.assert_allclose(out, [-1.0, 30.0, 10.0, -1.0])
+
+
+class TestSeive:
+    def test_primes(self):
+        s = Seive(100)
+        primes = [p for p in range(2, 100) if s.is_prime(p)]
+        assert primes[:10] == [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+        assert not s.is_prime(1) and not s.is_prime(91)  # 7*13
+
+
+class TestVecCache:
+    def test_store_lookup_roundtrip(self, rng_np):
+        cache = VecCache.create(n_vec=8, n_sets=4, associativity=2)
+        keys = jnp.asarray([4, 9, 14], jnp.int32)  # distinct sets 0,1,2
+        vecs = jnp.asarray(rng_np.random((3, 8)).astype(np.float32))
+        cache = cache.store(keys, vecs)
+        out, found, cache = cache.lookup(keys)
+        assert bool(found.all())
+        np.testing.assert_allclose(np.asarray(out), np.asarray(vecs),
+                                   rtol=1e-6)
+        _, found, cache = cache.lookup(jnp.asarray([99], jnp.int32))
+        assert not bool(found.any())
+
+    def test_lru_eviction_within_set(self, rng_np):
+        # associativity 2: storing 3 keys in one set evicts the LRU
+        cache = VecCache.create(n_vec=4, n_sets=1, associativity=2)
+        v = jnp.asarray(rng_np.random((1, 4)).astype(np.float32))
+        cache = cache.store(jnp.asarray([1], jnp.int32), v)
+        cache = cache.store(jnp.asarray([2], jnp.int32), v + 1)
+        # touch key 1 so key 2 becomes LRU
+        _, found, cache = cache.lookup(jnp.asarray([1], jnp.int32))
+        assert bool(found.all())
+        cache = cache.store(jnp.asarray([3], jnp.int32), v + 2)
+        _, found, cache = cache.lookup(jnp.asarray([3], jnp.int32))
+        assert bool(found.all())
+        # key 2 (LRU after key 1 was touched) was evicted, key 1 kept
+        _, found, _ = cache.lookup(jnp.asarray([1, 2], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(found), [True, False])
