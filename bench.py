@@ -67,6 +67,17 @@ def child_main():
     # config API is the only reliable selector
     if "BENCH_PLATFORM" in os.environ:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    if os.environ.get("BENCH_PROBE"):
+        # canary: backend init + one tiny dispatch. A wedged remote-
+        # compile tunnel HANGS here (it does not error), so the parent
+        # probes with a short timeout before committing to full-length
+        # measurement children.
+        jax.devices()
+        import jax.numpy as jnp
+        v = float((jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0])
+        print(json.dumps({"metric": "probe", "value": v, "unit": "ok"}),
+              flush=True)
+        return 0
     _init_backend_with_retry(jax)
     import jax.numpy as jnp
 
@@ -185,7 +196,27 @@ def _run_child(extra_env, timeout_s):
 
 def parent_main():
     errors = []
-    for attempt in range(TPU_ATTEMPTS):
+    # canary first: a wedged tunnel hangs (never errors) at first
+    # dispatch, and burning TPU_ATTEMPTS × CHILD_TIMEOUT on hangs could
+    # outlive the driver's budget. A short probe decides in minutes.
+    probe, perr = _run_child({"BENCH_PROBE": "1"},
+                             float(os.environ.get("BENCH_PROBE_TIMEOUT",
+                                                  240)))
+    tpu_attempts = TPU_ATTEMPTS
+    if probe is None:
+        errors.append(f"probe: {perr}")
+        if "child timeout" in (perr or ""):
+            # a HANG means the remote-compile tunnel is wedged: retries
+            # would burn the whole budget hanging. Fast init ERRORS stay
+            # on the retry path — they are the transient failures the
+            # backoff loop exists for (round-1 postmortem).
+            print(f"# bench TPU probe hung ({perr}); degrading early",
+                  file=sys.stderr)
+            tpu_attempts = 0
+        else:
+            print(f"# bench TPU probe errored ({perr}); keeping retries",
+                  file=sys.stderr)
+    for attempt in range(tpu_attempts):
         if attempt:
             time.sleep(TPU_BACKOFF_S[min(attempt - 1,
                                          len(TPU_BACKOFF_S) - 1)])

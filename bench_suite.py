@@ -173,8 +173,72 @@ def bench_linalg_random(results):
                     "value": round(t * 1e3, 1), "unit": "ms"})
 
 
+def bench_ball_cover(results):
+    # reference cpp/bench has no rbc case; recall-gated timing mirrors
+    # the ANN cases (pruned exact search vs fixed-budget)
+    import jax
+    from raft_tpu.neighbors import ball_cover
+    key = jax.random.key(6)
+    n, d, nq, k = 200_000, 16, 1000, 10
+    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    t_b0 = time.perf_counter()
+    index = ball_cover.build(db)
+    _sync(index.landmarks)
+    t_b = time.perf_counter() - t_b0
+    t = _time(lambda: ball_cover.knn_query(index, q, k), reps=3)
+    results.append({
+        "metric": f"ball_cover_pruned_{n//1000}kx{d}_q{nq}_k{k}_qps",
+        "value": round(nq / t, 1), "unit": "queries/s",
+        "build_s": round(t_b, 2)})
+
+
+def bench_sparse_wide(results):
+    # the hash-strategy slot: 100k-dim sparse rows, column-tiled tier
+    import numpy as np_
+    from raft_tpu.sparse import dense_to_csr
+    from raft_tpu.sparse.distance import pairwise_distance as sp_dist
+    from raft_tpu.distance.distance_types import DistanceType
+    rng = np_.random.default_rng(7)
+    m, n, kdim, nnz = 512, 256, 100_000, 64
+    def make(rows):
+        d = np_.zeros((rows, kdim), np_.float32)
+        cols = rng.integers(0, kdim, (rows, nnz))
+        d[np_.arange(rows)[:, None], cols] = rng.random((rows, nnz))
+        return dense_to_csr(d)
+    cx, cy = make(m), make(n)
+    t = _time(lambda: sp_dist(cx, cy, DistanceType.L2SqrtExpanded,
+                              col_tile=4096), reps=3)
+    results.append({
+        "metric": f"sparse_wide_l2_{m}x{n}x{kdim//1000}kdim_ms",
+        "value": round(t * 1e3, 1), "unit": "ms"})
+
+
+def bench_host_ivf(results):
+    # the host-memory transfer axis (reference knn.cuh host strategies)
+    import numpy as np_
+    import jax
+    from raft_tpu.neighbors import host_memory, ivf_flat
+    rng = np_.random.default_rng(8)
+    n, d, nq, k = 200_000, 64, 256, 10
+    x = rng.standard_normal((n, d), dtype=np_.float32)
+    t_b0 = time.perf_counter()
+    h = host_memory.build(x, ivf_flat.IndexParams(n_lists=512,
+                                                  kmeans_n_iters=10),
+                          chunk_rows=1 << 17)
+    t_b = time.perf_counter() - t_b0
+    q = x[:nq]
+    t = _time(lambda: host_memory.search(
+        h, q, k, ivf_flat.SearchParams(n_probes=32)), reps=3)
+    results.append({
+        "metric": f"host_ivf_search_{n//1000}kx{d}_q{nq}_k{k}_p32_qps",
+        "value": round(nq / t, 1), "unit": "queries/s",
+        "build_s": round(t_b, 2)})
+
+
 _CASES = [bench_pairwise_distance, bench_fused_l2_nn, bench_select_k,
-          bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_linalg_random]
+          bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_linalg_random,
+          bench_ball_cover, bench_sparse_wide, bench_host_ivf]
 
 
 def run_all(cases=None):

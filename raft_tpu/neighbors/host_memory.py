@@ -105,35 +105,42 @@ def build(dataset, params: IndexParams = IndexParams(),
     centers = kmeans_balanced.build_hierarchical(
         jnp.asarray(sub), params.n_lists, params.kmeans_n_iters, res=res)
 
-    per_list_rows = [[] for _ in range(params.n_lists)]
-    per_list_ids = [[] for _ in range(params.n_lists)]
+    # pass 1: labels only (n·4 bytes of bookkeeping) — keeps peak host
+    # memory at dataset + padded lists, not 3× the dataset
+    labels_all = np.empty(n, np.int32)
     for start in range(0, n, chunk_rows):
         chunk = x[start:start + chunk_rows]
-        labels = np.asarray(
+        labels_all[start:start + chunk.shape[0]] = np.asarray(
             kmeans_balanced.predict(jnp.asarray(chunk), centers, res=res))
+
+    counts = np.bincount(labels_all, minlength=params.n_lists)
+    max_list = max(8, int(-(-int(counts.max()) // 8) * 8))
+    lists_data = np.zeros((params.n_lists, max_list, dim), np.float32)
+    lists_idx = np.full((params.n_lists, max_list), -1, np.int32)
+
+    # pass 2: place rows directly into their list slots (per-list write
+    # cursors), chunk by chunk — no intermediate per-list copies
+    cursor = np.zeros(params.n_lists, np.int64)
+    for start in range(0, n, chunk_rows):
+        chunk = x[start:start + chunk_rows]
+        labels = labels_all[start:start + chunk.shape[0]]
         order = np.argsort(labels, kind="stable")
-        sorted_labels = labels[order]
-        bounds = np.searchsorted(sorted_labels,
+        bounds = np.searchsorted(labels[order],
                                  np.arange(params.n_lists + 1))
         for l in range(params.n_lists):
             rows = order[bounds[l]:bounds[l + 1]]
             if rows.size:
-                per_list_rows[l].append(chunk[rows])
-                per_list_ids[l].append((start + rows).astype(np.int32))
+                c = cursor[l]
+                lists_data[l, c:c + rows.size] = chunk[rows]
+                lists_idx[l, c:c + rows.size] = (start + rows)
+                cursor[l] += rows.size
 
-    counts = np.asarray([sum(a.shape[0] for a in r)
-                         for r in per_list_rows], np.int32)
-    max_list = max(8, int(-(-int(counts.max()) // 8) * 8))
-    lists_data = np.zeros((params.n_lists, max_list, dim), np.float32)
-    lists_idx = np.full((params.n_lists, max_list), -1, np.int32)
-    for l in range(params.n_lists):
-        if per_list_rows[l]:
-            rows = np.concatenate(per_list_rows[l], axis=0)
-            ids = np.concatenate(per_list_ids[l])
-            lists_data[l, :rows.shape[0]] = rows
-            lists_idx[l, :rows.shape[0]] = ids
-    norms = (lists_data.astype(np.float64) ** 2).sum(-1).astype(np.float32)
-    norms[lists_idx < 0] = 0.0
+    # norms in list blocks: O(block·max_list·dim) f64 temporaries only
+    norms = np.empty((params.n_lists, max_list), np.float32)
+    blk = 64
+    for l0 in range(0, params.n_lists, blk):
+        seg = lists_data[l0:l0 + blk].astype(np.float64)
+        norms[l0:l0 + blk] = (seg * seg).sum(-1).astype(np.float32)
     return HostIvfFlat(centers=centers, lists_data=lists_data,
                        lists_norms=norms, lists_indices=lists_idx,
                        metric=params.metric, size=n, scale=1.0)
@@ -186,19 +193,22 @@ def search(index: HostIvfFlat, queries, k: int,
     u = len(uniq)
     up = 1 << max(u - 1, 0).bit_length() if u else 1   # pow2 bucket
     pad = up - u
-    sub_data_np = index.lists_data[uniq]
-    sub_norms_np = index.lists_norms[uniq]
-    sub_idx_np = index.lists_indices[uniq]
     if pad:
-        zshape = (pad,) + sub_data_np.shape[1:]
-        sub_data_np = np.concatenate(
-            [sub_data_np, np.zeros(zshape, sub_data_np.dtype)])
-        sub_norms_np = np.concatenate(
-            [sub_norms_np, np.zeros((pad,) + sub_norms_np.shape[1:],
-                                    sub_norms_np.dtype)])
-        sub_idx_np = np.concatenate(
-            [sub_idx_np, np.full((pad,) + sub_idx_np.shape[1:], -1,
-                                 sub_idx_np.dtype)])
+        # preallocate the padded buffers once and fill the head — one
+        # copy per batch, not fancy-index + concatenate (two)
+        sub_data_np = np.zeros((up,) + index.lists_data.shape[1:],
+                               index.lists_data.dtype)
+        np.take(index.lists_data, uniq, axis=0, out=sub_data_np[:u])
+        sub_norms_np = np.zeros((up,) + index.lists_norms.shape[1:],
+                                index.lists_norms.dtype)
+        np.take(index.lists_norms, uniq, axis=0, out=sub_norms_np[:u])
+        sub_idx_np = np.full((up,) + index.lists_indices.shape[1:], -1,
+                             index.lists_indices.dtype)
+        np.take(index.lists_indices, uniq, axis=0, out=sub_idx_np[:u])
+    else:
+        sub_data_np = index.lists_data[uniq]
+        sub_norms_np = index.lists_norms[uniq]
+        sub_idx_np = index.lists_indices[uniq]
     sub_data = _fetch(sub_data_np)
     sub_norms = _fetch(sub_norms_np)
     probe_pos = jnp.asarray(inv.reshape(probes_np.shape).astype(np.int32))
