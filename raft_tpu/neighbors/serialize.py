@@ -120,13 +120,42 @@ def load_ivf_pq(path: str):
     return index
 
 
+def save_host_ivf_flat(index, path: str) -> None:
+    """Write a host-resident :class:`host_memory.HostIvfFlat`. The list
+    arrays stream from host numpy — nothing touches the device."""
+    _pack(path, "host_ivf_flat",
+          {"metric": int(index.metric), "size": int(index.size),
+           "scale": float(index.scale)},
+          {"centers": index.centers, "lists_data": index.lists_data,
+           "lists_indices": index.lists_indices,
+           "lists_norms": index.lists_norms})
+
+
+def load_host_ivf_flat(path: str):
+    """Read a host-resident index: lists stay in host numpy; only the
+    coarse centers go to device."""
+    from raft_tpu.neighbors.host_memory import HostIvfFlat
+    meta, a = _unpack(path, "host_ivf_flat")
+    return HostIvfFlat(
+        centers=jnp.asarray(a["centers"]),
+        lists_data=np.asarray(a["lists_data"]),
+        lists_norms=np.asarray(a["lists_norms"]),
+        lists_indices=np.asarray(a["lists_indices"]),
+        metric=DistanceType(meta["metric"]),
+        size=meta["size"],
+        scale=float(meta.get("scale", 1.0)))
+
+
 def save(index, path: str) -> None:
     """Type-dispatching save for any supported ANN index."""
     from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.neighbors.host_memory import HostIvfFlat
     if isinstance(index, ivf_flat.Index):
         save_ivf_flat(index, path)
     elif isinstance(index, ivf_pq.Index):
         save_ivf_pq(index, path)
+    elif isinstance(index, HostIvfFlat):
+        save_host_ivf_flat(index, path)
     else:
         raise TypeError(f"serialize.save: unsupported index {type(index)}")
 
@@ -141,4 +170,6 @@ def load(path: str):
         return load_ivf_flat(path)
     if fmt == "ivf_pq":
         return load_ivf_pq(path)
+    if fmt == "host_ivf_flat":
+        return load_host_ivf_flat(path)
     raise ValueError(f"serialize.load: unknown format {fmt!r} in {path}")

@@ -614,3 +614,17 @@ class TestHostResidentIvf:
         _, iref = nn.kneighbors(q)
         assert recall(np.asarray(i1), iref) > 0.999
         assert h1.size == len(x)
+
+    def test_host_index_serialize_roundtrip(self, dataset, tmp_path):
+        from raft_tpu.neighbors import host_memory, serialize
+        x, q = dataset
+        h = host_memory.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                      kmeans_n_iters=4))
+        p = str(tmp_path / "host.rtpu")
+        serialize.save(h, p)
+        h2 = serialize.load(p)
+        assert isinstance(h2.lists_data, np.ndarray)  # stays host-side
+        sp = ivf_flat.SearchParams(n_probes=8)
+        d1, i1 = host_memory.search(h, q, 5, sp)
+        d2, i2 = host_memory.search(h2, q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
