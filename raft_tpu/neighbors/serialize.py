@@ -146,9 +146,31 @@ def load_host_ivf_flat(path: str):
         scale=float(meta.get("scale", 1.0)))
 
 
+def save_ball_cover(index, path: str) -> None:
+    """Write a :class:`ball_cover.BallCoverIndex`."""
+    _pack(path, "ball_cover",
+          {"metric": int(index.metric), "size": int(index.size)},
+          {"landmarks": index.landmarks, "lists_data": index.lists_data,
+           "lists_indices": index.lists_indices, "radii": index.radii})
+
+
+def load_ball_cover(path: str):
+    """Read a ball-cover index written by :func:`save_ball_cover`."""
+    from raft_tpu.neighbors.ball_cover import BallCoverIndex
+    meta, a = _unpack(path, "ball_cover")
+    return BallCoverIndex(
+        landmarks=jnp.asarray(a["landmarks"]),
+        lists_data=jnp.asarray(a["lists_data"]),
+        lists_indices=jnp.asarray(a["lists_indices"]),
+        radii=jnp.asarray(a["radii"]),
+        metric=DistanceType(meta["metric"]),
+        size=meta["size"])
+
+
 def save(index, path: str) -> None:
     """Type-dispatching save for any supported ANN index."""
     from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.neighbors.ball_cover import BallCoverIndex
     from raft_tpu.neighbors.host_memory import HostIvfFlat
     if isinstance(index, ivf_flat.Index):
         save_ivf_flat(index, path)
@@ -156,6 +178,8 @@ def save(index, path: str) -> None:
         save_ivf_pq(index, path)
     elif isinstance(index, HostIvfFlat):
         save_host_ivf_flat(index, path)
+    elif isinstance(index, BallCoverIndex):
+        save_ball_cover(index, path)
     else:
         raise TypeError(f"serialize.save: unsupported index {type(index)}")
 
@@ -172,4 +196,6 @@ def load(path: str):
         return load_ivf_pq(path)
     if fmt == "host_ivf_flat":
         return load_host_ivf_flat(path)
+    if fmt == "ball_cover":
+        return load_ball_cover(path)
     raise ValueError(f"serialize.load: unknown format {fmt!r} in {path}")

@@ -628,3 +628,16 @@ class TestHostResidentIvf:
         d1, i1 = host_memory.search(h, q, 5, sp)
         d2, i2 = host_memory.search(h2, q, 5, sp)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestBallCoverSerialize:
+    def test_roundtrip(self, dataset, tmp_path):
+        from raft_tpu.neighbors import serialize
+        x, q = dataset
+        idx = ball_cover.build(x, n_landmarks=16)
+        p = str(tmp_path / "bc.rtpu")
+        serialize.save(idx, p)
+        idx2 = serialize.load(p)
+        d1, i1 = ball_cover.knn_query(idx, q, 5)
+        d2, i2 = ball_cover.knn_query(idx2, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
