@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Staged TPU measurement sequence (run when the axon tunnel is healthy).
+# Writes one log per stage under tools/measure_out/. Never kill a stage
+# mid-compile: a killed remote compile wedges the tunnel for hours
+# (see .claude/skills/verify) — stages get generous timeouts instead.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
+OUT=tools/measure_out
+mkdir -p "$OUT"
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+(jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()
+print('tunnel healthy:', jax.devices())" 2>&1 | tail -n1
+}
+
+echo "== probe"; probe | tee "$OUT/probe.log"
+grep -q "tunnel healthy" "$OUT/probe.log" || { echo "tunnel down; abort"; exit 1; }
+
+echo "== 1. IVF-Flat phase profile (rows gather)"
+timeout 2400 python tools/profile_ivf_flat.py 2>&1 | tee "$OUT/ivf_flat_rows.log"
+
+echo "== 2. gather A/B (onehot)"
+RAFT_TPU_GATHER=onehot timeout 2400 python tools/profile_ivf_flat.py \
+  2>&1 | tee "$OUT/ivf_flat_onehot.log"
+
+echo "== 3. IVF-PQ scan modes (in-kernel decode vs reconstruct vs lut)"
+timeout 2400 python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_modes.log"
+import time, jax
+import jax.numpy as jnp
+from raft_tpu.neighbors import ivf_pq
+key = jax.random.key(0)
+n, d, nq, k = 500_000, 128, 1000, 32
+db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+t0 = time.perf_counter()
+idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024))
+jax.block_until_ready(idx.codes)
+print("build", round(time.perf_counter() - t0, 1), "s")
+def timed(fn, reps=5):
+    o = fn(); jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    outs = [fn() for _ in range(reps)]
+    jax.block_until_ready(outs)
+    return (time.perf_counter() - t0) / reps
+for mode in ("codes", "reconstruct"):
+    sp = ivf_pq.SearchParams(n_probes=64, scan_mode=mode)
+    t = timed(lambda: ivf_pq.search(idx, q, k, sp))
+    print(f"ivf_pq {mode}: {t*1000:.1f} ms -> {nq/t:.0f} QPS")
+EOF
+
+echo "== 4. gated bench suite"
+timeout 3000 python bench_suite.py --gate 2>&1 | tee "$OUT/suite.log"
+
+echo "== 5. headline bench"
+timeout 1800 python bench.py 2>&1 | tee "$OUT/headline.log"
+
+echo "== done; update BASELINE.md + PERF_GATES + ivf_pq auto default from $OUT"
