@@ -94,13 +94,18 @@ class Index:
     centers: jax.Array            # (n_lists, dim) cluster centers
     centers_rot: jax.Array        # (n_lists, rot_dim) rotated centers
     rotation_matrix: jax.Array    # (rot_dim, dim)
-    pq_centers: jax.Array         # PER_SUBSPACE: (pq_dim, 2^bits, pq_len)
+    # PER_SUBSPACE: (pq_dim, 2^bits, pq_len) — one codebook per subspace
+    # PER_CLUSTER:  (n_lists, 2^bits, pq_len) — one codebook per coarse
+    #               cluster, shared across subspaces (reference
+    #               ivf_pq_build.cuh:532 train_per_cluster)
+    pq_centers: jax.Array
     codes: jax.Array              # (n_lists, max_list, pq_dim) uint8
     lists_indices: jax.Array      # (n_lists, max_list) int32, -1 pad
     list_sizes: jax.Array
     metric: DistanceType
     pq_bits: int
     size: int
+    codebook_kind: CodebookGen = CodebookGen.PER_SUBSPACE
     # exact decoded-residual squared norms, (n_lists, max_list) f32:
     # PQ subspaces concatenate orthogonally so the norm is a sum of
     # per-subspace codeword norms — computed once at build. With ids
@@ -123,7 +128,9 @@ class Index:
 
     @property
     def pq_dim(self) -> int:
-        return self.pq_centers.shape[0]
+        # derived from the codes (valid for both codebook kinds; the
+        # pq_centers leading dim is n_lists under PER_CLUSTER)
+        return self.codes.shape[2]
 
     @property
     def pq_len(self) -> int:
@@ -161,6 +168,106 @@ def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
         books.append(kmeans_balanced.balanced_kmeans(
             sub[:, s, :], n_codes, n_iters=n_iters, seed=seed + s))
     return jnp.stack(books)  # (pq_dim, n_codes, pq_len)
+
+
+def _list_chunk(L: int, per_list_elems: int,
+                budget: int = 1 << 26) -> int:
+    """Largest divisor of L whose chunk keeps per_list_elems·chunk under
+    the element budget (bounds the (chunk, M·pq_dim, C) intermediates)."""
+    from raft_tpu.neighbors._ivf_scan import largest_divisor_at_most
+    return largest_divisor_at_most(L, max(1, budget // max(1,
+                                                           per_list_elems)))
+
+
+@functools.partial(jax.jit, static_argnames=("n_codes", "n_iters",
+                                             "chunk"))
+def _batched_masked_kmeans(data, valid, n_codes: int, n_iters: int, key,
+                           chunk: int):
+    """One k-means per leading batch entry over masked rows — the
+    PER_CLUSTER codebook trainer (reference train_per_cluster,
+    ivf_pq_build.cuh:532), shape-bucketed (every cluster trains in one
+    compiled program) and list-chunked (``lax.map`` over groups of
+    ``chunk`` lists bounds the (chunk, M, C) distance blocks).
+
+    data (L, M, D) f32, valid (L, M) bool → (L, n_codes, D) codebooks.
+    Empty slots inherit their initial center (valid rows always win the
+    masked assignment)."""
+    L, M, D = data.shape
+
+    def em_block(args):
+        db, vb, kb = args                                # (G, M, D) ...
+        score = jax.random.uniform(kb, vb.shape) + \
+            jnp.where(vb, 0.0, 2.0)
+        first = jnp.argsort(score, axis=1)[:, :n_codes]
+        centers0 = jnp.take_along_axis(db, first[:, :, None], axis=1)
+
+        def one_iter(c, _):
+            xx = jnp.sum(db * db, axis=2)[:, :, None]
+            cc = jnp.sum(c * c, axis=2)[:, None, :]
+            ip = jnp.einsum("lmd,lcd->lmc", db, c,
+                            preferred_element_type=jnp.float32)
+            d = xx + cc - 2.0 * ip
+            assign = jnp.argmin(d, axis=2)
+            oh = jax.nn.one_hot(assign, n_codes, dtype=jnp.float32)
+            oh = oh * vb[:, :, None]
+            counts = jnp.sum(oh, axis=1)
+            sums = jnp.einsum("lmc,lmd->lcd", oh, db,
+                              preferred_element_type=jnp.float32)
+            newc = sums / jnp.maximum(counts, 1.0)[:, :, None]
+            return jnp.where(counts[:, :, None] > 0, newc, c), None
+
+        c, _ = lax.scan(one_iter, centers0, None, length=n_iters)
+        return c
+
+    keys = jax.random.split(key, L // chunk)
+    out = lax.map(em_block, (data.reshape(-1, chunk, M, D),
+                             valid.reshape(-1, chunk, M), keys))
+    return out.reshape(L, n_codes, D)
+
+
+def _nearest_code(sub, books):
+    """argmin_j ||sub − books[j]||² over the last axis, batched over any
+    leading dims — THE per-cluster encoding equation, shared by build
+    and extend so they can never diverge."""
+    ip = jnp.einsum("...sl,...cl->...sc", sub, books,
+                    preferred_element_type=jnp.float32,
+                    precision=matmul_precision())
+    bb = jnp.sum(books * books, axis=-1)[..., None, :]
+    ss = jnp.sum(sub * sub, axis=-1)[..., :, None]
+    return jnp.argmin(ss + bb - 2.0 * ip, axis=-1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _encode_per_cluster(bucketed_resid, books, chunk: int):
+    """codes[l, i, s] = argmin_j ||sub(l, i, s) − books[l, j]||² over the
+    bucketed rotated residuals (n_lists, max_list, rot_dim), in list
+    chunks."""
+    L, M, rot_dim = bucketed_resid.shape
+    _, n_codes, pq_len = books.shape
+    pq_dim = rot_dim // pq_len
+
+    def enc_block(args):
+        rb, bb_ = args
+        sub = rb.reshape(rb.shape[0], M * pq_dim, pq_len)
+        return _nearest_code(sub, bb_).reshape(rb.shape[0], M, pq_dim)
+
+    out = lax.map(enc_block,
+                  (bucketed_resid.reshape(-1, chunk, M, rot_dim),
+                   books.reshape(-1, chunk, n_codes, pq_len)))
+    return out.reshape(L, M, pq_dim)
+
+
+@jax.jit
+def _code_norms_per_cluster(codes_b, books, lists_indices):
+    """Exact ||decoded||² per slot for PER_CLUSTER books: subspaces share
+    the list's codebook, so the norm is Σ_s ||books_l[c_s]||²."""
+    L, M, pq_dim = codes_b.shape
+    bb = jnp.sum(books * books, axis=2)                  # (L, n_codes)
+    norms = jnp.zeros((L, M), jnp.float32)
+    for s in range(pq_dim):
+        norms = norms + jnp.take_along_axis(
+            bb, codes_b[:, :, s].astype(jnp.int32), axis=1)
+    return jnp.where(lists_indices >= 0, norms, 0.0)
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -220,6 +327,40 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     residuals_rot = jnp.matmul(residuals, rot.T,
                                precision=matmul_precision())
 
+    if params.codebook_kind == CodebookGen.PER_CLUSTER:
+        # one codebook per coarse cluster (reference train_per_cluster):
+        # bucket the rotated residuals, train a batched masked k-means
+        # over every list's pooled subvectors, encode in place
+        bucketed, idx, _, counts = _bucketize(residuals_rot, labels,
+                                              params.n_lists)
+        L, M, _ = bucketed.shape
+        # per-subvector validity: each row contributes pq_dim subvectors
+        valid = jnp.broadcast_to((idx >= 0)[:, :, None],
+                                 (L, M, pq_dim)).reshape(L, -1)
+        sub_all = bucketed.reshape(L, M * pq_dim, pq_len)
+        t_sub = min(M * pq_dim, 4096)  # training subsample per list
+        tr_sub, tr_valid = sub_all[:, :t_sub], valid[:, :t_sub]
+        if t_sub < n_codes:
+            # the trainer seeds n_codes centers from the slice: pad
+            # short lists by cyclic repetition (duplicate seeds are
+            # harmless — empty codewords keep their init)
+            reps = -(-n_codes // t_sub)
+            tr_sub = jnp.tile(tr_sub, (1, reps, 1))[:, :n_codes]
+            tr_valid = jnp.tile(tr_valid, (1, reps))[:, :n_codes]
+        chunk_t = _list_chunk(L, tr_sub.shape[1] * n_codes)
+        books = _batched_masked_kmeans(
+            tr_sub, tr_valid, n_codes,
+            params.kmeans_n_iters, jax.random.key(seed + 2), chunk_t)
+        chunk_e = _list_chunk(L, M * pq_dim * n_codes)
+        codes_b = _encode_per_cluster(bucketed, books, chunk_e)
+        return Index(centers=centers, centers_rot=centers_rot,
+                     rotation_matrix=rot, pq_centers=books, codes=codes_b,
+                     lists_indices=idx, list_sizes=counts,
+                     metric=params.metric, pq_bits=params.pq_bits, size=n,
+                     codebook_kind=CodebookGen.PER_CLUSTER,
+                     code_norms=_code_norms_per_cluster(codes_b, books,
+                                                        idx))
+
     n_cb_train = min(n, 1 << 16)
     if n_cb_train < n:
         cb_sel = jax.random.choice(jax.random.key(seed + 3), n,
@@ -270,7 +411,14 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     residuals_rot = jnp.matmul(x - index.centers[labels],
                                index.rotation_matrix.T,
                                precision=matmul_precision())
-    new_codes = _encode(residuals_rot, index.pq_centers)  # (n_new, pq_dim)
+    if index.codebook_kind == CodebookGen.PER_CLUSTER:
+        # frozen per-list books: encode each new row through its label's
+        # codebook (reference extend with codebook_gen PER_CLUSTER)
+        sub = residuals_rot.reshape(x.shape[0], index.pq_dim,
+                                    index.pq_len)
+        new_codes = _nearest_code(sub, index.pq_centers[labels])
+    else:
+        new_codes = _encode(residuals_rot, index.pq_centers)
 
     # flatten existing valid slots back to (n_old, pq_dim) + their ids
     flat_codes = index.codes.reshape(-1, index.pq_dim)
@@ -289,6 +437,9 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     idx = jnp.where(slot_idx >= 0, all_ids[jnp.clip(slot_idx, 0, None)],
                     jnp.int32(-1))
     codes_b = bucketed.astype(jnp.uint8)
+    norms_fn = (_code_norms_per_cluster
+                if index.codebook_kind == CodebookGen.PER_CLUSTER
+                else _code_norms)
     return Index(centers=index.centers, centers_rot=index.centers_rot,
                  rotation_matrix=index.rotation_matrix,
                  pq_centers=index.pq_centers,
@@ -296,7 +447,8 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
                  lists_indices=idx, list_sizes=counts,
                  metric=index.metric, pq_bits=index.pq_bits,
                  size=n_old + n_new,
-                 code_norms=_code_norms(codes_b, index.pq_centers, idx))
+                 codebook_kind=index.codebook_kind,
+                 code_norms=norms_fn(codes_b, index.pq_centers, idx))
 
 
 @jax.jit
@@ -312,6 +464,22 @@ def _code_norms(codes_b, pq_centers, lists_indices):
         norms = norms + bb[s][flat[:, s]]
     norms = norms.reshape(n_lists, max_list)
     return jnp.where(lists_indices >= 0, norms, 0.0)
+
+
+@jax.jit
+def _decode_lists_per_cluster(codes_b, books, lists_indices):
+    """Decode PER_CLUSTER codes → bf16 reconstruction cache: subspace s
+    of row i in list l decodes through list l's codebook."""
+    L, M, pq_dim = codes_b.shape
+    _, n_codes, pq_len = books.shape
+
+    def one_list(codes_l, book):
+        return book[codes_l.astype(jnp.int32)]        # (M, pq_dim, pl)
+
+    dec = jax.vmap(one_list)(codes_b, books)
+    dec = dec.reshape(L, M, pq_dim * pq_len)
+    valid = (lists_indices >= 0)[:, :, None]
+    return jnp.where(valid, dec, 0.0).astype(jnp.bfloat16)
 
 
 @jax.jit
@@ -394,13 +562,15 @@ def _search_impl_reconstruct(queries, centers, centers_rot, rot, decoded,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "n_probes", "sqrt", "kind"))
+                   static_argnames=("k", "n_probes", "sqrt", "kind",
+                                    "per_cluster"))
 def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
                  lists_indices, k: int, n_probes: int, sqrt: bool,
-                 kind: str = "l2"):
+                 kind: str = "l2", per_cluster: bool = False):
     nq, dim = queries.shape
     n_lists = centers.shape[0]
-    pq_dim, n_codes, pq_len = pq_centers.shape
+    pq_dim = codes.shape[2]
+    n_codes, pq_len = pq_centers.shape[1], pq_centers.shape[2]
 
     # coarse: select_clusters (reference :127)
     from raft_tpu.neighbors.ivf_flat import _coarse_scores
@@ -409,14 +579,16 @@ def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
 
     q_rot = queries @ rot.T  # (nq, rot_dim) (reference :1360 query rotation)
 
-    bb = jnp.sum(pq_centers * pq_centers, axis=2)  # (pq_dim, n_codes)
+    bb = jnp.sum(pq_centers * pq_centers, axis=2)  # (pq_dim|L, n_codes)
 
-    # the IP LUT is probe-independent (no residual): LUT[q, s, j] =
-    # sub_q(q,s)·book[s, j]; the per-probe center term q_rot·c_l is
-    # added after the code gather (reference ip distance dispatch).
-    # Hoisted out of the scan so it runs once, not n_probes times.
+    # the per-subspace IP LUT is probe-independent (no residual):
+    # LUT[q, s, j] = sub_q(q,s)·book[s, j]; the per-probe center term
+    # q_rot·c_l is added after the code gather (reference ip distance
+    # dispatch). Hoisted out of the scan so it runs once, not n_probes
+    # times. PER_CLUSTER books depend on the probed list, so its LUTs
+    # are built inside the scan for both metrics.
     ip_lut = None
-    if kind == "ip":
+    if kind == "ip" and not per_cluster:
         ip_lut = jnp.einsum("qsl,sjl->qsj",
                             q_rot.reshape(nq, pq_dim, pq_len), pq_centers,
                             preferred_element_type=jnp.float32,
@@ -425,7 +597,23 @@ def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
     def probe_step(carry, p):
         best_d, best_i = carry
         list_id = probes[:, p]                           # (nq,)
-        if kind == "ip":
+        if per_cluster:
+            books_l = pq_centers[list_id]                # (nq, C, pl)
+            if kind == "ip":
+                sub = q_rot.reshape(nq, pq_dim, pq_len)
+                lut = jnp.einsum("qsl,qjl->qsj", sub, books_l,
+                                 preferred_element_type=jnp.float32,
+                                 precision=matmul_precision())
+            else:
+                resid = q_rot - centers_rot[list_id]
+                sub = resid.reshape(nq, pq_dim, pq_len)
+                ip = jnp.einsum("qsl,qjl->qsj", sub, books_l,
+                                preferred_element_type=jnp.float32,
+                                precision=matmul_precision())
+                ss = jnp.sum(sub * sub, axis=2)
+                lut = (ss[:, :, None] + bb[list_id][:, None, :]
+                       - 2.0 * ip)
+        elif kind == "ip":
             lut = ip_lut
         else:
             # per-query LUT from the rotated residual wrt this center
@@ -500,6 +688,15 @@ def search(index: Index, queries, k: int,
                             DistanceType.L2SqrtUnexpanded)
     from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
     kind = _metric_kind(index.metric)
+    per_cluster = index.codebook_kind == CodebookGen.PER_CLUSTER
+
+    def _norms(idx_):
+        if idx_.code_norms is None:
+            fn = (_code_norms_per_cluster if per_cluster else _code_norms)
+            idx_.code_norms = fn(idx_.codes, idx_.pq_centers,
+                                 idx_.lists_indices)
+        return idx_.code_norms
+
     scan_mode = params.scan_mode
     if scan_mode == "auto":
         from raft_tpu.ops.dispatch import pallas_enabled
@@ -512,29 +709,24 @@ def search(index: Index, queries, k: int,
         cap = _ivf_scan.probe_cap(probes, index.n_lists)
         q_rot = jnp.matmul(q, index.rotation_matrix.T,
                            precision=matmul_precision())
-        code_norms = index.code_norms
-        if code_norms is None:  # older/deserialized index: derive once
-            code_norms = _code_norms(index.codes, index.pq_centers,
-                                     index.lists_indices)
-            index.code_norms = code_norms
+        code_norms = _norms(index)  # derives once for older indexes
         d, i = ivf_pq_code_scan_pallas(
             q_rot, index.centers_rot, index.pq_centers, index.codes,
             code_norms, index.lists_indices, probes, k, cap,
             bins=params.scan_bins, sqrt=sqrt,
             lut_dtype=params.lut_dtype,
             internal_distance_dtype=params.internal_distance_dtype,
-            metric=kind)
+            metric=kind, per_cluster=per_cluster)
         return _postprocess(d, index.metric), i
     if scan_mode == "reconstruct":
         if index.decoded is None:
-            index.decoded = _decode_lists(
+            dec_fn = (_decode_lists_per_cluster if per_cluster
+                      else _decode_lists)
+            index.decoded = dec_fn(
                 index.codes, index.pq_centers, index.lists_indices)
         if index.decoded_norms is None:
             # alias the exact build-time norms — same quantity, no copy
-            if index.code_norms is None:
-                index.code_norms = _code_norms(
-                    index.codes, index.pq_centers, index.lists_indices)
-            index.decoded_norms = index.code_norms
+            index.decoded_norms = _norms(index)
         nq = q.shape[0]
         from raft_tpu.neighbors.ann_types import list_order_auto
         use_list = (kind == "l2"
@@ -566,5 +758,5 @@ def search(index: Index, queries, k: int,
     d, i = _search_impl(q, index.centers, index.centers_rot,
                         index.rotation_matrix, index.pq_centers,
                         index.codes, index.lists_indices, k, n_probes,
-                        sqrt, kind=kind)
+                        sqrt, kind=kind, per_cluster=per_cluster)
     return _postprocess(d, index.metric), i

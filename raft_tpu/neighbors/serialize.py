@@ -92,7 +92,8 @@ def save_ivf_pq(index, path: str) -> None:
     """Write an :class:`raft_tpu.neighbors.ivf_pq.Index` to ``path``."""
     _pack(path, "ivf_pq",
           {"metric": int(index.metric), "size": int(index.size),
-           "pq_bits": int(index.pq_bits)},
+           "pq_bits": int(index.pq_bits),
+           "codebook_kind": int(index.codebook_kind)},
           {"centers": index.centers, "centers_rot": index.centers_rot,
            "rotation_matrix": index.rotation_matrix,
            "pq_centers": index.pq_centers, "codes": index.codes,
@@ -117,6 +118,8 @@ def load_ivf_pq(path: str):
         metric=DistanceType(meta["metric"]),
         pq_bits=meta["pq_bits"],
         size=meta["size"])
+    from raft_tpu.neighbors.ivf_pq import CodebookGen
+    index.codebook_kind = CodebookGen(meta.get("codebook_kind", 0))
     return index
 
 

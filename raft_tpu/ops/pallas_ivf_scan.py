@@ -234,7 +234,8 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
 
 def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
                     cd_ref, ci_ref, *, bins: int, metric: str, pq_dim: int,
-                    pq_len: int, n_codes: int, lut_dtype):
+                    pq_len: int, n_codes: int, lut_dtype,
+                    per_cluster: bool):
     """One IVF list per grid cell, scored straight from its u8 codes.
 
     Decode is one-hot × codebook on the MXU, **lanes-major over list
@@ -262,8 +263,12 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
     strips = []
     for s in range(pq_dim):
         oh = (iota == codes[:, s][None, :]).astype(operand)  # (C, ML)
+        # PER_CLUSTER: one codebook for this grid cell's list, shared
+        # across subspaces (the block is (1, C, pl)); PER_SUBSPACE: the
+        # s-th book of the global (pq_dim, C, pl) table
+        book_s = books_ref[0] if per_cluster else books_ref[s]
         strips.append(jax.lax.dot_general(
-            books_ref[s].astype(operand), oh,
+            book_s.astype(operand), oh,
             (((0,), (0,)), ((), ())), precision=prec,
             preferred_element_type=jnp.float32))         # (pq_len, ML)
     dec_t = jnp.concatenate(strips, axis=0)              # (rot_dim, ML)
@@ -295,21 +300,29 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
 
 @functools.partial(jax.jit, static_argnames=("bins", "metric", "out_dtype",
                                              "lut_dtype", "interpret",
-                                             "split"))
+                                             "split", "per_cluster"))
 def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
                   interpret: bool, metric: str, lut_dtype,
-                  out_dtype=jnp.float32, split: int = 1):
+                  out_dtype=jnp.float32, split: int = 1,
+                  per_cluster: bool = False):
     """``split`` > 1: codes/norms/ids carry ``split`` sub-lists per
     original list (leading dim n_lists·split); the query blocks stay
     per-ORIGINAL-list and are shared across a list's sub-cells via the
-    index map — no duplicated HBM."""
+    index map — no duplicated HBM. ``per_cluster``: books are
+    (n_lists, C, pl) — each cell fetches its own list's codebook."""
     n_lists, cap, rot_dim = qsub.shape
     n_cells, max_list = codes.shape[:2]
-    pq_dim, n_codes, pq_len = books.shape
+    pq_dim = codes.shape[2]
+    n_codes, pq_len = books.shape[1], books.shape[2]
     kern = functools.partial(
         _pq_scan_kernel, bins=bins, metric=metric, pq_dim=pq_dim,
         pq_len=pq_len, n_codes=n_codes,
-        lut_dtype=jnp.dtype(lut_dtype))
+        lut_dtype=jnp.dtype(lut_dtype), per_cluster=per_cluster)
+    books_spec = (pl.BlockSpec((1, n_codes, pq_len),
+                               lambda g: (g // split, 0, 0))
+                  if per_cluster else
+                  pl.BlockSpec((pq_dim, n_codes, pq_len),
+                               lambda g: (0, 0, 0)))
     cd, ci = pl.pallas_call(
         kern,
         grid=(n_cells,),
@@ -318,8 +331,7 @@ def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
                   pl.BlockSpec((1, max_list, pq_dim), lambda g: (g, 0, 0)),
                   pl.BlockSpec((1, max_list), lambda g: (g, 0)),
                   pl.BlockSpec((1, max_list), lambda g: (g, 0)),
-                  pl.BlockSpec((pq_dim, n_codes, pq_len),
-                               lambda g: (0, 0, 0))],
+                  books_spec],
         out_specs=[pl.BlockSpec((1, cap, bins), lambda g: (g, 0, 0)),
                    pl.BlockSpec((1, cap, bins), lambda g: (g, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((n_cells, cap, bins), out_dtype),
@@ -344,7 +356,8 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
                             cap: int, bins: int = 0, sqrt: bool = False,
                             lut_dtype=jnp.bfloat16,
                             internal_distance_dtype=jnp.float32,
-                            metric: str = "l2"):
+                            metric: str = "l2",
+                            per_cluster: bool = False):
     """IVF-PQ fine scan directly over the compressed codes.
 
     Reference ``ivf_pq_search.cuh:593`` scans the bit-packed
@@ -415,7 +428,8 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
                            as_sub(lists_indices), pq_centers, lay.bins,
                            pallas_interpret(), metric=metric,
                            lut_dtype=lut_dtype,
-                           out_dtype=internal_distance_dtype, split=split)
+                           out_dtype=internal_distance_dtype, split=split,
+                           per_cluster=per_cluster)
     if split > 1:
         # sub-lists of a list are contiguous: fold them back into a
         # wider candidate block per original list

@@ -59,8 +59,12 @@ def shard_ivf_flat(index, mesh: jax.sharding.Mesh, axis: str = "data"):
 def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
     """Reshard an IVF-PQ index's lists over ``mesh[axis]``. The bf16
     reconstruction cache is decoded first (sharded scans use it)."""
-    from raft_tpu.neighbors.ivf_pq import (Index, _code_norms,
-                                           _decode_lists)
+    from raft_tpu.neighbors.ivf_pq import (CodebookGen, Index,
+                                           _code_norms, _decode_lists)
+    expects(index.codebook_kind == CodebookGen.PER_SUBSPACE,
+            "shard_ivf_pq: PER_CLUSTER indexes are not supported by the "
+            "sharded scan yet (the per-subspace decode would silently "
+            "misread a per-cluster codebook table)")
     n_shards = mesh.shape[axis]
     expects(index.n_lists % n_shards == 0,
             f"shard_ivf_pq: n_lists={index.n_lists} not divisible by "
@@ -470,6 +474,11 @@ def distributed_ivf_pq_build(
     from raft_tpu.parallel.kmeans import distributed_kmeans_fit
     params = params or IndexParams()
     expects(mesh is not None, "distributed build: mesh is required")
+    from raft_tpu.neighbors.ivf_pq import CodebookGen
+    expects(params.codebook_kind == CodebookGen.PER_SUBSPACE,
+            "distributed_ivf_pq_build: PER_CLUSTER codebooks are not "
+            "supported on the distributed path yet — build single-host "
+            "or use PER_SUBSPACE")
     expects(params.metric in (DistanceType.L2Expanded,
                               DistanceType.L2SqrtExpanded,
                               DistanceType.L2Unexpanded,
