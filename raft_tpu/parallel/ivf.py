@@ -59,12 +59,9 @@ def shard_ivf_flat(index, mesh: jax.sharding.Mesh, axis: str = "data"):
 def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
     """Reshard an IVF-PQ index's lists over ``mesh[axis]``. The bf16
     reconstruction cache is decoded first (sharded scans use it)."""
-    from raft_tpu.neighbors.ivf_pq import (CodebookGen, Index,
-                                           _code_norms, _decode_lists)
-    expects(index.codebook_kind == CodebookGen.PER_SUBSPACE,
-            "shard_ivf_pq: PER_CLUSTER indexes are not supported by the "
-            "sharded scan yet (the per-subspace decode would silently "
-            "misread a per-cluster codebook table)")
+    from raft_tpu.neighbors.ivf_pq import (
+        CodebookGen, Index, _code_norms, _code_norms_per_cluster,
+        _decode_lists, _decode_lists_per_cluster)
     n_shards = mesh.shape[axis]
     expects(index.n_lists % n_shards == 0,
             f"shard_ivf_pq: n_lists={index.n_lists} not divisible by "
@@ -74,9 +71,23 @@ def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
     # single device (the 100M north-star constraint)
     codes = _shard0(index.codes, mesh, axis)
     lists_indices = _shard0(index.lists_indices, mesh, axis)
-    pq_centers = jax.device_put(index.pq_centers, NamedSharding(mesh, P()))
-    decoded = _decode_lists(codes, pq_centers, lists_indices)
-    decoded_norms = _code_norms(codes, pq_centers, lists_indices)
+    if index.codebook_kind == CodebookGen.PER_CLUSTER:
+        # per-cluster books are list-aligned: shard them WITH the lists
+        # and decode shard-locally
+        pq_centers = _shard0(index.pq_centers, mesh, axis)
+        decoded = _decode_lists_per_cluster(codes, pq_centers,
+                                            lists_indices)
+        norms_fn = _code_norms_per_cluster
+    else:
+        pq_centers = jax.device_put(index.pq_centers,
+                                    NamedSharding(mesh, P()))
+        decoded = _decode_lists(codes, pq_centers, lists_indices)
+        norms_fn = _code_norms
+    # build already holds the identical exact norms: shard them instead
+    # of re-gathering every code slot; recompute only for older indexes
+    decoded_norms = (_shard0(index.code_norms, mesh, axis)
+                     if index.code_norms is not None
+                     else norms_fn(codes, pq_centers, lists_indices))
     return Index(
         centers=_shard0(index.centers, mesh, axis),
         centers_rot=_shard0(index.centers_rot, mesh, axis),
@@ -87,6 +98,7 @@ def shard_ivf_pq(index, mesh: jax.sharding.Mesh, axis: str = "data"):
         lists_indices=lists_indices,
         list_sizes=_shard0(index.list_sizes, mesh, axis),
         metric=index.metric, pq_bits=index.pq_bits, size=index.size,
+        codebook_kind=index.codebook_kind,
         decoded=decoded, decoded_norms=decoded_norms)
 
 

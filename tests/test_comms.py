@@ -566,6 +566,27 @@ class TestHostP2P:
             assert p1.session == "p2p-test"
 
 
+class TestShardedPerClusterPq:
+    def test_sharded_search_matches_single_device(self):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.parallel import make_mesh
+        from raft_tpu.parallel.ivf import (shard_ivf_pq,
+                                           distributed_ivf_pq_search)
+        x, _ = make_blobs(n_samples=2000, n_features=16, centers=10, seed=0)
+        xn = np.asarray(x); q = xn[:30]
+        idx = ivf_pq.build(xn, ivf_pq.IndexParams(
+            n_lists=8, pq_dim=4, kmeans_n_iters=4,
+            codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER))
+        d0, i0 = ivf_pq.search(idx, q, 5, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="reconstruct", scan_order="probe"))
+        mesh = make_mesh(axis_names=("data",))
+        sidx = shard_ivf_pq(idx, mesh)
+        d1, i1 = distributed_ivf_pq_search(sidx, q, 5, mesh=mesh)
+        rec = np.mean([len(set(a) & set(b)) / 5 for a, b in
+                       zip(np.asarray(i1), np.asarray(i0))])
+        assert rec > 0.95, rec
+
+
 class TestDistributedIvfBuild:
     """Row-sharded multi-part IVF built DIRECTLY on the mesh (VERDICT
     round-1 item 6: no single-host index materialized; reference
