@@ -35,36 +35,52 @@ from jax import lax
 from raft_tpu.core.precision import matmul_precision
 
 
+def _round_cap(want: int, nq: int) -> int:
+    """Shared inverted-table width bucketing: next power of two (so jit
+    caches bucket instead of recompiling per batch), ≥ 8, ≤ nq."""
+    cap = 8
+    while cap < want:
+        cap *= 2
+    return min(cap, nq)
+
+
 def probe_cap(probes, n_lists: int) -> int:
     """Smallest safe static width for the inverted table: the max number
-    of queries probing any one list, rounded up to a power of two (so
-    jit caches bucket instead of recompiling per batch)."""
+    of queries probing any one list, bucketed by ``_round_cap``."""
     counts = jax.ops.segment_sum(
         jnp.ones(probes.size, jnp.int32), probes.reshape(-1),
         num_segments=n_lists)
     m = int(jax.device_get(jnp.max(counts)))
-    cap = 8
-    while cap < m:
-        cap *= 2
-    return min(cap, probes.shape[0])
+    return _round_cap(m, probes.shape[0])
 
 
 def _invert_probes(probes, n_lists: int, cap: int):
     """(nq, n_probes) → ``qmap`` (n_lists, cap) query ids (-1 pad) and
-    ``inv_pos`` (nq, n_probes): each pair's slot within its list's row."""
+    ``inv_pos`` (nq, n_probes): each pair's slot within its list's row.
+
+    Slots are assigned in PROBE-RANK priority order: within a list, pairs
+    from low probe ranks (a query's most-promising probes) fill first, so
+    when ``cap`` is smaller than a hot list's true probe count the
+    overflow drops the *least*-promising (high-rank) probes. With the
+    drop-free measured cap (``probe_cap``) the ordering is irrelevant;
+    with a cached/static cap it bounds the recall cost of overflow.
+    Dropped pairs keep ``inv_pos ≥ cap`` — mergers mask them out."""
     nq, n_probes = probes.shape
     flat_list = probes.reshape(-1)
     qid = jnp.broadcast_to(jnp.arange(nq, dtype=jnp.int32)[:, None],
                            (nq, n_probes)).reshape(-1)
+    p_rank = jnp.broadcast_to(jnp.arange(n_probes, dtype=jnp.int32)[None],
+                              (nq, n_probes)).reshape(-1)
     counts = jax.ops.segment_sum(jnp.ones(nq * n_probes, jnp.int32),
                                  flat_list, num_segments=n_lists)
-    order = jnp.argsort(flat_list, stable=True)
+    # composite key (list, probe rank); n_lists·n_probes stays well under
+    # int32 (≤ n_lists² ≤ 2^34 only for n_lists > 2^17-class indexes —
+    # far beyond the list counts this layout targets)
+    order = jnp.argsort(flat_list * n_probes + p_rank, stable=True)
     sl = flat_list[order]
     starts = jnp.cumsum(jnp.concatenate([jnp.zeros(1, jnp.int32),
                                          counts]))[:-1]
     pos = jnp.arange(nq * n_probes, dtype=jnp.int32) - starts[sl]
-    # pairs beyond cap are dropped (cannot happen when cap ≥ max count,
-    # which probe_cap guarantees)
     slot = jnp.where(pos < cap, sl * cap + pos, n_lists * cap)
     qmap = jnp.full((n_lists * cap,), -1, jnp.int32)
     qmap = qmap.at[slot].set(qid[order], mode="drop")
@@ -110,13 +126,25 @@ def _score_block(qsub, data, norms, scale):
 
 
 def merge_candidates(cand_d, cand_i, probes, inv_pos, k: int,
-                     sqrt: bool, use_pallas_select: bool = False):
+                     sqrt: bool, use_pallas_select: bool = False,
+                     cap: Optional[int] = None):
     """Shared tail of both list-major scans: gather each (query, probe)
     pair's candidate row from the (n_lists, cap, kk) blocks and merge to
-    the per-query top-k. ``-1`` candidate ids stay ``-1``."""
+    the per-query top-k. ``-1`` candidate ids stay ``-1``. ``cap``, when
+    given, masks pairs the inversion dropped (``inv_pos ≥ cap`` — a hot
+    list overflowed a cached/static table width)."""
     nq = probes.shape[0]
+    kept = None
+    if cap is not None:
+        kept = inv_pos < cap
+        inv_pos = jnp.minimum(inv_pos, cap - 1)
     pd = cand_d[probes, inv_pos].reshape(nq, -1)
     pi = cand_i[probes, inv_pos].reshape(nq, -1)
+    if kept is not None:
+        kk = pd.shape[1] // probes.shape[1]
+        keep_f = jnp.repeat(kept, kk, axis=1)
+        pd = jnp.where(keep_f, pd, jnp.inf)
+        pi = jnp.where(keep_f, pi, -1)
     pd = jnp.where(pi >= 0, pd, jnp.inf)
     if pd.shape[1] < k:  # fewer candidates than k: pad like the carry init
         short = k - pd.shape[1]
@@ -223,7 +251,96 @@ def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
             one_chunk, (qmap_c, data_c, norms_c, ids_c, off_c))
     cand_d = cand_d.reshape(n_lists, cap, kk)
     cand_i = cand_i.reshape(n_lists, cap, kk)
-    return merge_candidates(cand_d, cand_i, probes, inv_pos, k, sqrt)
+    return merge_candidates(cand_d, cand_i, probes, inv_pos, k, sqrt,
+                            cap=cap)
+
+
+def resolve_cap(cache: Optional[dict], queries, centers, params,
+                n_probes: int, n_lists: int, kind: str = "l2") -> int:
+    """Inverted-table width policy shared by IVF-Flat and IVF-PQ.
+
+    ``params.probe_cap``: 0 (default) measures the drop-free cap once per
+    (nq, n_probes) and caches it on the index — every later same-shape
+    search is then a SINGLE dispatch (the measurement costs one extra
+    device round-trip, which at ~tens of ms through the axon tunnel was
+    the round-2 reason IVF trailed brute force); -1 re-measures every
+    batch (guaranteed drop-free, the round-2 behavior); > 0 pins an
+    explicit cap with no sync at all. A later batch that overflows a
+    cached/pinned cap sheds its highest-rank probes only
+    (``_invert_probes`` priority order) and the merge masks them.
+
+    Measurement (the -1 mode, and the first 0-mode call per shape) runs
+    the coarse phase once here and once again inside the fused search —
+    the duplication keeps measured and cached searches byte-identical
+    through one jit cache entry; -1 is the drop-free debug mode, not the
+    serving path, so the extra coarse GEMM is accepted."""
+    pc = getattr(params, "probe_cap", 0)
+    if pc > 0:
+        return _round_cap(pc, queries.shape[0])
+    key = (queries.shape[0], n_probes)
+    if pc == 0 and cache is not None and key in cache:
+        return cache[key]
+    probes = coarse_probes(queries, centers, n_probes, kind=kind)
+    cap = probe_cap(probes, n_lists)
+    if pc == 0 and cache is not None:
+        cache[key] = cap
+    return cap
+
+
+def gather_mode() -> str:
+    """Resolve the RAFT_TPU_GATHER strategy OUTSIDE jit so the A/B knob
+    is a static argument of the fused searches, not an env read frozen
+    into the first trace."""
+    import os
+    mode = os.environ.get("RAFT_TPU_GATHER", "rows")
+    from raft_tpu.core.error import expects
+    expects(mode in ("rows", "onehot"),
+            "RAFT_TPU_GATHER=%s: want rows|onehot", mode)
+    return mode
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
+                                             "bins", "sqrt", "kind",
+                                             "use_pallas", "gather"))
+def fused_list_search(queries, centers, data, norms, ids, scale, *,
+                      k: int, n_probes: int, cap: int, bins: int,
+                      sqrt: bool, kind: str, use_pallas: bool,
+                      gather: str = "rows"):
+    """Single-dispatch list-major IVF-Flat search: coarse probe GEMM +
+    top-k, probe inversion, query gather, the list scan (Pallas kernel or
+    XLA tier) and the candidate merge — ONE jitted computation. The
+    reference's search is likewise one stream of kernels with no host
+    round-trips (``ivf_flat_search.cuh:1057``); on the tunneled axon
+    platform each avoided dispatch saves ~22 ms, which is why the fused
+    form, not the kernel, was the round-3 QPS lever."""
+    probes = coarse_probes(queries, centers, n_probes, kind=kind)
+    if use_pallas:
+        from raft_tpu.ops.pallas_ivf_scan import ivf_list_scan_pallas
+        return ivf_list_scan_pallas(queries, data, norms, ids, probes, k,
+                                    cap, scale=scale, bins=bins,
+                                    sqrt=sqrt, metric=kind,
+                                    gather=gather)
+    # XLA tier scores the l2 core only; search() gates routing
+    chunk = _chunk_size(ids.shape[0], cap, ids.shape[1])
+    return inverted_scan(queries, data, norms, ids, probes, k, cap,
+                         chunk, scale, bins=bins, sqrt=sqrt)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
+                                             "bins", "sqrt"))
+def fused_reconstruct_list_search(queries, centers, centers_rot, rot,
+                                  decoded, decoded_norms, ids, *,
+                                  k: int, n_probes: int, cap: int,
+                                  bins: int, sqrt: bool):
+    """Single-dispatch IVF-PQ reconstruct-cache list search (the XLA
+    tier's analogue of ``fused_list_search``): coarse on the unrotated
+    centers, query rotation, residual-form inverted scan, merge."""
+    probes = coarse_probes(queries, centers, n_probes)
+    q_rot = jnp.matmul(queries, rot.T, precision=matmul_precision())
+    chunk = _chunk_size(ids.shape[0], cap, ids.shape[1])
+    return inverted_scan(q_rot, decoded, decoded_norms, ids, probes, k,
+                         cap, chunk, center_offset=centers_rot,
+                         bins=bins, sqrt=sqrt)
 
 
 def gather_query_rows(queries, qmap, mode: str = ""):
@@ -248,6 +365,8 @@ def gather_query_rows(queries, qmap, mode: str = ""):
     mode = mode or os.environ.get("RAFT_TPU_GATHER", "rows")
     expects(mode in ("rows", "onehot"),
             "RAFT_TPU_GATHER=%s: want rows|onehot", mode)
+    # NOTE: jitted callers must resolve the mode via gather_mode() and
+    # pass it explicitly — an env read here would freeze into the trace
     nq = queries.shape[0]
     safe = jnp.clip(qmap, 0, nq - 1)
     if mode != "onehot":

@@ -72,6 +72,11 @@ class SearchParams:
     # the TPU-KNN partial top-k, recall-gated); -1 = exact on every
     # path; >0 = explicitly that many min-bins per list
     scan_bins: int = 0
+    # inverted-table width: 0 = measure once per (nq, n_probes), cache
+    # on the index (warm searches are ONE dispatch); -1 = re-measure
+    # every batch (drop-free); > 0 = explicit static width, never syncs.
+    # Overflowing pairs shed highest-rank probes (see _ivf_scan.resolve_cap)
+    probe_cap: int = 0
 
 
 @dataclass
@@ -89,6 +94,10 @@ class Index:
     metric: DistanceType
     size: int
     scale: float = 1.0
+    # measured inverted-table widths keyed (nq, n_probes) — see
+    # _ivf_scan.resolve_cap (not part of index identity/serialization)
+    cap_cache: dict = field(default_factory=dict, repr=False,
+                            compare=False)
 
     @property
     def n_lists(self) -> int:
@@ -379,23 +388,16 @@ def search(index: Index, queries, k: int,
                                              index.n_lists))))
     if use_list:
         from raft_tpu.neighbors import _ivf_scan
-        probes = _ivf_scan.coarse_probes(q, index.centers, n_probes,
-                                         kind=kind)
-        cap = _ivf_scan.probe_cap(probes, index.n_lists)
-        if pallas_enabled():
-            from raft_tpu.ops.pallas_ivf_scan import ivf_list_scan_pallas
-            d, i = ivf_list_scan_pallas(
-                q, index.lists_data, index.lists_norms,
-                index.lists_indices, probes, k, cap, scale=index.scale,
-                bins=params.scan_bins, sqrt=sqrt, metric=kind)
-            return _postprocess(d, index.metric), i
-        chunk = _ivf_scan._chunk_size(
-            index.n_lists, cap, index.lists_indices.shape[1])
-        return _ivf_scan.inverted_scan(
-            q, index.lists_data, index.lists_norms,
-            index.lists_indices, probes, k, cap, chunk,
-            jnp.float32(index.scale), bins=params.scan_bins,
-            sqrt=sqrt)
+        cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
+                                    params, n_probes, index.n_lists,
+                                    kind=kind)
+        d, i = _ivf_scan.fused_list_search(
+            q, index.centers, index.lists_data, index.lists_norms,
+            index.lists_indices, jnp.float32(index.scale), k=k,
+            n_probes=n_probes, cap=cap, bins=params.scan_bins,
+            sqrt=sqrt, kind=kind, use_pallas=pallas_enabled(),
+            gather=_ivf_scan.gather_mode())
+        return _postprocess(d, index.metric), i
     d, i = _search_impl(q, index.centers, index.lists_data,
                         index.lists_indices, index.lists_norms,
                         jnp.float32(index.scale), k, n_probes, sqrt,

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import enum
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dataclasses_field
 from typing import Optional, Tuple
 
 import jax
@@ -87,6 +87,8 @@ class SearchParams:
     scan_order: str = "auto"
     # see ivf_flat.SearchParams.scan_bins
     scan_bins: int = 0
+    # see ivf_flat.SearchParams.probe_cap / _ivf_scan.resolve_cap
+    probe_cap: int = 0
 
 
 @dataclass
@@ -117,6 +119,10 @@ class Index:
     # "codes" path.
     decoded: Optional[jax.Array] = None
     decoded_norms: Optional[jax.Array] = None
+    # measured inverted-table widths keyed (nq, n_probes) — see
+    # _ivf_scan.resolve_cap (not index identity; not serialized)
+    cap_cache: dict = dataclasses_field(default_factory=dict, repr=False,
+                                        compare=False)
 
     @property
     def n_lists(self) -> int:
@@ -655,6 +661,32 @@ def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
     return d, i
 
 
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
+                                             "bins", "sqrt", "kind",
+                                             "lut_dtype", "internal_dtype",
+                                             "per_cluster", "gather"))
+def _fused_code_search(q, centers, centers_rot, rot, pq_centers, codes,
+                       code_norms, lists_indices, *, k: int,
+                       n_probes: int, cap: int, bins: int, sqrt: bool,
+                       kind: str, lut_dtype, internal_dtype,
+                       per_cluster: bool, gather: str = "rows"):
+    """Single-dispatch code-resident search: coarse select_clusters,
+    query rotation, the Pallas code scan and the candidate merge in ONE
+    jitted computation (the reference search worker is likewise one
+    kernel stream, ``ivf_pq_search.cuh:1007``; see
+    ``_ivf_scan.fused_list_search`` for why dispatch count was the
+    round-3 QPS lever)."""
+    from raft_tpu.neighbors import _ivf_scan
+    from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
+    probes = _ivf_scan.coarse_probes(q, centers, n_probes, kind=kind)
+    q_rot = jnp.matmul(q, rot.T, precision=matmul_precision())
+    return ivf_pq_code_scan_pallas(
+        q_rot, centers_rot, pq_centers, codes, code_norms, lists_indices,
+        probes, k, cap, bins=bins, sqrt=sqrt, lut_dtype=lut_dtype,
+        internal_distance_dtype=internal_dtype, metric=kind,
+        per_cluster=per_cluster, gather=gather)
+
+
 def search(index: Index, queries, k: int,
            params: SearchParams = SearchParams(), res=None
            ) -> Tuple[jax.Array, jax.Array]:
@@ -706,20 +738,18 @@ def search(index: Index, queries, k: int,
         scan_mode = "codes" if pallas_enabled() else "reconstruct"
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
-        from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
-        probes = _ivf_scan.coarse_probes(q, index.centers, n_probes,
-                                         kind=kind)
-        cap = _ivf_scan.probe_cap(probes, index.n_lists)
-        q_rot = jnp.matmul(q, index.rotation_matrix.T,
-                           precision=matmul_precision())
+        cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
+                                    params, n_probes, index.n_lists,
+                                    kind=kind)
         code_norms = _norms(index)  # derives once for older indexes
-        d, i = ivf_pq_code_scan_pallas(
-            q_rot, index.centers_rot, index.pq_centers, index.codes,
-            code_norms, index.lists_indices, probes, k, cap,
-            bins=params.scan_bins, sqrt=sqrt,
+        d, i = _fused_code_search(
+            q, index.centers, index.centers_rot, index.rotation_matrix,
+            index.pq_centers, index.codes, code_norms,
+            index.lists_indices, k=k, n_probes=n_probes, cap=cap,
+            bins=params.scan_bins, sqrt=sqrt, kind=kind,
             lut_dtype=params.lut_dtype,
-            internal_distance_dtype=params.internal_distance_dtype,
-            metric=kind, per_cluster=per_cluster)
+            internal_dtype=params.internal_distance_dtype,
+            per_cluster=per_cluster, gather=_ivf_scan.gather_mode())
         return _postprocess(d, index.metric), i
     if scan_mode == "reconstruct":
         if index.decoded is None:
@@ -739,19 +769,17 @@ def search(index: Index, queries, k: int,
                                                  index.n_lists))))
         if use_list:
             from raft_tpu.neighbors import _ivf_scan
-            probes = _ivf_scan.coarse_probes(q, index.centers, n_probes)
-            cap = _ivf_scan.probe_cap(probes, index.n_lists)
-            chunk = _ivf_scan._chunk_size(
-                index.n_lists, cap, index.lists_indices.shape[1])
-            q_rot = jnp.matmul(q, index.rotation_matrix.T,
-                               precision=matmul_precision())
-            # lists hold decoded rotated residuals: offset each list's
-            # queries by its rotated center so the einsum scores
+            cap = _ivf_scan.resolve_cap(index.cap_cache, q,
+                                        index.centers, params, n_probes,
+                                        index.n_lists)
+            # lists hold decoded rotated residuals: the scan offsets each
+            # list's queries by its rotated center so the einsum scores
             # ||(q_rot - c_l) - decoded||²
-            return _ivf_scan.inverted_scan(
-                q_rot, index.decoded, index.decoded_norms,
-                index.lists_indices, probes, k, cap, chunk,
-                center_offset=index.centers_rot, bins=params.scan_bins,
+            return _ivf_scan.fused_reconstruct_list_search(
+                q, index.centers, index.centers_rot,
+                index.rotation_matrix, index.decoded,
+                index.decoded_norms, index.lists_indices, k=k,
+                n_probes=n_probes, cap=cap, bins=params.scan_bins,
                 sqrt=sqrt)
         d, i = _search_impl_reconstruct(
             q, index.centers, index.centers_rot, index.rotation_matrix,

@@ -196,13 +196,14 @@ class _Layout:
         from raft_tpu.neighbors._ivf_scan import merge_candidates
         return merge_candidates(
             cd[:, :self.cap].astype(jnp.float32), ci[:, :self.cap],
-            probes, self.inv_pos, k, sqrt, use_pallas_select=True)
+            probes, self.inv_pos, k, sqrt, use_pallas_select=True,
+            cap=self.cap)
 
 
 def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
                          probes, k: int, cap: int, scale=1.0,
                          bins: int = 0, sqrt: bool = False,
-                         metric: str = "l2"):
+                         metric: str = "l2", gather: str = ""):
     """Fused list-major IVF-Flat fine scan + merge.
 
     ``queries`` (nq, dim) f32; ``lists_data`` (n_lists, max_list, dim)
@@ -221,9 +222,10 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
 
     # pre-gather: each list's probing queries → (n_lists, cap, dim).
     # ~cap/mean-probes ≤ 2× the query bytes; read once by the kernel.
-    # Strategy (row gather vs one-hot MXU) via RAFT_TPU_GATHER.
+    # Strategy (row gather vs one-hot MXU) via RAFT_TPU_GATHER; jitted
+    # callers pass it resolved (``gather``) so the env isn't trace-frozen
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
-    qsub = gather_query_rows(queries, lay.padded_qmap())
+    qsub = gather_query_rows(queries, lay.padded_qmap(), mode=gather)
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim,
                   lists_data.dtype.itemsize)
     cd, ci = _list_scan_call(qsub, lists_data, lists_norms, lists_indices,
@@ -357,7 +359,8 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
                             lut_dtype=jnp.bfloat16,
                             internal_distance_dtype=jnp.float32,
                             metric: str = "l2",
-                            per_cluster: bool = False):
+                            per_cluster: bool = False,
+                            gather: str = ""):
     """IVF-PQ fine scan directly over the compressed codes.
 
     Reference ``ivf_pq_search.cuh:593`` scans the bit-packed
@@ -390,7 +393,7 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
     code_norms = lay.pad_lists(code_norms, max_list)
     lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
-    qg = gather_query_rows(q_rot, lay.padded_qmap())
+    qg = gather_query_rows(q_rot, lay.padded_qmap(), mode=gather)
     if metric == "ip":
         # IP decomposes linearly: q·(c_l + dec) = q·c_l + q·dec. The
         # kernel scores plain rotated queries against decoded residuals

@@ -181,6 +181,79 @@ class TestIvfFlat:
                                    rtol=1e-4, atol=1e-3)
 
 
+class TestProbeCapPolicy:
+    """The round-3 single-dispatch search: measured caps are cached per
+    (nq, n_probes); explicit static caps shed highest-rank probes only
+    (_ivf_scan.resolve_cap / _invert_probes priority order)."""
+
+    def test_cap_cached_and_reused(self, dataset):
+        x, q = dataset
+        index = ivf_flat.build(
+            x, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8))
+        sp = ivf_flat.SearchParams(n_probes=8, scan_order="list")
+        d1, i1 = ivf_flat.search(index, q, 10, sp)
+        assert (len(q), 8) in index.cap_cache
+        cap = index.cap_cache[(len(q), 8)]
+        d2, i2 = ivf_flat.search(index, q, 10, sp)  # cache hit
+        assert index.cap_cache[(len(q), 8)] == cap
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_remeasure_matches_cached(self, dataset):
+        x, q = dataset
+        index = ivf_flat.build(
+            x, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8))
+        d1, i1 = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list",
+                                                probe_cap=-1))
+        d2, i2 = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list"))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_tiny_explicit_cap_degrades_gracefully(self, dataset):
+        # a cap far below the measured width must shed the highest-rank
+        # probes only: valid ids out, recall above the 1-probe floor
+        x, q = dataset
+        index = ivf_flat.build(
+            x, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8))
+        d, i = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list",
+                                                probe_cap=8))
+        i = np.asarray(i)
+        # heavy drops may leave < k candidates (-1 pad); real ids valid
+        assert ((i >= -1) & (i < len(x))).all()
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        _, iref = nn.kneighbors(q)
+        # rank-priority drops keep each query's best probes: recall stays
+        # well above what losing arbitrary probes would leave
+        assert recall(i, iref) > 0.5
+
+    def test_generous_explicit_cap_matches_measured(self, dataset):
+        # an explicit cap ≥ the measured width must not drop anything
+        x, q = dataset
+        index = ivf_flat.build(
+            x, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8))
+        dm, im = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list",
+                                                probe_cap=-1))
+        de, ie = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list",
+                                                probe_cap=len(q)))
+        np.testing.assert_array_equal(np.asarray(im), np.asarray(ie))
+
+    def test_pq_cap_cached(self, dataset):
+        x, q = dataset
+        index = ivf_pq.build(
+            x, ivf_pq.IndexParams(n_lists=32, kmeans_n_iters=8))
+        d, i = ivf_pq.search(index, q, 10,
+                             ivf_pq.SearchParams(n_probes=8))
+        assert (len(q), 8) in index.cap_cache
+
+
 class TestIvfPq:
     def test_recall_gate(self, dataset):
         x, q = dataset
