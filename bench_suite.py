@@ -117,43 +117,194 @@ def bench_kmeans(results):
         "value": round(t * 1e3, 1), "unit": "ms"})
 
 
-def bench_ivf_flat(results):
-    # cpp/bench/neighbors/knn/ivf_flat_*.cu — SEARCH scope
+def _ivf_recall(i_got, db, q, k):
+    """Recall vs the exact scan (reference eval_neighbours role,
+    cpp/test/neighbors/ann_utils.cuh:201)."""
+    from raft_tpu.neighbors.brute_force import brute_force_knn
+    _, i_e = brute_force_knn(db, q, k, mode="exact")
+    f, e = np.asarray(i_got), np.asarray(i_e)
+    return float(np.mean([len(set(f[r]) & set(e[r])) / k
+                          for r in range(len(f))]))
+
+
+def _chained_search_time(search_fn, q_batches, reps, *operands):
+    """Marginal in-jit per-search time: ``reps`` searches over distinct
+    query batches chained in ONE dispatch (the gbench stream-of-kernels
+    methodology; per-dispatch tunnel latency is not kernel time).
+    ``operands`` (index arrays etc.) ride as jit arguments so they are
+    device parameters, not giant baked-in constants."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(qs, *ops):
+        acc = jnp.zeros((), jnp.float32)
+        for j in range(reps):
+            dj, ij = search_fn(qs[j], *ops)
+            acc = acc + dj[0, 0] + ij[0, 0].astype(jnp.float32)
+        return acc
+
+    return _time(lambda: chain(q_batches, *operands), reps=2) / reps
+
+
+def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
+                   label=None):
+    # cpp/bench/neighbors/knn/ivf_flat_*.cu — SEARCH scope (+BUILD:
+    # cold = first build incl. compiles; warm = steady-state rebuild,
+    # the gbench BUILD-scope iteration analogue)
+    import dataclasses
     import jax
     from raft_tpu.neighbors import ivf_flat
     key = jax.random.key(4)
-    n, d, nq, k = 500_000, 128, 1000, 32
+    d, nq, k = 128, 1000, 32
     db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
     q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    params = ivf_flat.IndexParams(n_lists=nlists)
     t_build0 = time.perf_counter()
-    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
+    index = ivf_flat.build(db, params)
     _sync(index.centers)
     t_build = time.perf_counter() - t_build0
-    sp = ivf_flat.SearchParams(n_probes=64)
+    t_build0 = time.perf_counter()
+    index = ivf_flat.build(db, params)
+    _sync(index.centers)
+    t_build_warm = time.perf_counter() - t_build0
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    d_f, i_f = ivf_flat.search(index, q, k, sp)  # warm + measure cap
+    rec = _ivf_recall(i_f, db, q, k)
     t = _time(lambda: ivf_flat.search(index, q, k, sp), reps=3)
+    # chained marginal: pin the measured cap so nothing syncs in-jit
+    spp = dataclasses.replace(sp, probe_cap=index.cap_cache[(nq, n_probes)])
+    reps = 8
+    qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
+
+    def run1(qq, centers, data, norms, idsarr, sizes):
+        idx2 = ivf_flat.Index(
+            centers=centers, lists_data=data, lists_indices=idsarr,
+            lists_norms=norms, list_sizes=sizes, metric=index.metric,
+            size=index.size, scale=index.scale)
+        return ivf_flat.search(idx2, qq, k, spp)
+
+    t_marg = _chained_search_time(
+        run1, qb, reps, index.centers, index.lists_data,
+        index.lists_norms, index.lists_indices, index.list_sizes)
     results.append({
-        "metric": f"ivf_flat_search_{n//1000}kx{d}_q{nq}_k{k}_p64_qps",
+        "metric": (label or
+                   f"ivf_flat_search_{n//1000}kx{d}_q{nq}_k{k}"
+                   f"_p{n_probes}_qps"),
         "value": round(nq / t, 1), "unit": "queries/s",
-        "build_s": round(t_build, 2)})
+        "recall": round(rec, 4),
+        "marginal_qps": round(nq / t_marg, 1),
+        "build_s": round(t_build, 2),
+        "build_warm_s": round(t_build_warm, 2)})
 
 
-def bench_ivf_pq(results):
+def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
+                 label=None):
+    import dataclasses
     import jax
     from raft_tpu.neighbors import ivf_pq
     key = jax.random.key(5)
-    n, d, nq, k = 500_000, 128, 1000, 32
+    d, nq, k = 128, 1000, 32
     db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
     q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    params = ivf_pq.IndexParams(n_lists=nlists)
     t_build0 = time.perf_counter()
-    index = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024))
+    index = ivf_pq.build(db, params)
     _sync(index.centers)
     t_build = time.perf_counter() - t_build0
-    sp = ivf_pq.SearchParams(n_probes=64)
+    sp = ivf_pq.SearchParams(n_probes=n_probes)
+    d_f, i_f = ivf_pq.search(index, q, k, sp)  # warm + measure cap
+    rec = _ivf_recall(i_f, db, q, k)
     t = _time(lambda: ivf_pq.search(index, q, k, sp), reps=3)
+    spp = dataclasses.replace(sp, probe_cap=index.cap_cache[(nq, n_probes)])
+    reps = 8
+    qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
+
+    # the warm search populated decoded/decoded_norms iff it took the
+    # reconstruct path; ride them as operands so the chained trace does
+    # NOT fold a whole-database decode into the measured search time
+    has_decoded = index.decoded is not None
+    extra = ([index.decoded, index.decoded_norms] if has_decoded else [])
+
+    def run1(qq, centers, centers_rot, rot, books, codes, code_norms,
+             idsarr, sizes, *dec):
+        idx2 = ivf_pq.Index(
+            centers=centers, centers_rot=centers_rot,
+            rotation_matrix=rot, pq_centers=books, codes=codes,
+            lists_indices=idsarr, list_sizes=sizes, metric=index.metric,
+            pq_bits=index.pq_bits, size=index.size,
+            codebook_kind=index.codebook_kind, code_norms=code_norms,
+            decoded=dec[0] if has_decoded else None,
+            decoded_norms=dec[1] if has_decoded else None)
+        return ivf_pq.search(idx2, qq, k, spp)
+
+    t_marg = _chained_search_time(
+        run1, qb, reps, index.centers, index.centers_rot,
+        index.rotation_matrix, index.pq_centers, index.codes,
+        index.code_norms, index.lists_indices, index.list_sizes, *extra)
     results.append({
-        "metric": f"ivf_pq_search_{n//1000}kx{d}_q{nq}_k{k}_p64_qps",
+        "metric": (label or
+                   f"ivf_pq_search_{n//1000}kx{d}_q{nq}_k{k}"
+                   f"_p{n_probes}_qps"),
         "value": round(nq / t, 1), "unit": "queries/s",
+        "recall": round(rec, 4),
+        "marginal_qps": round(nq / t_marg, 1),
         "build_s": round(t_build, 2)})
+
+
+def _big_enabled() -> bool:
+    """Reference-scale shapes (cpp/bench/neighbors/knn.cuh:380-389:
+    2M/10M×128, 10k×8192) — hours on the CPU mesh, so opt-in via
+    BENCH_BIG=1 (tools/tpu_measure.sh stage 4b sets it)."""
+    return os.environ.get("BENCH_BIG", "") == "1"
+
+
+def bench_brute_2m(results):
+    if not _big_enabled():
+        return
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.neighbors.brute_force import brute_force_knn
+    key = jax.random.key(10)
+    n, d, nq, k = 2_000_000, 128, 1000, 32
+    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    reps = 8
+    qb = jax.random.normal(jax.random.fold_in(key, 3), (reps, nq, d))
+    t_marg = _chained_search_time(
+        lambda qq, dbb: brute_force_knn(dbb, qq, k, mode="fused"),
+        qb, reps, db)
+    t = _time(lambda: brute_force_knn(db, q, k, mode="fused"), reps=3)
+    results.append({
+        "metric": f"bfknn_fused_{n//1_000_000}Mx{d}_q{nq}_k{k}_qps",
+        "value": round(nq / t, 1), "unit": "queries/s",
+        "marginal_qps": round(nq / t_marg, 1)})
+
+
+def bench_fused_wide(results):
+    # the 10k×8192 reference shape (K-staged fused kernel)
+    if not _big_enabled():
+        return
+    import jax
+    from raft_tpu.neighbors.brute_force import brute_force_knn
+    key = jax.random.key(11)
+    n, d, nq, k = 10_000, 8192, 1000, 32
+    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    t = _time(lambda: brute_force_knn(db, q, k, mode="fused"), reps=3)
+    results.append({
+        "metric": f"bfknn_fused_{n//1000}kx{d}_q{nq}_k{k}_qps",
+        "value": round(nq / t, 1), "unit": "queries/s"})
+
+
+def bench_ivf_10m(results):
+    # 10M×128: f32 lists = 5.1 GB (fits one v5e chip); PQ codes ≈ 320 MB
+    if not _big_enabled():
+        return
+    bench_ivf_flat(results, n=10_000_000, nlists=4096, n_probes=128,
+                   label="ivf_flat_search_10Mx128_q1000_k32_p128_qps")
+    bench_ivf_pq(results, n=10_000_000, nlists=4096, n_probes=128,
+                 label="ivf_pq_search_10Mx128_q1000_k32_p128_qps")
 
 
 def bench_linalg_random(results):
@@ -238,7 +389,8 @@ def bench_host_ivf(results):
 
 _CASES = [bench_pairwise_distance, bench_fused_l2_nn, bench_select_k,
           bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_linalg_random,
-          bench_ball_cover, bench_sparse_wide, bench_host_ivf]
+          bench_ball_cover, bench_sparse_wide, bench_host_ivf,
+          bench_brute_2m, bench_fused_wide, bench_ivf_10m]
 
 
 def run_all(cases=None):

@@ -118,6 +118,7 @@ def _coarse_scores(queries, centers, kind: str):
     return _l2_expanded(queries, centers, sqrt=False)
 
 
+@functools.partial(jax.jit, static_argnames=("n_lists", "max_list"))
 def _bucketize_static(x, labels, row_ids, n_lists: int, max_list: int,
                       counts=None):
     """jit-safe core of :func:`_bucketize`: scatter rows into padded

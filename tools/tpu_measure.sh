@@ -51,10 +51,18 @@ for mode in ("codes", "reconstruct"):
     print(f"ivf_pq {mode}: {t*1000:.1f} ms -> {nq/t:.0f} QPS")
 EOF
 
+echo "== 3b. build profile (compile vs compute split)"
+timeout 2400 python tools/profile_ivf_build.py 2>&1 | tee "$OUT/build_profile.log"
+
 echo "== 4. gated bench suite"
 timeout 3000 python bench_suite.py --gate 2>&1 | tee "$OUT/suite.log"
 
-echo "== 5. headline bench"
-timeout 1800 python bench.py 2>&1 | tee "$OUT/headline.log"
+echo "== 4b. reference-scale shapes (2M/10M x 128, 10k x 8192)"
+BENCH_BIG=1 timeout 6000 python bench_suite.py \
+  brute_2m fused_wide ivf_10m 2>&1 | tee "$OUT/suite_big.log"
+
+echo "== 5. headline bench (child budget 2400s x probe + retries: keep"
+echo "==    the outer timeout comfortably above it)"
+timeout 8000 python bench.py 2>&1 | tee "$OUT/headline.log"
 
 echo "== done; update BASELINE.md + PERF_GATES + ivf_pq auto default from $OUT"
