@@ -234,6 +234,46 @@ class Comms:
         pairs; XLA requires the full pattern statically)."""
         return lax.ppermute(x, self.axis_name, list(perm))
 
+    def group_start(self) -> None:
+        """Deliberate no-op (reference ``group_start``, core/comms.hpp:
+        108-216, maps to ``ncclGroupStart`` batching). Under XLA every
+        collective inside one jitted program is already scheduled and
+        fused by the compiler — there is no eager per-call launch to
+        batch, so the grouping brackets have nothing to do. Kept so
+        reference-shaped algorithm code ports without edits."""
+
+    def group_end(self) -> None:
+        """Deliberate no-op — see :meth:`group_start`."""
+
+    def multicast_sendrecv(self, x, dests_table: Sequence[Sequence[int]]):
+        """Grouped multi-destination p2p (reference
+        ``device_multicast_sendrecv``, core/comms.hpp:108-216: each rank
+        posts sends to a vector of destinations inside one NCCL group).
+
+        SPMD form: ``dests_table[rank]`` lists every rank's destinations
+        (host-known globally, R entries per rank); round ``r`` runs one
+        ``collective_permute`` with pattern ``rank → dests_table[rank][r]``,
+        so each round must be collision-free (each destination appears
+        once — interleave rounds otherwise). Returns the (R, ...) stack
+        of received buffers (round r's entry = the buffer whose sender
+        listed this rank at position r)."""
+        n = self.n_ranks
+        expects(len(dests_table) == n,
+                "multicast_sendrecv: need one dest list per rank")
+        rounds = len(dests_table[0])
+        expects(rounds > 0, "multicast_sendrecv: empty dest lists")
+        expects(all(len(d) == rounds for d in dests_table),
+                "multicast_sendrecv: ragged dest lists (pad with self)")
+        outs = []
+        for r in range(rounds):
+            dsts = [dests_table[i][r] for i in range(n)]
+            expects(len(set(dsts)) == n,
+                    "multicast_sendrecv: round %d has colliding "
+                    "destinations — interleave the rounds", r)
+            outs.append(lax.ppermute(
+                x, self.axis_name, [(i, dsts[i]) for i in range(n)]))
+        return jnp.stack(outs)
+
     def alltoall(self, x):
         """all-to-all over the leading axis (the sequence/context-parallel
         exchange primitive). On a split communicator the exchange runs
@@ -300,6 +340,34 @@ class Comms:
         return self.allreduce(jnp.ones((), jnp.int32))
 
     # -- host-side sync with failure semantics -----------------------------
+    def dispatch_checked(self, fn, *args, monitor=None,
+                         timeout_s: Optional[float] = None):
+        """Run a collective computation with failure semantics over BOTH
+        failure surfaces → ``(status, result_or_None)``.
+
+        A lost participant shows up differently per backend: the
+        multi-process CPU runtime errors at *dispatch* (Gloo context
+        init DEADLINE_EXCEEDED), while XLA:TPU collectives dispatch fine
+        and then never complete. The reference has the same split —
+        ``ncclCommGetAsyncError`` for surfaced errors, the polling
+        timeout for silent hangs (comms/detail/util.hpp:109-143). Here:
+        dispatch exception → ``ERROR``; silent non-completion →
+        ``ABORT`` via :meth:`sync_stream`. Either way ``monitor``
+        (when given) refreshes ``last_suspects`` with the ranks whose
+        heartbeats went stale."""
+        try:
+            out = fn(*args)
+        except Exception as e:
+            # keep the traceback visible: a code bug must remain
+            # distinguishable from a lost participant in the logs
+            from raft_tpu.core.logger import logger
+            logger.error("dispatch_checked: dispatch raised %r", e)
+            if monitor is not None:
+                monitor.suspect_ranks()
+            return Status.ERROR, None
+        return (self.sync_stream(out, timeout_s=timeout_s,
+                                 monitor=monitor), out)
+
     def sync_stream(self, *arrays, timeout_s: Optional[float] = None,
                     monitor=None) -> Status:
         """Block until device results materialize; ABORT on timeout
@@ -324,7 +392,14 @@ class Comms:
             try:
                 if all(a.is_ready() for a in leaves):
                     return Status.SUCCESS
-            except Exception:
+            except Exception as e:
+                # async runtimes surface a lost participant HERE (the
+                # error materializes in the future, not at dispatch) —
+                # refresh suspects so ERROR still names the failed ranks
+                from raft_tpu.core.logger import logger
+                logger.error("sync_stream: result poll raised %r", e)
+                if monitor is not None:
+                    monitor.suspect_ranks()
                 return Status.ERROR
             now = time.monotonic()
             if monitor is not None and now >= next_health:

@@ -69,10 +69,12 @@ class IndexParams:
 class SearchParams:
     n_probes: int = 20
     # the reference's LUT-precision variants (ivf_pq_search.cuh:780-1004)
-    # mapped to TPU terms — both live on the "codes" scan path:
-    # lut_dtype = decode-tile dtype (bf16 = one MXU pass, f32 = bf16x3
-    # split); internal_distance_dtype = candidate score dtype carried to
-    # the merge (bf16 halves candidate HBM traffic)
+    # mapped to TPU terms — all live on the "codes" scan path:
+    # lut_dtype = decode dtype: bf16 (one MXU pass), f32 (bf16x3 split),
+    # or float8_e4m3fn (the fp_8bit tier: books stored fp8 — half the
+    # codebook VMEM/HBM — computed in bf16; requires scan_mode "codes");
+    # internal_distance_dtype = candidate score dtype carried to the
+    # merge (bf16 halves candidate HBM traffic)
     lut_dtype: object = jnp.bfloat16
     internal_distance_dtype: object = jnp.float32
     # "auto" = "codes" when the Pallas tier is live, else "reconstruct";
@@ -119,6 +121,10 @@ class Index:
     # "codes" path.
     decoded: Optional[jax.Array] = None
     decoded_norms: Optional[jax.Array] = None
+    # fp8-LUT tier: code norms recomputed over the float8_e4m3fn-
+    # quantized books so the L2 epilogue matches what the kernel decodes
+    # (lazy, like decoded)
+    code_norms_fp8: Optional[jax.Array] = None
     # measured inverted-table widths keyed (nq, n_probes) — see
     # _ivf_scan.resolve_cap (not index identity; not serialized)
     cap_cache: dict = dataclasses_field(default_factory=dict, repr=False,
@@ -736,12 +742,36 @@ def search(index: Index, queries, k: int,
     if scan_mode == "auto":
         from raft_tpu.ops.dispatch import pallas_enabled
         scan_mode = "codes" if pallas_enabled() else "reconstruct"
+    expects(jnp.dtype(params.lut_dtype) in
+            (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+             jnp.dtype(jnp.float8_e4m3fn)),
+            "ivf_pq: lut_dtype must be float32|bfloat16|float8_e4m3fn")
+    # the fp8 tier only exists on the code-resident scan: reject rather
+    # than silently measure the full-precision reconstruct/lut paths
+    expects(jnp.dtype(params.lut_dtype) != jnp.dtype(jnp.float8_e4m3fn)
+            or scan_mode == "codes",
+            "ivf_pq: lut_dtype=float8_e4m3fn requires scan_mode='codes' "
+            "(resolved scan_mode is %r)", scan_mode)
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
         cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
                                     params, n_probes, index.n_lists,
                                     kind=kind)
-        code_norms = _norms(index)  # derives once for older indexes
+        if (jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.float8_e4m3fn)
+                and kind == "l2"):
+            # L2 epilogue must use norms of what the kernel decodes —
+            # the fp8-quantized books (reference fp_8bit tier; the LUT
+            # there carries the same quantization in its distance terms)
+            if index.code_norms_fp8 is None:
+                books8 = index.pq_centers.astype(
+                    jnp.float8_e4m3fn).astype(jnp.float32)
+                fn = (_code_norms_per_cluster if per_cluster
+                      else _code_norms)
+                index.code_norms_fp8 = fn(index.codes, books8,
+                                          index.lists_indices)
+            code_norms = index.code_norms_fp8
+        else:
+            code_norms = _norms(index)  # derives once for older indexes
         d, i = _fused_code_search(
             q, index.centers, index.centers_rot, index.rotation_matrix,
             index.pq_centers, index.codes, code_norms,

@@ -257,7 +257,10 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
     cap = q.shape[0]
     iota = jax.lax.broadcasted_iota(jnp.int32, (n_codes, ml), 0)
     # bf16 LUT = single MXU pass (the reference's fp16-LUT speed tier);
-    # f32 LUT = HIGHEST-precision passes (its fp32 accuracy tier)
+    # f32 LUT = HIGHEST-precision passes (its fp32 accuracy tier);
+    # fp8 LUT (float8_e4m3fn) = books arrive fp8-quantized — half the
+    # codebook VMEM/HBM of bf16 (the reference's fp_8bit tier,
+    # ivf_pq_search.cuh:780-1004) — and upcast to bf16 for the MXU
     f32_lut = jnp.dtype(lut_dtype) == jnp.dtype(jnp.float32)
     operand = jnp.float32 if f32_lut else jnp.bfloat16
     prec = jax.lax.Precision.HIGHEST if f32_lut else None
@@ -410,9 +413,19 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
     # (extra grid cells sharing the list's probing queries) so skewed or
     # low-n_lists indexes still compile (the old chunked path's
     # decode-tile budget, per-row form).
+    if jnp.dtype(lut_dtype) == jnp.dtype(jnp.float8_e4m3fn):
+        # the fp8 tier quantizes the codebook STORAGE (kernel input);
+        # compute runs bf16. Callers must pass ``code_norms`` computed
+        # over the fp8-quantized books (ivf_pq.search caches that table)
+        # so the L2 epilogue stays self-consistent
+        pq_centers = pq_centers.astype(jnp.float8_e4m3fn)
+
     rot_dim = pq_dim * pq_len
-    itemsize = jnp.dtype(lut_dtype).itemsize
-    per_row = (n_codes * itemsize + rot_dim * 4 + lay.capp * 4
+    # VMEM budget counts the COMPUTE operand width: the one-hot/decode
+    # strips run f32 (f32 LUT) or bf16 (bf16 AND fp8 LUT — fp8 shrinks
+    # only the shared books block, not the per-row transients)
+    op_item = 4 if jnp.dtype(lut_dtype) == jnp.dtype(jnp.float32) else 2
+    per_row = (n_codes * op_item + rot_dim * 4 + lay.capp * 4
                + pq_dim * 4)
     row_budget = max(lay.bins, (_VMEM_LIMIT // 3) // per_row)
     split = -(-lay.mlp // _round_up(row_budget, lay.bins))

@@ -71,6 +71,47 @@ class TestCommsObject:
         with pytest.raises(Exception):
             c.comm_split([0, 0, 0, 1, 1, 1, 1, 1])
 
+    def test_group_brackets_are_noops(self, mesh):
+        # documented no-ops (XLA batches collectives at compile); the
+        # brackets must exist so reference-shaped code ports verbatim
+        c = build_comms(mesh)
+        assert c.group_start() is None
+        assert c.group_end() is None
+
+    def test_multicast_sendrecv(self, mesh):
+        from jax.sharding import PartitionSpec as P
+        c = build_comms(mesh)
+        n = 8
+        # each rank multicasts to (rank+1, rank+3) — two collision-free
+        # rounds
+        dests = [[(r + 1) % n, (r + 3) % n] for r in range(n)]
+
+        def body(x):
+            c.group_start()
+            got = c.multicast_sendrecv(x, dests)
+            c.group_end()
+            return got
+
+        f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                  out_specs=P(None, "data")))
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        got = np.asarray(f(x))  # (rounds, n) after shard collection
+        # round 0: rank r received from (r-1); round 1: from (r-3)
+        want0 = [(r - 1) % n for r in range(n)]
+        want1 = [(r - 3) % n for r in range(n)]
+        np.testing.assert_allclose(got[0].ravel(), want0)
+        np.testing.assert_allclose(got[1].ravel(), want1)
+
+    def test_multicast_collision_rejected(self, mesh):
+        c = build_comms(mesh)
+        dests = [[0] for _ in range(8)]  # everyone sends to rank 0
+        with pytest.raises(Exception):
+            jax.jit(jax.shard_map(
+                lambda x: c.multicast_sendrecv(x, dests), mesh=mesh,
+                in_specs=jax.sharding.PartitionSpec("data"),
+                out_specs=jax.sharding.PartitionSpec(None, "data"))
+            )(jnp.ones((8, 1)))
+
     def test_sync_stream_success_and_abort(self, mesh):
         c = build_comms(mesh, abort_timeout_s=0.2)
         x = jnp.ones((4,)) * 2
@@ -546,6 +587,80 @@ class TestHostP2P:
         for p, (out, err) in zip(procs, outs):
             assert p.returncode == 0, (out, err[-2000:])
             assert b"OK" in out
+
+    def test_multiprocess_hang_mid_collective_aborts_with_suspect(
+            self, tmp_path):
+        """The real failure drill (round-2 verdict #8): two OS processes,
+        rank 1 goes silent mid-protocol (stops heartbeating, never joins
+        the collective); rank 0 must DETECT the failure (no indefinite
+        hang) and the health monitor must name rank 1 as the suspect —
+        the reference's ncclCommGetAsyncError abort path
+        (comms/detail/util.hpp:109-143) with participant identification.
+        The CPU runtime surfaces the loss as a dispatch error (Gloo init
+        timeout → ERROR); a TPU run would hang silently (→ ABORT via
+        sync_stream) — dispatch_checked covers both."""
+        import subprocess, sys, textwrap, socket, time as _time
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        prog = textwrap.dedent("""
+            import os, time
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import PartitionSpec as P
+            from raft_tpu.comms import (build_launcher_resources,
+                                        detect_launcher, HealthMonitor)
+            from raft_tpu.comms.comms import Status
+            w = detect_launcher()
+            res = build_launcher_resources(world=w)
+            mesh = res.mesh
+            c = res.get_comms()
+            m = HealthMonitor(w.process_id, 2, session="hang",
+                              interval_s=0.1, stale_after_s=1.5).start()
+            time.sleep(0.8)  # both sides observed alive
+            if w.process_id == 1:
+                m.stop()         # go silent: heartbeats stop...
+                time.sleep(600)  # ...but never join the collective (hang)
+            f = jax.jit(jax.shard_map(lambda x: c.allreduce(x),
+                                      mesh=mesh, in_specs=P("data"),
+                                      out_specs=P()))
+            arr = jax.make_array_from_process_local_data(
+                jax.NamedSharding(mesh, P("data")),
+                np.full((1,), 1.0, np.float32), (2,))
+            # rank 1 never arrives: dispatch errors (CPU/Gloo) or the
+            # result never completes (TPU) — both must be detected
+            st, _ = c.dispatch_checked(f, arr, monitor=m, timeout_s=45.0)
+            assert st in (Status.ABORT, Status.ERROR), st
+            assert m.last_suspects == [1], m.last_suspects
+            print("OK", w.process_id, flush=True)
+            os._exit(0)  # a hung dispatch thread must not block exit
+        """)
+        f = tmp_path / "hang_worker.py"
+        f.write_text(prog)
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = []
+        for i in range(2):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       RAFT_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       RAFT_TPU_NUM_PROCS="2", RAFT_TPU_PROC_ID=str(i),
+                       PYTHONPATH=repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(f)], stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env))
+        try:
+            out0, err0 = procs[0].communicate(timeout=150)
+            assert procs[0].returncode == 0, (out0, err0[-2000:])
+            assert b"OK 0" in out0
+        finally:
+            for p in procs:  # rank 1 hangs by design: reap it
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
 
     def test_default_registry_shared_in_process(self):
         from raft_tpu.comms.host_p2p import HostP2P

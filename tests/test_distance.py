@@ -207,3 +207,22 @@ class TestGram:
         np.testing.assert_allclose(kp, (1.5 * x @ y.T + 0.5) ** 2, rtol=1e-4, atol=1e-4)
         kt = np.asarray(gram_matrix(x, y, KernelParams(KernelType.TANH, 3, 0.1, 0.2)))
         np.testing.assert_allclose(kt, np.tanh(0.1 * x @ y.T + 0.2), rtol=1e-4, atol=1e-4)
+
+
+class TestPrecomputed:
+    """``DistanceType.Precomputed = 100`` is a special marker value in the
+    reference with no kernel behind it — the dispatch switch throws
+    (``distance/distance_types.hpp:65-66``, ``detail/distance.cuh:83``).
+    Parity = the member exists and pairwise rejects it cleanly."""
+
+    def test_enum_value(self):
+        from raft_tpu.distance.distance_types import DistanceType
+        assert DistanceType.Precomputed == 100
+
+    def test_pairwise_rejects(self, rng_np):
+        import pytest as _pytest
+        from raft_tpu.distance import pairwise_distance
+        from raft_tpu.distance.distance_types import DistanceType
+        x = rng_np.random((4, 3), dtype=np.float32)
+        with _pytest.raises(Exception):
+            pairwise_distance(x, x, metric=DistanceType.Precomputed)

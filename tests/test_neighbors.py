@@ -436,6 +436,32 @@ class TestIvfPqScanModes:
         np.testing.assert_allclose(np.asarray(d_r), np.asarray(d_l),
                                    rtol=0.05, atol=0.05)
 
+    def test_fp8_lut_tier(self, monkeypatch):
+        """The float8_e4m3fn LUT tier (reference fp_8bit,
+        ivf_pq_search.cuh:780-1004): books quantized to fp8 storage,
+        norms recomputed consistently; recall close to the bf16 tier."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.neighbors import ivf_pq
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        key = jax.random.key(9)
+        db = jax.random.normal(key, (2000, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (50, 32))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=16,
+                                                  kmeans_n_iters=4))
+        k = 10
+        d_b, i_b = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes"))
+        d_8, i_8 = ivf_pq.search(idx, q, k, ivf_pq.SearchParams(
+            n_probes=8, scan_mode="codes",
+            lut_dtype=jnp.float8_e4m3fn))
+        assert idx.code_norms_fp8 is not None
+        i_b, i_8 = np.asarray(i_b), np.asarray(i_8)
+        overlap = np.mean([len(set(i_b[r]) & set(i_8[r])) / k
+                           for r in range(50)])
+        assert overlap >= 0.7, overlap
+
     def test_bad_scan_mode(self):
         import pytest
         import jax
@@ -448,6 +474,20 @@ class TestIvfPqScanModes:
         with pytest.raises(LogicError):
             ivf_pq.search(idx, db[:5], 3,
                           ivf_pq.SearchParams(scan_mode="nope"))
+
+    def test_bad_lut_dtype(self):
+        import pytest
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.core.error import LogicError
+        from raft_tpu.neighbors import ivf_pq
+        key = jax.random.key(10)
+        db = jax.random.normal(key, (300, 16))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=4,
+                                                  kmeans_n_iters=2))
+        with pytest.raises(LogicError):
+            ivf_pq.search(idx, db[:5], 3,
+                          ivf_pq.SearchParams(lut_dtype=jnp.int8))
 
 
 class TestIvfPqExtend:
