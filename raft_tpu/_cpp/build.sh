@@ -1,11 +1,34 @@
 #!/usr/bin/env bash
 # Build the native host runtime → raft_tpu/_lib/libraft_tpu_host.so
+# plus the PJRT resources/mdarray layer (libraft_tpu_pjrt.so) and its
+# mock test plugin (libraft_tpu_mockpjrt.so).
 # (sources live package-internal so installed wheels can build them;
 #  repo-root cpp/ is a symlink here)
 # (the TPU framework's counterpart of the reference's compiled host-side
-# C++; see cpp/raft_tpu_host.cpp).
+# C++; see raft_tpu_host.cpp / raft_tpu_pjrt.cpp).
 set -euo pipefail
 cd "$(dirname "$0")"
 mkdir -p ../_lib
-exec g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra -pthread \
+g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra -pthread \
     -o ../_lib/libraft_tpu_host.so raft_tpu_host.cpp
+
+# The PJRT layer needs pjrt_c_api.h (ships in the tensorflow wheel's
+# include tree; a copy may also be provided via RAFT_TPU_PJRT_INCLUDE).
+# Best-effort: the host runtime above must build everywhere, the PJRT
+# layer only where a header is discoverable.
+PJRT_INC="${RAFT_TPU_PJRT_INCLUDE:-}"
+if [ -z "$PJRT_INC" ]; then
+  for d in \
+      /opt/venv/lib/python3*/site-packages/tensorflow/include \
+      /usr/local/lib/python3*/site-packages/tensorflow/include; do
+    if [ -f "$d/xla/pjrt/c/pjrt_c_api.h" ]; then PJRT_INC="$d"; break; fi
+  done
+fi
+if [ -n "$PJRT_INC" ]; then
+  g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra -pthread \
+      -I"$PJRT_INC" -o ../_lib/libraft_tpu_pjrt.so raft_tpu_pjrt.cpp -ldl
+  g++ -O2 -std=c++17 -shared -fPIC -Wall -Wextra -pthread \
+      -I"$PJRT_INC" -o ../_lib/libraft_tpu_mockpjrt.so mock_pjrt_plugin.cpp
+else
+  echo "pjrt_c_api.h not found; skipping PJRT layer build" >&2
+fi

@@ -357,11 +357,13 @@ class Comms:
         heartbeats went stale."""
         try:
             out = fn(*args)
-        except Exception as e:
+        except Exception:
             # keep the traceback visible: a code bug must remain
             # distinguishable from a lost participant in the logs
+            import traceback
             from raft_tpu.core.logger import logger
-            logger.error("dispatch_checked: dispatch raised %r", e)
+            logger.error("dispatch_checked: dispatch raised\n%s",
+                         traceback.format_exc())
             if monitor is not None:
                 monitor.suspect_ranks()
             return Status.ERROR, None
