@@ -35,6 +35,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <optional>
 #include <string>
 #include <vector>
 
@@ -337,7 +338,7 @@ int64_t rtp_buffer_from_host(int64_t res_id, const void* data, int dtype,
   const PJRT_Api* api = nullptr;
   PJRT_Client_BufferFromHostBuffer_Args a;
   std::memset(&a, 0, sizeof a);
-  InflightGuard* guard = nullptr;
+  std::optional<InflightGuard> guard;
   {
     std::lock_guard<std::mutex> lk(g_mu);
     Resources* r = find_res(res_id);
@@ -359,21 +360,18 @@ int64_t rtp_buffer_from_host(int64_t res_id, const void* data, int dtype,
     a.host_buffer_semantics =
         PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
     a.device = r->devices[static_cast<size_t>(dev_idx)];
-    std::string msg;
-    if (take_error(api, api->PJRT_Client_BufferFromHostBuffer(&a),
-                   &msg)) {
-      set_err(err, errlen, "BufferFromHostBuffer: " + msg);
-      return 0;
-    }
-    guard = new InflightGuard(res_id);
+    guard.emplace(res_id);
   }
-  // block until the runtime is done with the host pointer — OUTSIDE the
+  // the staging copy AND the host-pointer await both run OUTSIDE the
   // registry lock (a multi-GB upload must not serialize unrelated
-  // calls); the inflight guard keeps destroy from racing us
+  // calls); the inflight guard is held through cleanup/registration so
+  // rtp_resources_destroy cannot free the client/plugin under us
   std::string msg;
+  if (take_error(api, api->PJRT_Client_BufferFromHostBuffer(&a), &msg)) {
+    set_err(err, errlen, "BufferFromHostBuffer: " + msg);
+    return 0;
+  }
   bool bad = await_event(api, a.done_with_host_buffer, &msg);
-  guard->release();
-  delete guard;
   if (bad) {
     // a failed/aborted transfer must NOT hand back a live-looking
     // buffer full of undefined bytes
@@ -385,6 +383,8 @@ int64_t rtp_buffer_from_host(int64_t res_id, const void* data, int dtype,
     take_error(api, api->PJRT_Buffer_Destroy(&d), nullptr);
     return 0;
   }
+  // register while the guard is still held: a concurrent destroy is
+  // parked in its drain loop and will orphan-sweep this buffer after
   std::lock_guard<std::mutex> lk(g_mu);
   int64_t id = g_next_id++;
   g_buf[id] = Buffer{res_id, a.buffer};
@@ -396,6 +396,7 @@ int rtp_buffer_ndim(int64_t id) {
   Buffer* b = find_buf(id);
   if (!b) return -1;
   Resources* r = find_res(b->res_id);
+  if (!r) return -1;
   PJRT_Buffer_Dimensions_Args a;
   std::memset(&a, 0, sizeof a);
   a.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
@@ -410,6 +411,7 @@ int rtp_buffer_dims(int64_t id, int64_t* out, int cap) {
   Buffer* b = find_buf(id);
   if (!b) return -1;
   Resources* r = find_res(b->res_id);
+  if (!r) return -1;
   PJRT_Buffer_Dimensions_Args a;
   std::memset(&a, 0, sizeof a);
   a.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
@@ -426,6 +428,7 @@ int rtp_buffer_dtype(int64_t id) {
   Buffer* b = find_buf(id);
   if (!b) return -1;
   Resources* r = find_res(b->res_id);
+  if (!r) return -1;
   PJRT_Buffer_ElementType_Args a;
   std::memset(&a, 0, sizeof a);
   a.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
@@ -441,6 +444,7 @@ int rtp_buffer_ready(int64_t id) {
   Buffer* b = find_buf(id);
   if (!b) return -1;
   Resources* r = find_res(b->res_id);
+  if (!r) return -1;
   PJRT_Buffer_ReadyEvent_Args re;
   std::memset(&re, 0, sizeof re);
   re.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
@@ -465,13 +469,14 @@ int rtp_buffer_ready(int64_t id) {
 int rtp_buffer_sync(int64_t id) {
   PJRT_Event* ev = nullptr;
   const PJRT_Api* api = nullptr;
-  InflightGuard* guard = nullptr;
+  std::optional<InflightGuard> guard;
   {
     std::lock_guard<std::mutex> lk(g_mu);
     Buffer* b = find_buf(id);
     if (!b) return -1;
     if (is_dying(b->res_id)) return -1;
     Resources* r = find_res(b->res_id);
+    if (!r) return -1;
     api = r->api;
     PJRT_Buffer_ReadyEvent_Args re;
     std::memset(&re, 0, sizeof re);
@@ -480,17 +485,14 @@ int rtp_buffer_sync(int64_t id) {
     if (take_error(api, api->PJRT_Buffer_ReadyEvent(&re), nullptr))
       return -2;
     ev = re.event;
-    guard = new InflightGuard(b->res_id);  // under the SAME lock as
-                                           // the liveness check
+    guard.emplace(b->res_id);  // under the SAME lock as the liveness
+                               // check
   }
   // await OUTSIDE the registry lock: a slow device must not block
   // unrelated resource/buffer calls; the inflight guard keeps
   // rtp_resources_destroy from freeing the client under us
   std::string msg;
-  bool bad = await_event(api, ev, &msg);
-  guard->release();
-  delete guard;
-  return bad ? -2 : 0;
+  return await_event(api, ev, &msg) ? -2 : 0;
 }
 
 // Device → host copy (blocking). out must hold nbytes.
@@ -498,7 +500,7 @@ int rtp_buffer_to_host(int64_t id, void* out, int64_t nbytes, char* err,
                        int errlen) {
   PJRT_Event* ev = nullptr;
   const PJRT_Api* api = nullptr;
-  InflightGuard* guard = nullptr;
+  std::optional<InflightGuard> guard;
   {
     std::lock_guard<std::mutex> lk(g_mu);
     Buffer* b = find_buf(id);
@@ -507,6 +509,10 @@ int rtp_buffer_to_host(int64_t id, void* out, int64_t nbytes, char* err,
       return -1;
     }
     Resources* r = find_res(b->res_id);
+    if (!r) {
+      set_err(err, errlen, "bad buffer id");
+      return -1;
+    }
     api = r->api;
     PJRT_Buffer_ToHostBuffer_Args a;
     std::memset(&a, 0, sizeof a);
@@ -520,13 +526,10 @@ int rtp_buffer_to_host(int64_t id, void* out, int64_t nbytes, char* err,
       return -2;
     }
     ev = a.event;
-    guard = new InflightGuard(b->res_id);
+    guard.emplace(b->res_id);
   }
   std::string msg;
-  bool bad = await_event(api, ev, &msg);
-  guard->release();
-  delete guard;
-  if (bad) {
+  if (await_event(api, ev, &msg)) {
     set_err(err, errlen, "copy event: " + msg);
     return -2;
   }
@@ -539,6 +542,7 @@ int64_t rtp_buffer_host_nbytes(int64_t id) {
   Buffer* b = find_buf(id);
   if (!b) return -1;
   Resources* r = find_res(b->res_id);
+  if (!r) return -1;
   PJRT_Buffer_ToHostBuffer_Args a;
   std::memset(&a, 0, sizeof a);
   a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;

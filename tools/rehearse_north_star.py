@@ -1,0 +1,113 @@
+"""North-star rehearsal: the 100M×128 v5e-64 structure, exercised
+end-to-end at 10M+ rows on the virtual 8-device CPU mesh.
+
+Round-2 verdict #3: nothing above 1M had ever been attempted — the
+sharded build/search path (parallel/ivf.py) and the host-memory-resident
+variant (neighbors/host_memory.py) must be *proven code* at 10M+ before
+the v5e-64 run is credible. This script:
+
+  1. builds a row-sharded IVF-Flat index DIRECTLY on the mesh at
+     N rows (no single-host index is ever materialized),
+  2. searches it (per-shard scan + cross-shard merge) and checks
+     recall against an exact scan on a query subset,
+  3. builds + searches a host-memory-resident index on a slice
+     (the reference's host-transfer strategies axis, knn.cuh:380-389).
+
+Dims/lists are sized for a single-core CPU host (the CI/driver box);
+on a real v5e-64 the same code runs with dim=128, n_lists=16k+, the
+mesh axis over 64 chips, and HBM-resident parts.
+
+Run: python tools/rehearse_north_star.py [N_ROWS]   (default 10M)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def main(n_rows: int = 10_000_000) -> None:
+    from raft_tpu.neighbors import host_memory, ivf_flat
+    from raft_tpu.parallel.ivf import (distributed_ivf_flat_build,
+                                       distributed_ivf_flat_search_parts)
+    from jax.sharding import Mesh
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, devs
+    mesh = Mesh(np.asarray(devs[:8]), axis_names=("data",))
+
+    dim, n_lists, nq, k, n_probes = 32, 256, 1000, 10, 16
+    print(f"[rehearsal] N={n_rows} dim={dim} n_lists={n_lists} "
+          f"mesh={mesh.shape}", flush=True)
+
+    key = jax.random.key(0)
+    t0 = time.perf_counter()
+    x = jax.random.normal(key, (n_rows, dim), dtype=jnp.float32)
+    q = jax.random.normal(jax.random.fold_in(key, 1), (nq, dim),
+                          dtype=jnp.float32)
+    jax.block_until_ready((x, q))
+    print(f"[rehearsal] data gen {time.perf_counter()-t0:.1f}s "
+          f"({n_rows * dim * 4 / 1e9:.1f} GB)", flush=True)
+
+    # 1) sharded build on the mesh
+    t0 = time.perf_counter()
+    didx = distributed_ivf_flat_build(
+        x, ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=2),
+        mesh, axis="data")
+    jax.block_until_ready(didx.parts_data)
+    t_build = time.perf_counter() - t0
+    print(f"[rehearsal] sharded build {t_build:.1f}s", flush=True)
+
+    # 2) sharded search + recall vs exact on a query subset
+    t0 = time.perf_counter()
+    d, i = distributed_ivf_flat_search_parts(
+        didx, q, k, ivf_flat.SearchParams(n_probes=n_probes))
+    jax.block_until_ready((d, i))
+    t_search = time.perf_counter() - t0
+    qps = nq / t_search
+    print(f"[rehearsal] sharded search {t_search:.1f}s "
+          f"({qps:.0f} QPS cold incl. compile)", flush=True)
+
+    nq_check = 50
+    from raft_tpu.neighbors.brute_force import brute_force_knn
+    _, i_exact = brute_force_knn(x, q[:nq_check], k,
+                                 mode="exact")
+    got, want = np.asarray(i[:nq_check]), np.asarray(i_exact)
+    recall = np.mean([len(set(got[r]) & set(want[r])) / k
+                      for r in range(nq_check)])
+    print(f"[rehearsal] recall@{k} vs exact ({nq_check} q): "
+          f"{recall:.3f} (floor {n_probes / n_lists:.3f})", flush=True)
+    assert recall >= n_probes / n_lists, (recall, n_probes / n_lists)
+
+    # 3) host-memory-resident variant on a slice (streaming build; probed
+    #    sub-lists fetched host→device per batch)
+    n_host = min(n_rows // 5, 2_000_000)
+    x_host = np.asarray(x[:n_host])
+    t0 = time.perf_counter()
+    hidx = host_memory.build(
+        x_host, ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=2),
+        chunk_rows=1 << 19)
+    t_hbuild = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    hd, hi = host_memory.search(
+        hidx, np.asarray(q[:256]), k,
+        ivf_flat.SearchParams(n_probes=n_probes))
+    t_hsearch = time.perf_counter() - t0
+    print(f"[rehearsal] host-resident {n_host} rows: build {t_hbuild:.1f}s "
+          f"search {t_hsearch:.1f}s", flush=True)
+    assert np.asarray(hi).shape == (256, k)
+
+    print("[rehearsal] OK", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000)
