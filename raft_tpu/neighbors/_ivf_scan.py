@@ -301,11 +301,12 @@ def gather_mode() -> str:
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
                                              "bins", "sqrt", "kind",
-                                             "use_pallas", "gather"))
+                                             "use_pallas", "gather",
+                                             "internal_dtype"))
 def fused_list_search(queries, centers, data, norms, ids, scale, *,
                       k: int, n_probes: int, cap: int, bins: int,
                       sqrt: bool, kind: str, use_pallas: bool,
-                      gather: str = "rows"):
+                      gather: str = "rows", internal_dtype=None):
     """Single-dispatch list-major IVF-Flat search: coarse probe GEMM +
     top-k, probe inversion, query gather, the list scan (Pallas kernel or
     XLA tier) and the candidate merge — ONE jitted computation. The
@@ -319,7 +320,8 @@ def fused_list_search(queries, centers, data, norms, ids, scale, *,
         return ivf_list_scan_pallas(queries, data, norms, ids, probes, k,
                                     cap, scale=scale, bins=bins,
                                     sqrt=sqrt, metric=kind,
-                                    gather=gather)
+                                    gather=gather,
+                                    internal_dtype=internal_dtype)
     # XLA tier scores the l2 core only; search() gates routing
     chunk = _chunk_size(ids.shape[0], cap, ids.shape[1])
     return inverted_scan(queries, data, norms, ids, probes, k, cap,

@@ -77,6 +77,11 @@ class SearchParams:
     # every batch (drop-free); > 0 = explicit static width, never syncs.
     # Overflowing pairs shed highest-rank probes (see _ivf_scan.resolve_cap)
     probe_cap: int = 0
+    # candidate score dtype the Pallas list scan carries to the merge
+    # (the internal_distance_dtype role, reference ivf_pq_search.cuh:
+    # 780-1004, applied to IVF-Flat): bfloat16 halves the candidate-
+    # block HBM writeback+readback; final distances are still f32
+    internal_distance_dtype: object = jnp.float32
 
 
 @dataclass
@@ -397,7 +402,8 @@ def search(index: Index, queries, k: int,
             index.lists_indices, jnp.float32(index.scale), k=k,
             n_probes=n_probes, cap=cap, bins=params.scan_bins,
             sqrt=sqrt, kind=kind, use_pallas=pallas_enabled(),
-            gather=_ivf_scan.gather_mode())
+            gather=_ivf_scan.gather_mode(),
+            internal_dtype=params.internal_distance_dtype)
         return _postprocess(d, index.metric), i
     d, i = _search_impl(q, index.centers, index.lists_data,
                         index.lists_indices, index.lists_norms,

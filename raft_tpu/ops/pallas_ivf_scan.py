@@ -203,7 +203,8 @@ class _Layout:
 def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
                          probes, k: int, cap: int, scale=1.0,
                          bins: int = 0, sqrt: bool = False,
-                         metric: str = "l2", gather: str = ""):
+                         metric: str = "l2", gather: str = "",
+                         internal_dtype=None):
     """Fused list-major IVF-Flat fine scan + merge.
 
     ``queries`` (nq, dim) f32; ``lists_data`` (n_lists, max_list, dim)
@@ -228,9 +229,13 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     qsub = gather_query_rows(queries, lay.padded_qmap(), mode=gather)
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim,
                   lists_data.dtype.itemsize)
+    # internal_dtype: candidate-block dtype carried to the merge (the
+    # IVF-PQ internal_distance_dtype role) — bf16 halves the kernel's
+    # HBM writeback+readback; the merge re-ranks in f32 either way
     cd, ci = _list_scan_call(qsub, lists_data, lists_norms, lists_indices,
                              lay.bins, lc, scale, pallas_interpret(),
-                             metric=metric)
+                             metric=metric,
+                             out_dtype=internal_dtype or jnp.float32)
     return lay.merge(cd, ci, probes, k, sqrt)
 
 

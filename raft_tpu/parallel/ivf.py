@@ -575,7 +575,13 @@ def distributed_ivf_pq_search_parts(
     """Search a row-sharded multi-part IVF-PQ index: per shard, probed
     code blocks decode on the fly (transient, probe-major) and score
     against the rotated query residual; shards merge over the comm
-    axis. Codes stay compressed at rest on every shard."""
+    axis. Codes stay compressed at rest on every shard.
+
+    Decode is one-hot × codebook on the MXU (the ``_pq_scan_kernel``
+    trick, probe-major form) — per-lane LUT gathers lower to the TPU
+    scalar core and measured ~100× slower in rounds 1-2. The operand
+    dtype follows ``params.lut_dtype`` (bf16 one-pass / f32 highest /
+    float8_e4m3fn-quantized books computed in bf16)."""
     from raft_tpu.neighbors.ivf_flat import (_coarse_scores, _metric_kind,
                                              _postprocess)
     from raft_tpu.neighbors.ivf_pq import SearchParams
@@ -589,21 +595,45 @@ def distributed_ivf_pq_search_parts(
                              DistanceType.L2SqrtUnexpanded)
     comms = build_comms(mesh, axis)
     pq_dim = dindex.pq_dim
+    n_codes = 1 << dindex.pq_bits
+    lut_dt = jnp.dtype(params.lut_dtype)
+    expects(lut_dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                       jnp.dtype(jnp.float8_e4m3fn)),
+            "distributed ivf_pq search: lut_dtype must be "
+            "float32|bfloat16|float8_e4m3fn")
+    f32_lut = lut_dt == jnp.dtype(jnp.float32)
+    op_dt = jnp.float32 if f32_lut else jnp.bfloat16
+    op_prec = matmul_precision() if f32_lut else None
 
     def local(centers, centers_rot, rot, books, pcodes, pidx, pnorms,
               q_rep):
         coarse = _coarse_scores(q_rep, centers, kind)
         _, probes = lax.top_k(-coarse, n_probes)
         q_rot = jnp.matmul(q_rep, rot.T, precision=matmul_precision())
+        if lut_dt == jnp.dtype(jnp.float8_e4m3fn):
+            # NOTE: pnorms stay exact-over-f32-books here (recomputing
+            # over quantized books would decode every shard's codes);
+            # the resulting distance error is within the fp8 tier's own
+            # quantization class, matching the reference fp8-LUT contract
+            books_op = books.astype(jnp.float8_e4m3fn).astype(op_dt)
+        else:
+            books_op = books.astype(op_dt)
 
         def get_probe(p):
             list_id = probes[:, p]
             codes_p = pcodes[0][list_id].astype(jnp.int32)  # (nq, ml, s)
             ids = pidx[0][list_id]
-            # transient decode of the probed blocks only
-            dec = jnp.concatenate(
-                [books[s][codes_p[..., s]] for s in range(pq_dim)],
-                axis=-1)                                  # (nq, ml, rot)
+            # transient decode of the probed blocks only: per subspace,
+            # one-hot (nq, ml, C) × book (C, pl) rides the MXU
+            import jax.nn as jnn
+            strips = [
+                jnp.einsum("qlc,cp->qlp",
+                           jnn.one_hot(codes_p[..., s], n_codes,
+                                       dtype=op_dt),
+                           books_op[s], precision=op_prec,
+                           preferred_element_type=jnp.float32)
+                for s in range(pq_dim)]
+            dec = jnp.concatenate(strips, axis=-1)        # (nq, ml, rot)
             if kind == "ip":
                 full = dec + centers_rot[list_id][:, None, :]
                 ip = jnp.einsum("qd,qld->ql", q_rot, full,

@@ -245,6 +245,28 @@ class TestProbeCapPolicy:
                                                 probe_cap=len(q)))
         np.testing.assert_array_equal(np.asarray(im), np.asarray(ie))
 
+    def test_flat_bf16_internal_dtype(self, dataset, monkeypatch):
+        """bf16 candidate blocks (the internal_distance_dtype role
+        applied to IVF-Flat) must agree closely with the f32 path."""
+        import jax.numpy as jnp
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        x, q = dataset
+        index = ivf_flat.build(
+            x, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8))
+        df, i_f = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(n_probes=8,
+                                                scan_order="list"))
+        db_, i_b = ivf_flat.search(
+            index, q, 10, ivf_flat.SearchParams(
+                n_probes=8, scan_order="list",
+                internal_distance_dtype=jnp.bfloat16))
+        f, b = np.asarray(i_f), np.asarray(i_b)
+        overlap = np.mean([len(set(f[r]) & set(b[r])) / 10
+                           for r in range(len(f))])
+        assert overlap >= 0.9, overlap
+        np.testing.assert_allclose(np.asarray(db_), np.asarray(df),
+                                   rtol=0.02, atol=0.5)
+
     def test_pq_cap_cached(self, dataset):
         x, q = dataset
         index = ivf_pq.build(
