@@ -164,18 +164,27 @@ def merge_candidates(cand_d, cand_i, probes, inv_pos, k: int,
     return d, ids
 
 
-@functools.partial(jax.jit, static_argnames=("n_probes", "kind"))
-def coarse_probes(queries, centers, n_probes: int, kind: str = "l2"):
+@functools.partial(jax.jit, static_argnames=("n_probes", "kind",
+                                             "use_pallas"))
+def coarse_probes(queries, centers, n_probes: int, kind: str = "l2",
+                  use_pallas: bool = False):
     """Coarse phase (reference select_clusters, ivf_pq_search.cuh:127):
-    run separately so the host can size the inverted table from its
-    output before the fine-scan jit is staged. ``kind`` "ip" probes the
-    largest-dot-product centers."""
+    query×centers GEMM + n_probes-selection. ``kind`` "ip" probes the
+    largest-dot-product centers. With ``use_pallas`` the selection runs
+    through the exact Pallas ``select_k`` kernel (the warpsort slot) —
+    ``lax.top_k`` is a full variadic sort, tens of ms at
+    (1000, 1024+)-wide score matrices (BASELINE.md select_k rows), and
+    inside the fused single-dispatch search it would dominate the
+    coarse phase."""
     from raft_tpu.distance.pairwise import _l2_expanded
     if kind == "ip":
         coarse = -jnp.matmul(queries, centers.T,
                              precision=matmul_precision())
     else:
         coarse = _l2_expanded(queries, centers, sqrt=False)
+    if use_pallas and n_probes <= 256:
+        from raft_tpu.ops.pallas_select_k import select_k_pallas
+        return select_k_pallas(coarse, n_probes)[1]
     return lax.top_k(-coarse, n_probes)[1]
 
 
@@ -256,7 +265,8 @@ def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
 
 
 def resolve_cap(cache: Optional[dict], queries, centers, params,
-                n_probes: int, n_lists: int, kind: str = "l2") -> int:
+                n_probes: int, n_lists: int, kind: str = "l2",
+                use_pallas: bool = False) -> int:
     """Inverted-table width policy shared by IVF-Flat and IVF-PQ.
 
     ``params.probe_cap``: 0 (default) measures the drop-free cap once per
@@ -280,7 +290,12 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     key = (queries.shape[0], n_probes)
     if pc == 0 and cache is not None and key in cache:
         return cache[key]
-    probes = coarse_probes(queries, centers, n_probes, kind=kind)
+    # measure over the SAME coarse selection the serving search runs
+    # (use_pallas must match) — a tie resolved differently between two
+    # selection programs could otherwise push a list past the measured
+    # cap and silently shed probes in the drop-free modes
+    probes = coarse_probes(queries, centers, n_probes, kind=kind,
+                           use_pallas=use_pallas)
     cap = probe_cap(probes, n_lists)
     if pc == 0 and cache is not None:
         cache[key] = cap
@@ -314,7 +329,8 @@ def fused_list_search(queries, centers, data, norms, ids, scale, *,
     round-trips (``ivf_flat_search.cuh:1057``); on the tunneled axon
     platform each avoided dispatch saves ~22 ms, which is why the fused
     form, not the kernel, was the round-3 QPS lever."""
-    probes = coarse_probes(queries, centers, n_probes, kind=kind)
+    probes = coarse_probes(queries, centers, n_probes, kind=kind,
+                           use_pallas=use_pallas)
     if use_pallas:
         from raft_tpu.ops.pallas_ivf_scan import ivf_list_scan_pallas
         return ivf_list_scan_pallas(queries, data, norms, ids, probes, k,

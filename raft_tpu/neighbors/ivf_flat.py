@@ -396,7 +396,8 @@ def search(index: Index, queries, k: int,
         from raft_tpu.neighbors import _ivf_scan
         cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
                                     params, n_probes, index.n_lists,
-                                    kind=kind)
+                                    kind=kind,
+                                    use_pallas=pallas_enabled())
         d, i = _ivf_scan.fused_list_search(
             q, index.centers, index.lists_data, index.lists_norms,
             index.lists_indices, jnp.float32(index.scale), k=k,

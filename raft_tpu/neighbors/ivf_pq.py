@@ -684,7 +684,8 @@ def _fused_code_search(q, centers, centers_rot, rot, pq_centers, codes,
     round-3 QPS lever)."""
     from raft_tpu.neighbors import _ivf_scan
     from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
-    probes = _ivf_scan.coarse_probes(q, centers, n_probes, kind=kind)
+    probes = _ivf_scan.coarse_probes(q, centers, n_probes, kind=kind,
+                                     use_pallas=True)
     q_rot = jnp.matmul(q, rot.T, precision=matmul_precision())
     return ivf_pq_code_scan_pallas(
         q_rot, centers_rot, pq_centers, codes, code_norms, lists_indices,
@@ -756,7 +757,7 @@ def search(index: Index, queries, k: int,
         from raft_tpu.neighbors import _ivf_scan
         cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
                                     params, n_probes, index.n_lists,
-                                    kind=kind)
+                                    kind=kind, use_pallas=True)
         if (jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.float8_e4m3fn)
                 and kind == "l2"):
             # L2 epilogue must use norms of what the kernel decodes —

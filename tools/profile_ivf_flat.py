@@ -40,10 +40,17 @@ sp = ivf_flat.SearchParams(n_probes=nprobes)
 t = timed(lambda: ivf_flat.search(idx, q, k, sp))
 print(f"search e2e: {t*1000:.1f} ms -> {nq/t:.0f} QPS")
 
-# stage 1: coarse probes
-probes = _ivf_scan.coarse_probes(q, idx.centers, nprobes)
-t = timed(lambda: _ivf_scan.coarse_probes(q, idx.centers, nprobes))
-print(f"coarse: {t*1000:.1f} ms")
+# stage 1: coarse probes — time the path the serving search runs
+# (Pallas select_k on TPU) plus the lax.top_k variant for comparison
+from raft_tpu.ops.dispatch import pallas_enabled
+up = pallas_enabled()
+probes = _ivf_scan.coarse_probes(q, idx.centers, nprobes, use_pallas=up)
+t = timed(lambda: _ivf_scan.coarse_probes(q, idx.centers, nprobes,
+                                          use_pallas=up))
+print(f"coarse[pallas={up}]: {t*1000:.1f} ms")
+if up:
+    t = timed(lambda: _ivf_scan.coarse_probes(q, idx.centers, nprobes))
+    print(f"coarse[top_k]: {t*1000:.1f} ms")
 cap = _ivf_scan.probe_cap(probes, nlists)
 print("cap:", cap)
 
