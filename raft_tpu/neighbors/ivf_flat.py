@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core import trace
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -206,23 +207,27 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
                               DistanceType.InnerProduct,
                               DistanceType.CosineExpanded),
             "ivf_flat: unsupported metric %s", params.metric)
-    if params.metric == DistanceType.CosineExpanded:
-        x = x / jnp.maximum(
-            jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
-    n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
-    # random trainset subsample — a prefix would bias centers when input
-    # rows arrive ordered (reference subsamples too)
-    if n_train < n:
-        sel = jax.random.choice(jax.random.key(0), n, (n_train,),
-                                replace=False)
-        trainset = x[sel]
-    else:
-        trainset = x
-    centers = kmeans_balanced.build_hierarchical(
-        trainset, params.n_lists, params.kmeans_n_iters, res=res)
-    labels = kmeans_balanced.predict(x, centers, res=res)
-    data, idx, norms, counts = _bucketize(x, labels, params.n_lists)
-    data, norms, scale = _quantize_lists(data, norms, params.storage_dtype)
+    # RAII range like the reference's nvtx scope in build (nvtx.hpp:69)
+    with trace.range("ivf_flat::build(%d, %d)", n, params.n_lists):
+        if params.metric == DistanceType.CosineExpanded:
+            x = x / jnp.maximum(
+                jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
+        n_train = max(params.n_lists,
+                      int(n * params.kmeans_trainset_fraction))
+        # random trainset subsample — a prefix would bias centers when
+        # input rows arrive ordered (reference subsamples too)
+        if n_train < n:
+            sel = jax.random.choice(jax.random.key(0), n, (n_train,),
+                                    replace=False)
+            trainset = x[sel]
+        else:
+            trainset = x
+        centers = kmeans_balanced.build_hierarchical(
+            trainset, params.n_lists, params.kmeans_n_iters, res=res)
+        labels = kmeans_balanced.predict(x, centers, res=res)
+        data, idx, norms, counts = _bucketize(x, labels, params.n_lists)
+        data, norms, scale = _quantize_lists(data, norms,
+                                             params.storage_dtype)
     return Index(centers=centers, lists_data=data, lists_indices=idx,
                  lists_norms=norms, list_sizes=counts,
                  metric=params.metric, size=n, scale=scale)
@@ -392,22 +397,26 @@ def search(index: Index, queries, k: int,
                      or (params.scan_order == "auto"
                          and list_order_auto(nq, n_probes,
                                              index.n_lists))))
-    if use_list:
-        from raft_tpu.neighbors import _ivf_scan
-        cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
-                                    params, n_probes, index.n_lists,
-                                    kind=kind,
-                                    use_pallas=pallas_enabled())
-        d, i = _ivf_scan.fused_list_search(
-            q, index.centers, index.lists_data, index.lists_norms,
-            index.lists_indices, jnp.float32(index.scale), k=k,
-            n_probes=n_probes, cap=cap, bins=params.scan_bins,
-            sqrt=sqrt, kind=kind, use_pallas=pallas_enabled(),
-            gather=_ivf_scan.gather_mode(),
-            internal_dtype=params.internal_distance_dtype)
-        return _postprocess(d, index.metric), i
-    d, i = _search_impl(q, index.centers, index.lists_data,
-                        index.lists_indices, index.lists_norms,
-                        jnp.float32(index.scale), k, n_probes, sqrt,
-                        kind=kind)
+    # RAII range at the public search (the reference's nvtx scope slot);
+    # covers both the list-major and probe-major paths
+    with trace.range("ivf_flat::search(%s)",
+                     "list" if use_list else "probe"):
+        if use_list:
+            from raft_tpu.neighbors import _ivf_scan
+            cap = _ivf_scan.resolve_cap(index.cap_cache, q,
+                                        index.centers, params, n_probes,
+                                        index.n_lists, kind=kind,
+                                        use_pallas=pallas_enabled())
+            d, i = _ivf_scan.fused_list_search(
+                q, index.centers, index.lists_data, index.lists_norms,
+                index.lists_indices, jnp.float32(index.scale), k=k,
+                n_probes=n_probes, cap=cap, bins=params.scan_bins,
+                sqrt=sqrt, kind=kind, use_pallas=pallas_enabled(),
+                gather=_ivf_scan.gather_mode(),
+                internal_dtype=params.internal_distance_dtype)
+        else:
+            d, i = _search_impl(q, index.centers, index.lists_data,
+                                index.lists_indices, index.lists_norms,
+                                jnp.float32(index.scale), k, n_probes,
+                                sqrt, kind=kind)
     return _postprocess(d, index.metric), i

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core import trace
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -755,70 +756,78 @@ def search(index: Index, queries, k: int,
             "(resolved scan_mode is %r)", scan_mode)
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
-        cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
-                                    params, n_probes, index.n_lists,
-                                    kind=kind, use_pallas=True)
-        if (jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.float8_e4m3fn)
-                and kind == "l2"):
-            # L2 epilogue must use norms of what the kernel decodes —
-            # the fp8-quantized books (reference fp_8bit tier; the LUT
-            # there carries the same quantization in its distance terms)
-            if index.code_norms_fp8 is None:
-                books8 = index.pq_centers.astype(
-                    jnp.float8_e4m3fn).astype(jnp.float32)
-                fn = (_code_norms_per_cluster if per_cluster
-                      else _code_norms)
-                index.code_norms_fp8 = fn(index.codes, books8,
-                                          index.lists_indices)
-            code_norms = index.code_norms_fp8
-        else:
-            code_norms = _norms(index)  # derives once for older indexes
-        d, i = _fused_code_search(
-            q, index.centers, index.centers_rot, index.rotation_matrix,
-            index.pq_centers, index.codes, code_norms,
-            index.lists_indices, k=k, n_probes=n_probes, cap=cap,
-            bins=params.scan_bins, sqrt=sqrt, kind=kind,
-            lut_dtype=params.lut_dtype,
-            internal_dtype=params.internal_distance_dtype,
-            per_cluster=per_cluster, gather=_ivf_scan.gather_mode())
-        return _postprocess(d, index.metric), i
-    if scan_mode == "reconstruct":
-        if index.decoded is None:
-            dec_fn = (_decode_lists_per_cluster if per_cluster
-                      else _decode_lists)
-            index.decoded = dec_fn(
-                index.codes, index.pq_centers, index.lists_indices)
-        if index.decoded_norms is None:
-            # alias the exact build-time norms — same quantity, no copy
-            index.decoded_norms = _norms(index)
-        nq = q.shape[0]
-        from raft_tpu.neighbors.ann_types import list_order_auto
-        use_list = (kind == "l2"
-                    and (params.scan_order == "list"
-                         or (params.scan_order == "auto"
-                             and list_order_auto(nq, n_probes,
-                                                 index.n_lists))))
-        if use_list:
-            from raft_tpu.neighbors import _ivf_scan
+        # RAII range (reference nvtx scope in search, ivf_pq_search.cuh:
+        # 1263): exception-safe, unlike a bare push/pop pair
+        with trace.range("ivf_pq::search(codes)"):
             cap = _ivf_scan.resolve_cap(index.cap_cache, q,
                                         index.centers, params, n_probes,
-                                        index.n_lists)
-            # lists hold decoded rotated residuals: the scan offsets each
-            # list's queries by its rotated center so the einsum scores
-            # ||(q_rot - c_l) - decoded||²
-            return _ivf_scan.fused_reconstruct_list_search(
+                                        index.n_lists, kind=kind,
+                                        use_pallas=True)
+            if (jnp.dtype(params.lut_dtype)
+                    == jnp.dtype(jnp.float8_e4m3fn) and kind == "l2"):
+                # L2 epilogue must use norms of what the kernel decodes
+                # — the fp8-quantized books (reference fp_8bit tier; the
+                # LUT there carries the same quantization in its
+                # distance terms)
+                if index.code_norms_fp8 is None:
+                    books8 = index.pq_centers.astype(
+                        jnp.float8_e4m3fn).astype(jnp.float32)
+                    fn = (_code_norms_per_cluster if per_cluster
+                          else _code_norms)
+                    index.code_norms_fp8 = fn(index.codes, books8,
+                                              index.lists_indices)
+                code_norms = index.code_norms_fp8
+            else:
+                code_norms = _norms(index)  # derives once, older indexes
+            d, i = _fused_code_search(
+                q, index.centers, index.centers_rot,
+                index.rotation_matrix, index.pq_centers, index.codes,
+                code_norms, index.lists_indices, k=k, n_probes=n_probes,
+                cap=cap, bins=params.scan_bins, sqrt=sqrt, kind=kind,
+                lut_dtype=params.lut_dtype,
+                internal_dtype=params.internal_distance_dtype,
+                per_cluster=per_cluster, gather=_ivf_scan.gather_mode())
+        return _postprocess(d, index.metric), i
+    if scan_mode == "reconstruct":
+        with trace.range("ivf_pq::search(reconstruct)"):
+            if index.decoded is None:
+                dec_fn = (_decode_lists_per_cluster if per_cluster
+                          else _decode_lists)
+                index.decoded = dec_fn(
+                    index.codes, index.pq_centers, index.lists_indices)
+            if index.decoded_norms is None:
+                # alias the exact build-time norms — same quantity
+                index.decoded_norms = _norms(index)
+            nq = q.shape[0]
+            from raft_tpu.neighbors.ann_types import list_order_auto
+            use_list = (kind == "l2"
+                        and (params.scan_order == "list"
+                             or (params.scan_order == "auto"
+                                 and list_order_auto(nq, n_probes,
+                                                     index.n_lists))))
+            if use_list:
+                from raft_tpu.neighbors import _ivf_scan
+                cap = _ivf_scan.resolve_cap(index.cap_cache, q,
+                                            index.centers, params,
+                                            n_probes, index.n_lists)
+                # lists hold decoded rotated residuals: the scan offsets
+                # each list's queries by its rotated center so the einsum
+                # scores ||(q_rot - c_l) - decoded||²
+                return _ivf_scan.fused_reconstruct_list_search(
+                    q, index.centers, index.centers_rot,
+                    index.rotation_matrix, index.decoded,
+                    index.decoded_norms, index.lists_indices, k=k,
+                    n_probes=n_probes, cap=cap, bins=params.scan_bins,
+                    sqrt=sqrt)
+            d, i = _search_impl_reconstruct(
                 q, index.centers, index.centers_rot,
                 index.rotation_matrix, index.decoded,
-                index.decoded_norms, index.lists_indices, k=k,
-                n_probes=n_probes, cap=cap, bins=params.scan_bins,
-                sqrt=sqrt)
-        d, i = _search_impl_reconstruct(
-            q, index.centers, index.centers_rot, index.rotation_matrix,
-            index.decoded, index.decoded_norms, index.lists_indices,
-            k, n_probes, sqrt, kind=kind)
+                index.decoded_norms, index.lists_indices,
+                k, n_probes, sqrt, kind=kind)
         return _postprocess(d, index.metric), i
-    d, i = _search_impl(q, index.centers, index.centers_rot,
-                        index.rotation_matrix, index.pq_centers,
-                        index.codes, index.lists_indices, k, n_probes,
-                        sqrt, kind=kind, per_cluster=per_cluster)
+    with trace.range("ivf_pq::search(lut)"):
+        d, i = _search_impl(q, index.centers, index.centers_rot,
+                            index.rotation_matrix, index.pq_centers,
+                            index.codes, index.lists_indices, k, n_probes,
+                            sqrt, kind=kind, per_cluster=per_cluster)
     return _postprocess(d, index.metric), i
