@@ -9,15 +9,13 @@ rank — runnable on a real multi-chip mesh or the virtual CPU mesh alike.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, ReduceOp, build_comms
+from raft_tpu.comms.comms import build_comms
 
 
 def _shmap(mesh, comms, fn, replicated_out=True):

@@ -12,7 +12,6 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import distance
 

@@ -41,7 +41,6 @@ from raft_tpu.core import trace
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.distance.pairwise import _l2_expanded
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.neighbors.ivf_flat import _bucketize
 from raft_tpu.core.precision import matmul_precision

@@ -30,7 +30,6 @@ from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.precision import matmul_precision
 from raft_tpu.comms.comms import build_comms
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.distance.pairwise import _l2_expanded
 
 
 def _shard0(arr, mesh, axis):

@@ -22,7 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors.brute_force import _knn_scan, _db_tile
-from raft_tpu.comms.comms import Comms, build_comms
+from raft_tpu.comms.comms import build_comms
 
 
 def _merge(d_a, i_a, d_b, i_b, k: int):

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from raft_tpu.sparse.csr import CSR
 from raft_tpu.sparse.linalg import laplacian, spmv
-from raft_tpu.sparse.solver.lanczos import lanczos_largest, lanczos_smallest
+from raft_tpu.sparse.solver.lanczos import lanczos_largest
 from raft_tpu.spectral.eigen_solvers import (
     ClusterSolverConfig,
     EigenSolverConfig,
