@@ -1,0 +1,97 @@
+"""Failure detection + clique re-formation — the full recovery loop.
+
+The reference's contract is "abort comm, caller recreates clique"
+(``comms/detail/util.hpp:130-133``): NCCL async-error polling returns
+ABORT and the deployment layer rebuilds the communicator without the
+dead rank. raft_tpu upgrades the detection side (heartbeats name the
+suspect, ``comms/health.py``) and this example shows the CALLER side of
+the contract — what a driver loop looks like:
+
+  1. run collectives through ``dispatch_checked`` with a HealthMonitor;
+  2. on ABORT/ERROR read ``monitor.last_suspects``;
+  3. re-form the clique: a NEW mesh over the surviving devices + a
+     fresh communicator (XLA subgroup collectives need equal-size
+     groups, so rank exclusion is a mesh re-formation, not a
+     comm_split), reshard, continue.
+
+Runs on the virtual CPU mesh (a stopped monitor stands in for a dead
+rank, as in tests/test_comms.py; the 2-process drill there exercises
+the real process-death surfaces).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python examples/04_failure_recovery.py
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.comms import Status, build_comms
+from raft_tpu.comms.health import HealthMonitor, _InProcessBoard
+from raft_tpu.parallel import make_mesh
+
+N_RANKS = 8
+mesh = make_mesh(axis_names=("data",))
+comms = build_comms(mesh, "data")
+
+# every rank heartbeats a shared board (across hosts this is the
+# coordination-service KV / native TCP broker; in-process for the demo)
+board = _InProcessBoard()
+monitors = [HealthMonitor(r, N_RANKS, session="demo", interval_s=0.05,
+                          stale_after_s=0.4, board=board).start()
+            for r in range(N_RANKS)]
+me = monitors[0]  # this process acts as rank 0
+
+x = jnp.arange(N_RANKS, dtype=jnp.float32).reshape(N_RANKS, 1)
+step = jax.jit(jax.shard_map(lambda v: comms.allreduce(v), mesh=mesh,
+                             in_specs=P("data"), out_specs=P()))
+
+# -- healthy step ----------------------------------------------------------
+st, out = comms.dispatch_checked(step, x, monitor=me, timeout_s=30.0)
+assert st == Status.SUCCESS
+print(f"step 1: SUCCESS, allreduce = "
+      f"{float(np.asarray(out).ravel()[0]):.0f}")
+
+# -- rank 5 dies mid-job ---------------------------------------------------
+monitors[5].stop()          # heartbeats stop: the rank has gone silent
+time.sleep(0.8)             # past stale_after_s
+
+# on real hardware the NEXT collective would hang (TPU) or error at
+# dispatch (CPU/Gloo); dispatch_checked turns either into ABORT/ERROR
+# with the suspects named. Here the mesh is in-process so the collective
+# itself still completes — ask the monitor directly, as sync_stream does.
+suspects = me.suspect_ranks()
+assert suspects == [5], suspects
+print(f"step 2: failure detected, suspects = {suspects}")
+
+# -- re-form the clique without the suspect (the reference's 'caller
+# recreates clique'). XLA subgroup collectives need EQUAL-size groups,
+# so excluding one rank is not a comm_split — recovery builds a NEW
+# mesh over the surviving devices and a fresh communicator on it, then
+# reshards the work (this is what `sync_stream`'s ABORT contract hands
+# back to the deployment layer; docs/scaling.md step 4) -----------------
+live = [d for r, d in enumerate(mesh.devices.ravel())
+        if r not in suspects]
+mesh2 = make_mesh(devices=live, axis_names=("data",))
+survivors = build_comms(mesh2, "data")
+print(f"step 3: re-formed mesh over {survivors.get_size()} survivors")
+
+# reshard the survivors' rows onto the new mesh and continue
+x2 = jax.device_put(np.asarray(x)[[r for r in range(N_RANKS)
+                                   if r not in suspects]],
+                    NamedSharding(mesh2, P("data")))
+step2 = jax.jit(jax.shard_map(
+    lambda v: survivors.allreduce(v), mesh=mesh2, in_specs=P("data"),
+    out_specs=P()))
+out2 = np.asarray(step2(x2))
+want = sum(r for r in range(N_RANKS) if r != 5)
+assert float(out2.ravel()[0]) == want, out2
+print(f"step 4: work continues on survivors, allreduce = "
+      f"{float(out2.ravel()[0]):.0f} (expected {want})")
+
+for m in monitors:
+    m.stop()
+print("recovery loop complete")
