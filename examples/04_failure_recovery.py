@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.comms import Status, build_comms
-from raft_tpu.comms.health import HealthMonitor, _InProcessBoard
+from raft_tpu.comms.health import HealthMonitor
 from raft_tpu.parallel import make_mesh
 
 N_RANKS = 8
@@ -38,10 +38,10 @@ mesh = make_mesh(axis_names=("data",))
 comms = build_comms(mesh, "data")
 
 # every rank heartbeats a shared board (across hosts this is the
-# coordination-service KV / native TCP broker; in-process for the demo)
-board = _InProcessBoard()
+# coordination-service KV / native TCP broker; sessions share the
+# in-process default board in this single-process demo)
 monitors = [HealthMonitor(r, N_RANKS, session="demo", interval_s=0.05,
-                          stale_after_s=0.4, board=board).start()
+                          stale_after_s=0.4).start()
             for r in range(N_RANKS)]
 me = monitors[0]  # this process acts as rank 0
 

@@ -84,6 +84,23 @@ class TestKMeans:
         rnd_c = sample_centroids(x, 5, seed=0)
         assert float(cluster_cost(x, cpp_c)) <= float(cluster_cost(x, rnd_c)) * 1.5
 
+    def test_min_cluster_distance_and_counts(self, blobs):
+        # the remaining public building blocks (reference kmeans.cuh:
+        # 51-953 exposes minClusterDistance / countSamplesInCluster)
+        from raft_tpu.cluster.kmeans import (count_samples_in_cluster,
+                                             min_cluster_distance)
+        x, _ = blobs
+        c, _, _ = fit(x, KMeansParams(n_clusters=5, max_iter=5, seed=0))
+        d = np.asarray(min_cluster_distance(x, c))
+        # every min-distance equals the distance to the assigned center
+        lbl = np.asarray(predict(x, c))
+        want = ((np.asarray(x) - np.asarray(c)[lbl]) ** 2).sum(1)
+        np.testing.assert_allclose(d, want, rtol=1e-3, atol=1e-2)
+        counts = np.asarray(count_samples_in_cluster(x, c))
+        assert counts.sum() == len(np.asarray(x))
+        np.testing.assert_array_equal(
+            counts, np.bincount(lbl, minlength=5))
+
     def test_fit_predict(self, blobs):
         x, y = blobs
         labels, centroids, inertia, n_iter = fit_predict(
