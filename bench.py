@@ -62,6 +62,8 @@ def _init_backend_with_retry(jax, attempts=4, base_sleep=5.0):
 def child_main():
     import numpy as np
     import jax
+    from raft_tpu.core.compile_cache import enable as _enable_cache
+    _enable_cache()  # cold compiles cost 20-40 s each via the tunnel
     # BENCH_PLATFORM=cpu for smoke/degraded runs: the env-var route
     # (JAX_PLATFORMS) is overridden by the host sitecustomize, so the
     # config API is the only reliable selector

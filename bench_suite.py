@@ -425,6 +425,8 @@ def run_all(cases=None):
     import jax
     if "BENCH_PLATFORM" in os.environ:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from raft_tpu.core.compile_cache import enable as _enable_cache
+    _enable_cache()  # cross-process warm kernels (AOT-kernel role)
     results = []
     selected = _CASES if not cases else [
         c for c in _CASES if c.__name__.removeprefix("bench_") in cases]

@@ -52,6 +52,8 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
         y = data_ref[l]                                  # (ML, dim)
         ml = y.shape[0]
         cap = q.shape[0]
+        norms_l = norms_ref[l, 0]                        # (ML,)
+        ids = ids_ref[l, 0]                              # (ML,) int32
         if y.dtype == jnp.bfloat16:
             ip = jax.lax.dot_general(
                 y, q.astype(jnp.bfloat16), (((1,), (1,)), ((), ())),
@@ -65,7 +67,6 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
                 preferred_element_type=jnp.float32)
         else:
             ip = dot_nt_f32(y, q, precision)             # (ML, cap)
-        ids = ids_ref[l]                                 # (ML,) int32
         ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
         if metric == "ip":
             # similarity → negate: smaller-is-better uniformly (the
@@ -74,7 +75,7 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
         else:
             qq = jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32),
                          axis=1)[None, :]                # (1, cap)
-            d = norms_ref[l][:, None] + qq - 2.0 * ip
+            d = norms_l[:, None] + qq - 2.0 * ip
             d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
 
         # STRIDED bins (row r → bin r % B): bucketized rows follow
@@ -106,14 +107,21 @@ def _list_scan_call(qsub, data, norms, ids, bins: int, lc: int,
     # scale rides as a (1,1) traced input: a static arg would recompile
     # the kernel for every distinct int8 index scale
     scale_arr = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    # norms/ids ride with a singleton middle axis: Mosaic constrains the
+    # LAST TWO block dims (divisible by (8, 128) or equal to the array
+    # dim); as 2-D (lc, max_list) blocks the lc slot is constrained and
+    # lc < 8 fails to lower — as (lc, 1, max_list) the constrained pair
+    # is (1, max_list) == the array dims, legal for every lc
+    norms3 = norms[:, None, :]
+    ids3 = ids[:, None, :]
     cd, ci = pl.pallas_call(
         kern,
         grid=(gc,),
         in_specs=[pl.BlockSpec((1, 1), lambda g: (0, 0)),
                   pl.BlockSpec((lc, cap, dim), lambda g: (g, 0, 0)),
                   pl.BlockSpec((lc, max_list, dim), lambda g: (g, 0, 0)),
-                  pl.BlockSpec((lc, max_list), lambda g: (g, 0)),
-                  pl.BlockSpec((lc, max_list), lambda g: (g, 0))],
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0))],
         out_specs=[pl.BlockSpec((lc, bins, cap), lambda g: (g, 0, 0)),
                    pl.BlockSpec((lc, bins, cap), lambda g: (g, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((n_lists, bins, cap), out_dtype),
@@ -127,7 +135,7 @@ def _list_scan_call(qsub, data, norms, ids, bins: int, lc: int,
                             + 8 * n_lists * bins * cap),
             transcendentals=0),
         interpret=interpret,
-    )(scale_arr, qsub, data, norms, ids)
+    )(scale_arr, qsub, data, norms3, ids3)
     return cd, ci
 
 
@@ -287,13 +295,13 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
         q.astype(operand), dec_t.astype(operand),
         (((1,), (0,)), ((), ())), precision=prec,
         preferred_element_type=jnp.float32)              # (cap, ML)
-    ids = ids_ref[0]                                     # (ML,)
+    ids = ids_ref[0, 0]                                  # (ML,)
     ids_b = jnp.broadcast_to(ids[None, :], (cap, ml))
     if metric == "ip":
         d = jnp.where(ids_b >= 0, -ip, jnp.inf)
     else:
         rr = jnp.sum(q * q, axis=1)[:, None]             # (cap, 1)
-        d = rr + norms_ref[0][None, :] - 2.0 * ip
+        d = rr + norms_ref[0, 0][None, :] - 2.0 * ip
         d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
 
     # strided bins along the row axis (row r → bin r % B), row-major
@@ -333,14 +341,19 @@ def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
                   if per_cluster else
                   pl.BlockSpec((pq_dim, n_codes, pq_len),
                                lambda g: (0, 0, 0)))
+    # norms/ids carry a singleton middle axis (see _list_scan_call): the
+    # 2-D (1, max_list) block put 1 in a Mosaic-constrained slot and
+    # failed to lower on real TPU (r3 measurement)
+    norms3 = norms[:, None, :]
+    ids3 = ids[:, None, :]
     cd, ci = pl.pallas_call(
         kern,
         grid=(n_cells,),
         in_specs=[pl.BlockSpec((1, cap, rot_dim),
                                lambda g: (g // split, 0, 0)),
                   pl.BlockSpec((1, max_list, pq_dim), lambda g: (g, 0, 0)),
-                  pl.BlockSpec((1, max_list), lambda g: (g, 0)),
-                  pl.BlockSpec((1, max_list), lambda g: (g, 0)),
+                  pl.BlockSpec((1, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((1, 1, max_list), lambda g: (g, 0, 0)),
                   books_spec],
         out_specs=[pl.BlockSpec((1, cap, bins), lambda g: (g, 0, 0)),
                    pl.BlockSpec((1, cap, bins), lambda g: (g, 0, 0))],
@@ -356,7 +369,7 @@ def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
                             + 8 * n_cells * cap * bins),
             transcendentals=0),
         interpret=interpret,
-    )(qsub, jax.lax.bitcast_convert_type(codes, jnp.int8), norms, ids,
+    )(qsub, jax.lax.bitcast_convert_type(codes, jnp.int8), norms3, ids3,
       books)
     return cd, ci
 

@@ -15,6 +15,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
 print(jax.devices())
 
 from raft_tpu.cluster import kmeans_balanced

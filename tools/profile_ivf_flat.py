@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
 print(jax.devices())
 
 from raft_tpu.neighbors import ivf_flat

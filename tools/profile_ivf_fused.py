@@ -1,0 +1,92 @@
+"""Operating-point A/B for the fused IVF-Flat search on the real chip.
+
+The round-3 fused search is ONE dispatch; what remains is choosing the
+(cap, bins, internal_dtype) operating point. The first TPU profile
+(tools/measure_out/ivf_flat_rows.log) showed the drop-free measured cap
+is 256 while the MEAN probe load is 64 — the kernel, the query gather
+and the candidate blocks all scale with cap, so a pinned cap that sheds
+the overflow of the hottest lists (priority-ordered: lowest-rank probes
+drop first) trades a little recall for up to 4x less fine-phase work.
+``bins`` similarly scales the merge width (n_probes*bins) and candidate
+writeback.
+
+Methodology: chained marginal in-jit time (the gbench stream model —
+bench.py run_chain) + recall vs the exact scan, for each combo; brute
+force chained under the same harness is the line to beat.
+
+Run: PYTHONPATH=.:/root/.axon_site python tools/profile_ivf_fused.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
+print(jax.devices())
+
+from raft_tpu.neighbors import ivf_flat, brute_force
+
+key = jax.random.key(0)
+n, d, nq, k, nlists, nprobes = 500_000, 128, 1000, 32, 1024, 64
+CHAIN = 8
+db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+qs = jax.random.normal(jax.random.fold_in(key, 2), (CHAIN, nq, d))
+q0 = qs[0]
+jax.block_until_ready((db, qs))
+
+t0 = time.perf_counter()
+idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=nlists,
+                                              kmeans_n_iters=10))
+jax.block_until_ready(idx.lists_data)
+print("build", round(time.perf_counter() - t0, 1), "s; max_list",
+      idx.lists_data.shape[1])
+
+# ground truth for recall
+gt_d, gt_i = brute_force.brute_force_knn(db, q0, k, mode="exact")
+gt = np.asarray(jax.device_get(gt_i))
+jax.block_until_ready(gt_d)
+
+
+def chained(fn):
+    """Marginal in-jit ms per call: CHAIN calls chained in one jit."""
+    @jax.jit
+    def run(qb):
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(CHAIN):
+            dd, ii = fn(qb[i])
+            acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
+        return acc
+    jax.block_until_ready(run(qs))  # compile + warm
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(qs))
+        best = min(best, (time.perf_counter() - t0) / CHAIN)
+    return best * 1e3
+
+
+def recall_of(ii):
+    got = np.asarray(jax.device_get(ii))
+    hits = sum(len(set(got[r]) & set(gt[r])) for r in range(nq))
+    return hits / (nq * k)
+
+
+ms = chained(lambda qb: brute_force.brute_force_knn(
+    db, qb, k, mode="fused"))
+print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS")
+
+for cap in (256, 128, 64):
+    for bins in (128, 64):
+        for idt in (jnp.float32, jnp.bfloat16):
+            sp = ivf_flat.SearchParams(
+                n_probes=nprobes, scan_order="list", probe_cap=cap,
+                scan_bins=bins, internal_distance_dtype=idt)
+            dd, ii = ivf_flat.search(idx, q0, k, sp)
+            rec = recall_of(ii)
+            ms = chained(lambda qb, sp=sp: ivf_flat.search(idx, qb, k, sp))
+            tag = "bf16" if idt == jnp.bfloat16 else "f32"
+            print(f"cap={cap:3d} bins={bins:3d} idt={tag}: "
+                  f"{ms:6.2f} ms -> {nq/ms*1000:7.0f} QPS  "
+                  f"recall@{k}={rec:.4f}", flush=True)
