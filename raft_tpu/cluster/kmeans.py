@@ -29,6 +29,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+from raft_tpu.util.host_sample import sample_rows
 
 
 def _weighted_update(x, labels, weights, n_clusters: int):
@@ -124,9 +125,9 @@ def sample_centroids(x, n_clusters: int, seed: int = 0, res=None) -> jax.Array:
     """Random distinct-point seeding (reference initRandom /
     sample_centroids)."""
     x = as_array(x)
-    idx = jax.random.choice(jax.random.key(seed), x.shape[0],
-                            (n_clusters,), replace=False)
-    return x[idx]
+    # host-side draw (util.host_sample): a traced choice(replace=False)
+    # is an n-wide sort compile on TPU
+    return x[sample_rows(x.shape[0], n_clusters, seed)]
 
 
 def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,

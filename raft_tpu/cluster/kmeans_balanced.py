@@ -24,6 +24,7 @@ from jax import lax
 
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+from raft_tpu.util.host_sample import sample_rows
 
 
 def _nn(x, centers):
@@ -76,9 +77,9 @@ def balanced_kmeans(x, n_clusters: int, n_iters: int = 20,
     """Train ``n_clusters`` balanced centers (reference
     balancing_em_iters :628). Returns (n_clusters, dim) centers."""
     x = as_array(x).astype(jnp.float32)
-    key = jax.random.key(seed)
-    idx = jax.random.choice(key, x.shape[0], (n_clusters,), replace=False)
-    centers0 = x[idx]
+    # init indices sampled HOST-side (util.host_sample rationale: a
+    # traced choice(replace=False) is an n-wide sort compile)
+    centers0 = x[sample_rows(x.shape[0], n_clusters, seed)]
     return _em(x, centers0, n_clusters, n_iters, balance_threshold)
 
 
@@ -91,12 +92,12 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
     the full center set."""
     x = as_array(x).astype(jnp.float32)
     n = x.shape[0]
-    key = jax.random.key(seed)
 
-    # subsample trainset (reference ivf builds train on a subset)
+    # subsample trainset (reference ivf builds train on a subset) —
+    # host-side draw for the same no-giant-sort-compile reason as in
+    # balanced_kmeans
     if n > max_train_points:
-        sel = jax.random.choice(key, n, (max_train_points,), replace=False)
-        xt = x[sel]
+        xt = x[sample_rows(n, max_train_points, seed)]
     else:
         xt = x
     nt = xt.shape[0]

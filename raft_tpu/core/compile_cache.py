@@ -25,13 +25,20 @@ from __future__ import annotations
 import os
 
 _enabled = False
+_active_path = None
 
 
 def enable(path: str | None = None) -> bool:
     """Idempotently turn on the persistent compilation cache. Returns
     True if the cache is active after the call."""
-    global _enabled
+    global _enabled, _active_path
     if _enabled:
+        if path is not None and path != _active_path:
+            import warnings
+            warnings.warn(
+                f"raft_tpu compile cache already enabled at "
+                f"{_active_path!r}; ignoring new path {path!r} (JAX has "
+                f"one cache dir per process)")
         return True
     env = os.environ.get("RAFT_TPU_COMPILE_CACHE", "")
     if env == "0":
@@ -57,4 +64,5 @@ def enable(path: str | None = None) -> bool:
                       f"compiles will not be reused across processes")
         return False
     _enabled = True
+    _active_path = path
     return True

@@ -36,6 +36,7 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import _l2_expanded
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.precision import matmul_precision
+from raft_tpu.util.host_sample import sample_rows
 
 
 @dataclass
@@ -215,11 +216,11 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         n_train = max(params.n_lists,
                       int(n * params.kmeans_trainset_fraction))
         # random trainset subsample — a prefix would bias centers when
-        # input rows arrive ordered (reference subsamples too)
+        # input rows arrive ordered (reference subsamples too); drawn
+        # host-side (util.host_sample): a traced choice(replace=False)
+        # is an n-wide sort compile on TPU
         if n_train < n:
-            sel = jax.random.choice(jax.random.key(0), n, (n_train,),
-                                    replace=False)
-            trainset = x[sel]
+            trainset = x[sample_rows(n, n_train, 0)]
         else:
             trainset = x
         centers = kmeans_balanced.build_hierarchical(

@@ -44,6 +44,7 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.neighbors.ivf_flat import _bucketize
 from raft_tpu.core.precision import matmul_precision
+from raft_tpu.util.host_sample import sample_rows
 
 
 class CodebookGen(enum.IntEnum):
@@ -325,9 +326,9 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
 
     n_train = max(params.n_lists, int(n * params.kmeans_trainset_fraction))
     if n_train < n:
-        sel = jax.random.choice(jax.random.key(seed), n, (n_train,),
-                                replace=False)
-        trainset = x[sel]
+        # host-side draw (util.host_sample): a traced
+        # choice(replace=False) is an n-wide sort compile on TPU
+        trainset = x[sample_rows(n, n_train, seed)]
     else:
         trainset = x
     centers = kmeans_balanced.build_hierarchical(
@@ -378,9 +379,7 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
 
     n_cb_train = min(n, 1 << 16)
     if n_cb_train < n:
-        cb_sel = jax.random.choice(jax.random.key(seed + 3), n,
-                                   (n_cb_train,), replace=False)
-        cb_trainset = residuals_rot[cb_sel]
+        cb_trainset = residuals_rot[sample_rows(n, n_cb_train, seed + 3)]
     else:
         cb_trainset = residuals_rot
     pq_centers = _train_codebooks_per_subspace(

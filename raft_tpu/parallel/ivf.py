@@ -30,6 +30,7 @@ from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.precision import matmul_precision
 from raft_tpu.comms.comms import build_comms
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.util.host_sample import sample_rows
 
 
 def _shard0(arr, mesh, axis):
@@ -522,8 +523,9 @@ def distributed_ivf_pq_build(
 
     # 2) codebooks on a bounded subsample (replicated training)
     m = min(n, 1 << 15)
-    sel = jax.random.choice(jax.random.key(seed + 3), n, (m,),
-                            replace=False) if m < n else jnp.arange(n)
+    # host-side draw (util.host_sample): a traced choice(replace=False)
+    # is an n-wide sort compile (minutes at 10M+ rows)
+    sel = sample_rows(n, m, seed + 3) if m < n else jnp.arange(n)
     xs_cb = x[sel]
     lbl_cb = jnp.argmin(_coarse_scores(xs_cb, centers, kind), axis=1)
     resid_cb = jnp.matmul(xs_cb - centers[lbl_cb], rot.T,

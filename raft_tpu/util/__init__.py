@@ -17,10 +17,11 @@ counterpart here. What does carry over:
 from raft_tpu.util.pow2_utils import (Pow2, round_up_pow2, round_down_pow2,
                                       is_pow2)
 from raft_tpu.util.cache import VecCache
+from raft_tpu.util.host_sample import sample_rows
 from raft_tpu.util.scatter import scatter, scatter_if
 from raft_tpu.util.seive import Seive
 
 __all__ = [
     "Pow2", "round_up_pow2", "round_down_pow2", "is_pow2",
-    "VecCache", "scatter", "scatter_if", "Seive",
+    "VecCache", "sample_rows", "scatter", "scatter_if", "Seive",
 ]

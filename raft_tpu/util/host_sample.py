@@ -1,0 +1,43 @@
+"""Host-side distinct-row sampling for trainset/init subsets.
+
+A traced ``jax.random.choice(..., replace=False)`` lowers to a
+full-width permutation — an n-wide sort whose first compile on the
+tunneled TPU platform takes minutes at n ≥ ~100k and has wedged the
+remote-compile service outright (see ``.claude/skills/verify``). Every
+in-library use of without-replacement sampling is *seeding*: picking a
+trainset subsample or initial centroids before any jit region. The
+reference does this with host RNG as well (``initRandom`` /
+``trainset_fraction`` subsampling are thrust/host draws, e.g.
+``cluster/detail/kmeans.cuh`` shuffle-and-gather), so drawing on host
+with numpy and shipping only the gathered rows to device is both the
+faithful and the TPU-safe design. The public ``raft_tpu.random``
+distributions (user-facing RNG parity) keep their traced
+implementations.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# below this width the traced draw's sort compiles in ordinary time and
+# we keep the historical jax.random stream (seed-for-seed identical to
+# earlier releases — several quality tests are calibrated to it); above
+# it the permutation compile is the hazard described above
+_TRACED_MAX_N = 65536
+
+
+def sample_rows(n: int, m: int, seed: int) -> jnp.ndarray:
+    """``m`` distinct indices in ``[0, n)``. Small ``n`` draws the
+    traced ``jax.random.choice`` stream (identical to prior versions);
+    large ``n`` draws host-side with numpy and returns sorted indices
+    (sorted gathers are friendlier to HBM prefetch). Returns a device
+    int32 array."""
+    if n <= _TRACED_MAX_N:
+        idx = jax.random.choice(jax.random.key(seed), n, (m,),
+                                replace=False)
+        return idx.astype(jnp.int32)
+    idx = np.random.default_rng(seed).choice(n, size=m, replace=False)
+    idx.sort()
+    return jnp.asarray(idx, dtype=jnp.int32)

@@ -41,10 +41,13 @@ def stage(name, fn):
     return out
 
 
-# stage 1: trainset subsample (random.choice without replacement)
-sel = stage("subsample", lambda: jax.random.choice(
-    jax.random.key(0), n, (max(nlists, n // 2),), replace=False))
-trainset = db[sel]
+# stage 1: trainset subsample — host-side draw + device gather, the
+# path the library now takes (util.host_sample; the old traced
+# choice(replace=False) was the n-wide-sort compile that wedged the
+# remote-compile service)
+from raft_tpu.util.host_sample import sample_rows
+trainset = stage("subsample",
+                 lambda: db[sample_rows(n, max(nlists, n // 2), 0)])
 
 # stage 2: balanced EM on the trainset (the hierarchical trainer's flat
 # path at n_lists ≤ 16384)

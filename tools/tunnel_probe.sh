@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Tunnel health probe that can NEVER wedge the remote-compile service.
+#
+# The known failure mode (.claude/skills/verify, BASELINE.md round-2
+# notes): a client killed mid-remote-compile wedges the service for
+# every later process. `timeout N python -c "...matmul..."` is exactly
+# that kill — so this probe spawns the trial dispatch DETACHED, polls
+# its exit file, and on timeout reports "slow/hung" while LEAVING THE
+# CHILD RUNNING (a parked client is harmless; a killed one is not).
+# Re-invocations reuse the parked child's eventual completion.
+#
+# Exit codes: 0 healthy, 1 hung/slow (child left running), 2 dead
+# (child errored fast — e.g. connection refused).
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/measure_out
+mkdir -p "$OUT"
+STAMP="$OUT/tunnel_probe"
+WAIT="${1:-90}"
+
+# a previously parked probe that has since finished counts as an answer
+if [ -f "$STAMP.rc" ]; then
+  rc=$(cat "$STAMP.rc")
+  rm -f "$STAMP.rc" "$STAMP.pid"
+  if [ "$rc" = 0 ]; then echo "healthy (parked probe completed)"; exit 0
+  else echo "dead (parked probe rc=$rc): $(tail -n1 "$STAMP.log" 2>/dev/null)"; exit 2; fi
+fi
+if [ -f "$STAMP.pid" ] && kill -0 "$(cat "$STAMP.pid")" 2>/dev/null; then
+  echo "probe already parked (pid $(cat "$STAMP.pid")); still waiting"
+  exit 1
+fi
+
+rm -f "$STAMP.rc"
+(
+  PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}" \
+  python - >"$STAMP.log" 2>&1 <<'EOF'
+import jax, jax.numpy as jnp
+v = float((jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0])
+print("dispatch ok", v, jax.devices())
+EOF
+  echo $? > "$STAMP.rc"
+) &
+pid=$!
+echo "$pid" > "$STAMP.pid"
+disown "$pid"
+
+for _ in $(seq "$WAIT"); do
+  [ -f "$STAMP.rc" ] && break
+  sleep 1
+done
+if [ ! -f "$STAMP.rc" ]; then
+  echo "no answer in ${WAIT}s — child parked (pid $pid), NOT killed"
+  exit 1
+fi
+rc=$(cat "$STAMP.rc"); rm -f "$STAMP.rc" "$STAMP.pid"
+if [ "$rc" = 0 ]; then echo "healthy: $(grep 'dispatch ok' "$STAMP.log")"; exit 0; fi
+echo "dead (rc=$rc): $(tail -n1 "$STAMP.log")"; exit 2
