@@ -59,10 +59,14 @@ def _lloyd(x, weights, init_centroids, n_clusters: int, max_iter: int,
         labels, d = _assign(x, centroids)
         new_centroids, wsum = _weighted_update(x, labels, weights, n_clusters)
         # empty clusters: re-seed from the points with highest cost
-        # (deterministic analogue of detail/kmeans.cuh empty handling)
+        # (deterministic analogue of detail/kmeans.cuh empty handling).
+        # approx_max_k, not top_k: the reseed is heuristic, and an exact
+        # top_k is an n-wide sort whose first TPU compile at bench
+        # shapes (500k rows) runs minutes through the remote-compile
+        # tunnel; PartialReduce is the TPU-native selection
         empty = wsum == 0.0
         n_worst = n_clusters  # top-k worst points, one per potential empty
-        _, worst = lax.top_k(d, n_worst)
+        _, worst = lax.approx_max_k(d, n_worst)
         order = jnp.cumsum(empty.astype(jnp.int32)) - 1  # slot per empty cluster
         seed_pts = x[worst]
         new_centroids = jnp.where(

@@ -33,7 +33,8 @@ def enable(path: str | None = None) -> bool:
     True if the cache is active after the call."""
     global _enabled, _active_path
     if _enabled:
-        if path is not None and path != _active_path:
+        if path is not None and _active_path is not None and \
+                os.path.realpath(path) != os.path.realpath(_active_path):
             import warnings
             warnings.warn(
                 f"raft_tpu compile cache already enabled at "

@@ -16,6 +16,7 @@ force chained under the same harness is the line to beat.
 
 Run: PYTHONPATH=.:/root/.axon_site python tools/profile_ivf_fused.py
 """
+import os
 import time
 
 import jax
@@ -24,13 +25,18 @@ import numpy as np
 
 from raft_tpu.core.compile_cache import enable as _enable_cache
 _enable_cache()
+if os.environ.get("PROFILE_PLATFORM"):  # CPU smoke of the harness itself
+    jax.config.update("jax_platforms", os.environ["PROFILE_PLATFORM"])
 print(jax.devices())
 
 from raft_tpu.neighbors import ivf_flat, brute_force
 
 key = jax.random.key(0)
-n, d, nq, k, nlists, nprobes = 500_000, 128, 1000, 32, 1024, 64
-CHAIN = 8
+n = int(os.environ.get("PROFILE_N", 500_000))
+d, nq, k = 128, int(os.environ.get("PROFILE_NQ", 1000)), 32
+nlists = int(os.environ.get("PROFILE_NLISTS", 1024))
+nprobes = int(os.environ.get("PROFILE_NPROBES", 64))
+CHAIN = int(os.environ.get("PROFILE_CHAIN", 8))
 db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
 qs = jax.random.normal(jax.random.fold_in(key, 2), (CHAIN, nq, d))
 q0 = qs[0]
@@ -75,18 +81,40 @@ def recall_of(ii):
 
 ms = chained(lambda qb: brute_force.brute_force_knn(
     db, qb, k, mode="fused"))
-print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS")
+print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS",
+      flush=True)
 
-for cap in (256, 128, 64):
-    for bins in (128, 64):
-        for idt in (jnp.float32, jnp.bfloat16):
-            sp = ivf_flat.SearchParams(
-                n_probes=nprobes, scan_order="list", probe_cap=cap,
-                scan_bins=bins, internal_distance_dtype=idt)
-            dd, ii = ivf_flat.search(idx, q0, k, sp)
-            rec = recall_of(ii)
-            ms = chained(lambda qb, sp=sp: ivf_flat.search(idx, qb, k, sp))
-            tag = "bf16" if idt == jnp.bfloat16 else "f32"
-            print(f"cap={cap:3d} bins={bins:3d} idt={tag}: "
-                  f"{ms:6.2f} ms -> {nq/ms*1000:7.0f} QPS  "
-                  f"recall@{k}={rec:.4f}", flush=True)
+
+def run_point(cap, bins, idt):
+    sp = ivf_flat.SearchParams(
+        n_probes=nprobes, scan_order="list", probe_cap=cap,
+        scan_bins=bins, internal_distance_dtype=idt)
+    dd, ii = ivf_flat.search(idx, q0, k, sp)
+    rec = recall_of(ii)
+    ms = chained(lambda qb, sp=sp: ivf_flat.search(idx, qb, k, sp))
+    tag = "bf16" if idt == jnp.bfloat16 else "f32"
+    qps = nq / ms * 1000
+    print(f"cap={cap:3d} bins={bins:3d} idt={tag}: "
+          f"{ms:6.2f} ms -> {qps:7.0f} QPS  "
+          f"recall@{k}={rec:.4f}", flush=True)
+    return qps, rec
+
+
+# bf16-first sweep (roofline: candidate-block traffic halves), then one
+# f32 check at the bf16 winner — 7 chained compiles instead of 12; each
+# cold chained compile costs minutes through the remote-compile tunnel
+best = None
+for cap in (128, 256, 64):
+    for bins in (64, 128):
+        qps, rec = run_point(cap, bins, jnp.bfloat16)
+        if rec >= 0.95 and (best is None or qps > best[0]):
+            best = (qps, cap, bins)
+if best is not None:
+    print(f"best bf16 point: cap={best[1]} bins={best[2]} "
+          f"({best[0]:.0f} QPS); f32 check:", flush=True)
+    run_point(best[1], best[2], jnp.float32)
+else:
+    print("no bf16 point reached recall 0.95 — config likely caps the "
+          "probed lists too hard (or smoke-scale shapes); f32 check at "
+          "the widest point:", flush=True)
+    run_point(256, 128, jnp.float32)

@@ -1,35 +1,29 @@
 #!/usr/bin/env bash
 # Staged TPU measurement sequence (run when the axon tunnel is healthy).
-# Writes one log per stage under tools/measure_out/. Never kill a stage
+# Writes one log per stage under tools/measure_out/. NEVER kill a stage
 # mid-compile: a killed remote compile wedges the tunnel for hours
-# (see .claude/skills/verify) — stages get generous timeouts instead.
+# (see .claude/skills/verify) — stages get generous timeouts instead,
+# and the probe uses tunnel_probe.sh (parks, never kills).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
 OUT=tools/measure_out
 mkdir -p "$OUT"
 
-probe() {
-  timeout 120 python -c "
-import jax, jax.numpy as jnp
-(jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()
-print('tunnel healthy:', jax.devices())" 2>&1 | tail -n1
-}
+echo "== probe (parks on hang; see $OUT/tunnel_probe.log)"
+bash tools/tunnel_probe.sh 120 || { echo "tunnel not healthy; abort"; exit 1; }
 
-echo "== probe"; probe | tee "$OUT/probe.log"
-grep -q "tunnel healthy" "$OUT/probe.log" || { echo "tunnel down; abort"; exit 1; }
+echo "== 1. fused IVF-Flat operating-point A/B (brute baseline + sweep)"
+timeout 5400 python tools/profile_ivf_fused.py 2>&1 | tee "$OUT/ivf_fused_ab.log"
 
-echo "== 1. IVF-Flat phase profile (rows gather)"
-timeout 2400 python tools/profile_ivf_flat.py 2>&1 | tee "$OUT/ivf_flat_rows.log"
-
-echo "== 2. gather A/B (onehot)"
-RAFT_TPU_GATHER=onehot timeout 2400 python tools/profile_ivf_flat.py \
-  2>&1 | tee "$OUT/ivf_flat_onehot.log"
-
-echo "== 3. IVF-PQ scan modes (in-kernel decode vs reconstruct vs lut)"
-timeout 2400 python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_modes.log"
+echo "== 2. IVF-PQ scan modes (in-kernel decode vs reconstruct) + fp8 LUT"
+timeout 3600 python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_modes.log"
 import time, jax
 import jax.numpy as jnp
+import numpy as np
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
+from bench_suite import _sync, _time, _ivf_recall
 from raft_tpu.neighbors import ivf_pq
 key = jax.random.key(0)
 n, d, nq, k = 500_000, 128, 1000, 32
@@ -37,28 +31,29 @@ db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
 q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
 t0 = time.perf_counter()
 idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024))
-jax.block_until_ready(idx.codes)
-print("build", round(time.perf_counter() - t0, 1), "s")
-def timed(fn, reps=5):
-    o = fn(); jax.block_until_ready(o)
-    t0 = time.perf_counter()
-    outs = [fn() for _ in range(reps)]
-    jax.block_until_ready(outs)
-    return (time.perf_counter() - t0) / reps
-for mode in ("codes", "reconstruct"):
-    sp = ivf_pq.SearchParams(n_probes=64, scan_mode=mode)
-    t = timed(lambda: ivf_pq.search(idx, q, k, sp))
-    print(f"ivf_pq {mode}: {t*1000:.1f} ms -> {nq/t:.0f} QPS")
+_sync(idx.codes)
+print("build", round(time.perf_counter() - t0, 1), "s", flush=True)
+cases = [("codes bf16", dict(scan_mode="codes", lut_dtype=jnp.bfloat16)),
+         ("codes fp8",  dict(scan_mode="codes",
+                             lut_dtype=jnp.float8_e4m3fn)),
+         ("reconstruct", dict(scan_mode="reconstruct"))]
+for name, kw in cases:
+    sp = ivf_pq.SearchParams(n_probes=64, **kw)
+    dd, ii = ivf_pq.search(idx, q, k, sp)
+    rec = _ivf_recall(ii, db, q, k)
+    t = _time(lambda sp=sp: ivf_pq.search(idx, q, k, sp))
+    print(f"ivf_pq {name}: {t*1000:.1f} ms -> {nq/t:.0f} QPS "
+          f"recall@{k}={rec:.4f}", flush=True)
 EOF
 
-echo "== 3b. build profile (compile vs compute split)"
+echo "== 3. build profile (compile vs compute split)"
 timeout 2400 python tools/profile_ivf_build.py 2>&1 | tee "$OUT/build_profile.log"
 
 echo "== 4. gated bench suite"
-timeout 3000 python bench_suite.py --gate 2>&1 | tee "$OUT/suite.log"
+timeout 3600 python bench_suite.py --gate 2>&1 | tee "$OUT/suite.log"
 
 echo "== 4b. reference-scale shapes (2M/10M x 128, 10k x 8192)"
-BENCH_BIG=1 timeout 6000 python bench_suite.py \
+BENCH_BIG=1 timeout 7200 python bench_suite.py \
   brute_2m fused_wide ivf_10m 2>&1 | tee "$OUT/suite_big.log"
 
 echo "== 5. headline bench (child budget 2400s x probe + retries: keep"

@@ -25,9 +25,18 @@ if [ -f "$STAMP.rc" ]; then
   if [ "$rc" = 0 ]; then echo "healthy (parked probe completed)"; exit 0
   else echo "dead (parked probe rc=$rc): $(tail -n1 "$STAMP.log" 2>/dev/null)"; exit 2; fi
 fi
-if [ -f "$STAMP.pid" ] && kill -0 "$(cat "$STAMP.pid")" 2>/dev/null; then
-  echo "probe already parked (pid $(cat "$STAMP.pid")); still waiting"
-  exit 1
+# a parked probe counts only if the PID is alive AND is still a python
+# process (guards against PID reuse after an OOM-kill/reboot left a
+# stale .pid with no .rc)
+if [ -f "$STAMP.pid" ]; then
+  oldpid=$(cat "$STAMP.pid")
+  if kill -0 "$oldpid" 2>/dev/null && \
+     ps -p "$oldpid" -o args= 2>/dev/null | \
+       grep -qE "python|tunnel_probe"; then
+    echo "probe already parked (pid $oldpid); still waiting"
+    exit 1
+  fi
+  rm -f "$STAMP.pid"
 fi
 
 rm -f "$STAMP.rc"
@@ -38,7 +47,7 @@ import jax, jax.numpy as jnp
 v = float((jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0])
 print("dispatch ok", v, jax.devices())
 EOF
-  echo $? > "$STAMP.rc"
+  echo $? > "$STAMP.rc.tmp" && mv "$STAMP.rc.tmp" "$STAMP.rc"
 ) &
 pid=$!
 echo "$pid" > "$STAMP.pid"
