@@ -62,13 +62,14 @@ def _init_backend_with_retry(jax, attempts=4, base_sleep=5.0):
 def child_main():
     import numpy as np
     import jax
-    from raft_tpu.core.compile_cache import enable as _enable_cache
-    _enable_cache()  # cold compiles cost 20-40 s each via the tunnel
     # BENCH_PLATFORM=cpu for smoke/degraded runs: the env-var route
     # (JAX_PLATFORMS) is overridden by the host sitecustomize, so the
-    # config API is the only reliable selector
+    # config API is the only reliable selector. Platform BEFORE cache:
+    # the cache dir is platform-scoped.
     if "BENCH_PLATFORM" in os.environ:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from raft_tpu.core.compile_cache import enable as _enable_cache
+    _enable_cache()  # cold compiles cost 20-40 s each via the tunnel
     if os.environ.get("BENCH_PROBE"):
         # canary: backend init + one tiny dispatch. A wedged remote-
         # compile tunnel HANGS here (it does not error), so the parent
@@ -106,13 +107,29 @@ def child_main():
     _fetch([d_f[0, 0], i_f[0, 0]])  # compile + warm
 
     # recall gate vs the exact scan (eval_neighbours analogue,
-    # cpp/test/neighbors/ann_utils.cuh:201)
-    recall = 1.0
+    # cpp/test/neighbors/ann_utils.cuh:201). Ground-truth indices are
+    # computed ONCE and reused by the bf16-tier gate below — the exact
+    # 1M scan costs seconds of chip time per run.
+    recall, exact_ids, fused_gate_recall = 1.0, None, None
+
+    def _recall_vs_exact(i_got):
+        nonlocal exact_ids
+        if exact_ids is None:
+            _, i_e = brute_force_knn(db, q, K, mode="exact")
+            exact_ids = np.asarray(i_e)
+        got = np.asarray(i_got)
+        return float(np.mean([
+            len(set(got[r]) & set(exact_ids[r])) / K
+            for r in range(len(got))]))
+
     if mode == "fused":
-        from bench_suite import _ivf_recall
-        recall = _ivf_recall(i_f, db, q, K)
+        recall = _recall_vs_exact(i_f)
         if recall < MIN_RECALL:
-            mode = "exact"  # fused kernel fails its gate: report exact
+            # fused kernel fails its gate: report the exact path, whose
+            # recall is 1.0 by definition (the fused gate value rides
+            # along under its own key)
+            mode = "exact"
+            fused_gate_recall, recall = recall, 1.0
 
     # offline-throughput timing: n_iters independent searches (distinct
     # query batches) chained inside ONE jitted computation, synced once —
@@ -125,25 +142,30 @@ def child_main():
         jax.random.fold_in(kq, 7), (n_iters, N_QUERIES, N_DIM),
         dtype=jnp.float32))
 
-    @jax.jit
-    def run_chain(db_, qs):
+    def time_chain(kprec):
         # touch every search's result so none is dead-code eliminated,
         # and reduce to ONE scalar: every extra output leaf costs a
         # ~20 ms tunnel round-trip at fetch time
-        acc = jnp.zeros((), jnp.float32)
-        for i in range(n_iters):
-            d_, i_ = brute_force_knn(db_, qs[i], K, DistanceType.L2Expanded,
-                                     mode=mode)
-            acc += d_[0, 0] + i_[0, 0].astype(jnp.float32)
-        return acc
+        @jax.jit
+        def run_chain(db_, qs):
+            acc = jnp.zeros((), jnp.float32)
+            for i in range(n_iters):
+                d_, i_ = brute_force_knn(db_, qs[i], K,
+                                         DistanceType.L2Expanded,
+                                         mode=mode,
+                                         kernel_precision=kprec)
+                acc += d_[0, 0] + i_[0, 0].astype(jnp.float32)
+            return acc
 
-    _fetch(run_chain(db, q_batches))  # compile + warm
-    walls = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _fetch(run_chain(db, q_batches))
-        walls.append((time.perf_counter() - t0) / n_iters)
-    wall = min(walls)  # best-of-3: tunnel jitter is not kernel time
+        _fetch(run_chain(db, q_batches))  # compile + warm
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _fetch(run_chain(db, q_batches))
+            walls.append((time.perf_counter() - t0) / n_iters)
+        return min(walls)  # best-of-3: tunnel jitter is not kernel time
+
+    wall = time_chain(None)
     ms = wall * 1e3
     qps = N_QUERIES / wall
     platform = jax.devices()[0].platform
@@ -156,10 +178,37 @@ def child_main():
     }
     if platform not in ("tpu", "axon"):
         out["degraded_platform"] = platform
-    # print the brute-force headline FIRST: if the IVF enrichment below
-    # hangs or dies, the parent salvages this line (it parses the last
+    out["recall"] = round(recall, 4)
+    if fused_gate_recall is not None:
+        out["fused_gate_recall"] = round(fused_gate_recall, 4)
+    # print the brute-force headline FIRST: if the enrichments below
+    # hang or die, the parent salvages this line (it parses the last
     # parseable JSON line of stdout)
     print(json.dumps(out), flush=True)
+
+    # recall-gated single-pass-bf16 speed tier (the reference benches
+    # fp16 datasets alongside fp32 — knn.cuh kInputs half variants; on
+    # TPU the analogue is one bf16 MXU pass instead of the 3-pass
+    # bf16x3 split). Headline takes the tier only if its recall holds.
+    if mode == "fused" and not os.environ.get("BENCH_SKIP_BF16"):
+        try:
+            d_b, i_b = brute_force_knn(db, q, K, DistanceType.L2Expanded,
+                                       mode="fused",
+                                       kernel_precision="bf16")
+            _fetch([d_b[0, 0], i_b[0, 0]])
+            rec_b = _recall_vs_exact(i_b)
+            wall_b = time_chain("bf16")
+            out["bf16_tier_qps"] = round(N_QUERIES / wall_b, 1)
+            out["bf16_tier_recall"] = round(rec_b, 4)
+            if rec_b >= MIN_RECALL and wall_b < wall:
+                ms = wall_b * 1e3
+                out["value"] = round(N_QUERIES / wall_b, 1)
+                out["recall"] = round(rec_b, 4)
+                out["kernel_precision"] = "bf16"
+                out["vs_baseline"] = round(BASELINE_PROXY_MS / ms, 3)
+        except Exception as e:  # the tier must not void the headline
+            out["bf16_tier_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
 
     # IVF rows (round-2 verdict: the headline artifact must carry the
     # flagship index numbers + recall, not only brute force). Reuses the

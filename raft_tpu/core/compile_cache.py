@@ -44,11 +44,21 @@ def enable(path: str | None = None) -> bool:
     env = os.environ.get("RAFT_TPU_COMPILE_CACHE", "")
     if env == "0":
         return False
-    if path is None:
-        path = env or os.path.join(
+    import jax
+    if path is None and env:
+        path = env  # explicit override: used verbatim (docstring contract)
+    elif path is None:
+        base = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), ".jax_cache")
-    import jax
+        # the computed default is scoped by requested platform (config
+        # string, no backend init): axon entries are produced by the
+        # REMOTE compile service whose host CPU differs from this box —
+        # sharing one dir makes local CPU runs load foreign AOT results
+        # (machine-feature mismatch warnings, SIGILL risk). Callers
+        # setting a platform must do so before enable().
+        plat = getattr(jax.config, "jax_platforms", None) or "default"
+        path = os.path.join(base, str(plat).replace(",", "_"))
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

@@ -87,3 +87,24 @@ def kernel_matmul_mode(interpret: bool = False):
                 f"RAFT_TPU_KERNEL_PRECISION={name!r}: "
                 "want bf16x3|highest|default")
     return _kernel_resolved
+
+
+def resolve_kernel_mode(name: Optional[str], interpret: bool = False):
+    """Per-call kernel matmul mode: ``None`` defers to the process-wide
+    ``kernel_matmul_mode()`` env default; otherwise ``bf16x3`` (3-pass
+    split, ~f32), ``bf16`` (ONE MXU pass, ~5e-4 relative — the recall-
+    gated speed tier, the reference's fp16-dataset bench axis,
+    ``cpp/bench/neighbors/knn/*_float_*.cu`` vs half variants), or
+    ``highest``. Interpret mode always computes true f32."""
+    if interpret:
+        return lax.Precision.HIGHEST
+    if name is None:
+        return kernel_matmul_mode(interpret)
+    name = name.lower()
+    if name == "bf16x3":
+        return "bf16x3"
+    if name in ("bf16", "default"):
+        return lax.Precision.DEFAULT
+    if name == "highest":
+        return lax.Precision.HIGHEST
+    raise ValueError(f"kernel precision {name!r}: want bf16x3|bf16|highest")

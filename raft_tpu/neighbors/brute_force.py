@@ -93,6 +93,7 @@ def brute_force_knn(
     metric: DistanceType = DistanceType.L2SqrtExpanded,
     metric_arg: float = 2.0,
     mode: str = "auto",
+    kernel_precision: str = None,
     res=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN of ``queries`` against ``db`` → (dists, indices), both
@@ -105,7 +106,10 @@ def brute_force_knn(
     the TPU-KNN recall/throughput tradeoff, near-exact at default bin
     width). The fused kernel is the TPU analogue of the reference's
     k ≤ 64 fusedL2Knn fast path (``knn_brute_force_faiss.cuh:281``); it
-    is opt-in here because its selection is approximate."""
+    is opt-in here because its selection is approximate.
+    ``kernel_precision`` (fused path only): ``None`` = env default
+    (bf16x3, ~f32-exact) | ``"bf16"`` = single-pass MXU speed tier
+    (~5e-4 relative; recall-gate it) | ``"bf16x3"`` | ``"highest"``."""
     db, queries = as_array(db), as_array(queries)
     expects(db.shape[1] == queries.shape[1], "knn: dim mismatch")
     expects(k <= db.shape[0], "knn: k > database size")
@@ -124,7 +128,8 @@ def brute_force_knn(
                 f"fused knn supports L2/IP/cosine/correlation, got {metric}")
         from raft_tpu.ops.pallas_fused_knn import fused_knn_pallas
         m_name, sq = pal
-        return fused_knn_pallas(queries, db, k, metric=m_name, sqrt=sq)
+        return fused_knn_pallas(queries, db, k, metric=m_name, sqrt=sq,
+                                kernel_precision=kernel_precision)
     tile = _db_tile(queries.shape[0], db.shape[0])
     # InnerProduct is a similarity: select the k LARGEST (the reference
     # routes IP through FAISS's max-heap select)

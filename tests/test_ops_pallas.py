@@ -129,6 +129,23 @@ class TestFusedKnnPallas:
             for r in range(20)])
         assert agree >= 0.9
 
+    @pytest.mark.parametrize("kprec", ["bf16", "bf16x3", "highest"])
+    def test_kernel_precision_tiers(self, kprec):
+        # per-call precision tiers (bench.py's recall-gated bf16 speed
+        # tier rides this; under the interpreter every tier computes
+        # true f32, so this checks the threading, not the rounding)
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        key = jax.random.key(9)
+        db = jax.random.normal(jax.random.fold_in(key, 1), (300, 12))
+        q = db[:16]
+        d, i = brute_force_knn(db, q, 4, DistanceType.L2Expanded,
+                               mode="fused", kernel_precision=kprec)
+        assert np.asarray(i)[:, 0].tolist() == list(range(16))
+        with pytest.raises(ValueError):
+            from raft_tpu.core.precision import resolve_kernel_mode
+            resolve_kernel_mode("fp64")
+
 
 class TestSelectKPallas:
     """Exact warpsort-slot kernel (ops/pallas_select_k.py) vs numpy sort

@@ -76,3 +76,32 @@ class TestVecCache:
         # key 2 (LRU after key 1 was touched) was evicted, key 1 kept
         _, found, _ = cache.lookup(jnp.asarray([1, 2], jnp.int32))
         np.testing.assert_array_equal(np.asarray(found), [True, False])
+
+
+class TestHostSample:
+    """util/host_sample — the no-giant-sort-compile trainset sampler."""
+
+    def test_small_n_matches_traced_stream(self):
+        # below the threshold the draw must be the historical traced
+        # jax.random stream (quality tests are calibrated to it)
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.util.host_sample import sample_rows
+        got = np.asarray(sample_rows(1000, 32, seed=7))
+        want = np.asarray(jax.random.choice(
+            jax.random.key(7), 1000, (32,), replace=False))
+        np.testing.assert_array_equal(got, want)
+
+    def test_large_n_distinct_sorted_in_range(self):
+        from raft_tpu.util.host_sample import (sample_rows,
+                                               _TRACED_MAX_N)
+        n = _TRACED_MAX_N + 5
+        idx = np.asarray(sample_rows(n, 4096, seed=3))
+        assert idx.dtype == np.int32
+        assert len(np.unique(idx)) == 4096          # distinct
+        assert (np.diff(idx) > 0).all()             # sorted
+        assert idx.min() >= 0 and idx.max() < n
+        # deterministic per seed; different across seeds
+        np.testing.assert_array_equal(
+            idx, np.asarray(sample_rows(n, 4096, seed=3)))
+        assert (idx != np.asarray(sample_rows(n, 4096, seed=4))).any()

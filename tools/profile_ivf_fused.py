@@ -23,10 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.compile_cache import enable as _enable_cache
-_enable_cache()
 if os.environ.get("PROFILE_PLATFORM"):  # CPU smoke of the harness itself
     jax.config.update("jax_platforms", os.environ["PROFILE_PLATFORM"])
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
 print(jax.devices())
 
 from raft_tpu.neighbors import ivf_flat, brute_force

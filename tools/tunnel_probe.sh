@@ -25,14 +25,13 @@ if [ -f "$STAMP.rc" ]; then
   if [ "$rc" = 0 ]; then echo "healthy (parked probe completed)"; exit 0
   else echo "dead (parked probe rc=$rc): $(tail -n1 "$STAMP.log" 2>/dev/null)"; exit 2; fi
 fi
-# a parked probe counts only if the PID is alive AND is still a python
-# process (guards against PID reuse after an OOM-kill/reboot left a
-# stale .pid with no .rc)
+# a parked probe counts only if the PID is alive AND its recorded start
+# time still matches — a recycled PID (OOM-kill/reboot left a stale
+# .pid with no .rc) has a different lstart and is ignored
 if [ -f "$STAMP.pid" ]; then
-  oldpid=$(cat "$STAMP.pid")
-  if kill -0 "$oldpid" 2>/dev/null && \
-     ps -p "$oldpid" -o args= 2>/dev/null | \
-       grep -qE "python|tunnel_probe"; then
+  read -r oldpid oldstart < <(head -n1 "$STAMP.pid"; echo)
+  curstart=$(ps -p "$oldpid" -o lstart= 2>/dev/null | tr -s ' ')
+  if [ -n "$curstart" ] && [ "$curstart" = "$oldstart" ]; then
     echo "probe already parked (pid $oldpid); still waiting"
     exit 1
   fi
@@ -50,7 +49,7 @@ EOF
   echo $? > "$STAMP.rc.tmp" && mv "$STAMP.rc.tmp" "$STAMP.rc"
 ) &
 pid=$!
-echo "$pid" > "$STAMP.pid"
+echo "$pid $(ps -p "$pid" -o lstart= | tr -s ' ')" > "$STAMP.pid"
 disown "$pid"
 
 for _ in $(seq "$WAIT"); do
