@@ -27,11 +27,12 @@ from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.util.host_sample import sample_rows
 
 
-def _nn(x, centers):
+def _nn(x, centers, kernel_precision=None):
     """(labels, dists) of nearest centers via the public fused_l2_nn —
     one dispatch site for the Pallas-vs-XLA routing. Traceable: usable
     inside the jit'd EM loop."""
-    kv = fused_l2_nn(x, centers, sqrt=False)
+    kv = fused_l2_nn(x, centers, sqrt=False,
+                     kernel_precision=kernel_precision)
     return kv.key, kv.value
 
 
@@ -43,13 +44,15 @@ def predict(x, centers, res=None) -> jax.Array:
     return labels
 
 
-@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters"))
-def _em(x, centers0, n_clusters: int, n_iters: int, balance_threshold: float):
+@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters",
+                                             "kernel_precision"))
+def _em(x, centers0, n_clusters: int, n_iters: int, balance_threshold: float,
+        kernel_precision=None):
     n = x.shape[0]
     avg = n / n_clusters
 
     def one_iter(_, centers):
-        labels, d = _nn(x, centers)
+        labels, d = _nn(x, centers, kernel_precision)
         counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels,
                                      num_segments=n_clusters)
         sums = jax.ops.segment_sum(x, labels, num_segments=n_clusters)
@@ -73,23 +76,31 @@ def _em(x, centers0, n_clusters: int, n_iters: int, balance_threshold: float):
 
 def balanced_kmeans(x, n_clusters: int, n_iters: int = 20,
                     balance_threshold: float = 0.25, seed: int = 0,
+                    kernel_precision: str | None = None,
                     res=None) -> jax.Array:
     """Train ``n_clusters`` balanced centers (reference
-    balancing_em_iters :628). Returns (n_clusters, dim) centers."""
+    balancing_em_iters :628). Returns (n_clusters, dim) centers.
+    ``kernel_precision``: per-call Pallas matmul tier for the EM
+    assignment (``"bf16"`` = one MXU pass — the ANN-trainer speed knob;
+    cluster assignment tolerates ~5e-4 relative distance error, gate
+    any default change on downstream index recall)."""
     x = as_array(x).astype(jnp.float32)
     # init indices sampled HOST-side (util.host_sample rationale: a
     # traced choice(replace=False) is an n-wide sort compile)
     centers0 = x[sample_rows(x.shape[0], n_clusters, seed)]
-    return _em(x, centers0, n_clusters, n_iters, balance_threshold)
+    return _em(x, centers0, n_clusters, n_iters, balance_threshold,
+               kernel_precision=kernel_precision)
 
 
 def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
                        max_train_points: int = 1 << 18, seed: int = 0,
+                       kernel_precision: str | None = None,
                        res=None) -> jax.Array:
     """Two-level balanced trainer (reference build_hierarchical): train
     √k mesoclusters on a subsample, partition, then train proportional
     fine clusters per mesocluster; finish with balancing iterations over
-    the full center set."""
+    the full center set. ``kernel_precision`` reaches every EM sweep
+    (see :func:`balanced_kmeans`)."""
     x = as_array(x).astype(jnp.float32)
     n = x.shape[0]
 
@@ -109,7 +120,8 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
     # that — and naive per-mesocluster shapes would trigger one XLA
     # recompile each (SURVEY.md hard part (c)).
     if n_clusters <= 16384:
-        return balanced_kmeans(xt, n_clusters, n_iters, seed=seed, res=res)
+        return balanced_kmeans(xt, n_clusters, n_iters, seed=seed,
+                               kernel_precision=kernel_precision, res=res)
 
     # two-level path, shape-bucketed so XLA compiles O(log) variants, not
     # O(n_meso): uniform fine allocation (one km for every mesocluster —
@@ -118,7 +130,9 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
     # empirical distribution seen by EM).
     n_meso = int(math.isqrt(n_clusters))
     km = -(-n_clusters // n_meso)  # uniform fine centers per meso
-    meso_centers = balanced_kmeans(xt, n_meso, n_iters, seed=seed, res=res)
+    meso_centers = balanced_kmeans(xt, n_meso, n_iters, seed=seed,
+                                   kernel_precision=kernel_precision,
+                                   res=res)
     meso_labels = predict(xt, meso_centers, res=res)
     meso_np = jax.device_get(meso_labels)
 
@@ -139,7 +153,10 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
         reps = -(-target // pts.shape[0])
         pts_p = jnp.tile(pts, (reps, 1))[:target]
         centers.append(balanced_kmeans(pts_p, km, max(4, n_iters // 2),
-                                       seed=seed + m + 1, res=res))
+                                       seed=seed + m + 1,
+                                       kernel_precision=kernel_precision,
+                                       res=res))
     all_centers = jnp.concatenate(centers, axis=0)[:n_clusters]
     # final balancing sweeps over the full center set
-    return _em(xt, all_centers, n_clusters, max(2, n_iters // 4), 0.25)
+    return _em(xt, all_centers, n_clusters, max(2, n_iters // 4), 0.25,
+               kernel_precision=kernel_precision)

@@ -93,7 +93,7 @@ def brute_force_knn(
     metric: DistanceType = DistanceType.L2SqrtExpanded,
     metric_arg: float = 2.0,
     mode: str = "auto",
-    kernel_precision: str = None,
+    kernel_precision: str | None = None,
     res=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN of ``queries`` against ``db`` → (dists, indices), both

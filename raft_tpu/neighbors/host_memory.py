@@ -103,7 +103,9 @@ def build(dataset, params: IndexParams = IndexParams(),
     t_rows = min(n, train_rows)
     sub = x[rng.choice(n, t_rows, replace=False)] if t_rows < n else x
     centers = kmeans_balanced.build_hierarchical(
-        jnp.asarray(sub), params.n_lists, params.kmeans_n_iters, res=res)
+        jnp.asarray(sub), params.n_lists, params.kmeans_n_iters,
+        kernel_precision=getattr(params, "kmeans_kernel_precision", None),
+        res=res)
 
     # pass 1: labels only (n·4 bytes of bookkeeping) — keeps peak host
     # memory at dataset + padded lists, not 3× the dataset

@@ -48,6 +48,9 @@ class IndexParams:
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
     adaptive_centers: bool = False
+    # Pallas matmul tier for the balanced-EM trainer ("bf16" = one MXU
+    # pass — the build-speed knob; see docs/tuning.md). None = default.
+    kmeans_kernel_precision: object = None
     # list storage dtype: "float32" | "bfloat16" | "int8". The reference
     # indexes f32/f16/u8/s8 datasets (ivf_flat_types.hpp index<T>,
     # quantized dtypes via the kDivisor convention, ann_utils.cuh:79);
@@ -224,7 +227,8 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         else:
             trainset = x
         centers = kmeans_balanced.build_hierarchical(
-            trainset, params.n_lists, params.kmeans_n_iters, res=res)
+            trainset, params.n_lists, params.kmeans_n_iters,
+            kernel_precision=params.kmeans_kernel_precision, res=res)
         labels = kmeans_balanced.predict(x, centers, res=res)
         data, idx, norms, counts = _bucketize(x, labels, params.n_lists)
         data, norms, scale = _quantize_lists(data, norms,

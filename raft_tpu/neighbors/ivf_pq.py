@@ -64,6 +64,8 @@ class IndexParams:
     pq_dim: int = 0           # 0 = dim/4 heuristic (reference default path)
     codebook_kind: CodebookGen = CodebookGen.PER_SUBSPACE
     force_random_rotation: bool = False
+    # Pallas matmul tier for the balanced-EM trainer (docs/tuning.md)
+    kmeans_kernel_precision: object = None
 
 
 @dataclass
@@ -332,7 +334,8 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     else:
         trainset = x
     centers = kmeans_balanced.build_hierarchical(
-        trainset, params.n_lists, params.kmeans_n_iters, res=res)
+        trainset, params.n_lists, params.kmeans_n_iters,
+        kernel_precision=params.kmeans_kernel_precision, res=res)
     labels = kmeans_balanced.predict(x, centers, res=res)
 
     rot = make_rotation_matrix(dim, rot_dim, params.force_random_rotation,

@@ -28,7 +28,7 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.ops.dispatch import pallas_interpret
 from raft_tpu.ops._util import (BIG_I32 as _BIG_I32, VMEM_LIMIT as _VMEM_LIMIT,
                                 round_up as _round_up, dot_nt_f32)
-from raft_tpu.core.precision import kernel_matmul_mode
+from raft_tpu.core.precision import resolve_kernel_mode
 
 
 def _nn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
@@ -62,8 +62,10 @@ def _nn_kernel(x_ref, y_ref, od_ref, oi_ref, *, n: int, tn: int, gn: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("sqrt", "tm", "tn", "interpret"))
-def _fused_l2_nn_call(x, y, sqrt: bool, tm: int, tn: int, interpret: bool):
+                   static_argnames=("sqrt", "tm", "tn", "interpret",
+                                    "kernel_precision"))
+def _fused_l2_nn_call(x, y, sqrt: bool, tm: int, tn: int, interpret: bool,
+                      kernel_precision=None):
     m, k = x.shape
     n = y.shape[0]
     mp, np_ = _round_up(m, tm), _round_up(n, tn)
@@ -71,7 +73,8 @@ def _fused_l2_nn_call(x, y, sqrt: bool, tm: int, tn: int, interpret: bool):
     yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
     gm, gn = mp // tm, np_ // tn
     kern = functools.partial(_nn_kernel, n=n, tn=tn, gn=gn, sqrt=sqrt,
-                             precision=kernel_matmul_mode(interpret))
+                             precision=resolve_kernel_mode(
+                                 kernel_precision, interpret))
     od, oi = pl.pallas_call(
         kern,
         grid=(gm, gn),
@@ -92,7 +95,8 @@ def _fused_l2_nn_call(x, y, sqrt: bool, tm: int, tn: int, interpret: bool):
     return oi.reshape(-1)[:m], od.reshape(-1)[:m]
 
 
-def fused_l2_nn_pallas(x, y, sqrt: bool = False, tm: int = 0, tn: int = 0):
+def fused_l2_nn_pallas(x, y, sqrt: bool = False, tm: int = 0, tn: int = 0,
+                       kernel_precision: str | None = None):
     """For each row of ``x``: (index, distance) of its nearest row of ``y``
     under (squared) L2 — single fused kernel, no (m, n) buffer.
 
@@ -113,4 +117,5 @@ def fused_l2_nn_pallas(x, y, sqrt: bool = False, tm: int = 0, tn: int = 0):
             tm, tn = 256, 512
     tm = min(tm, _round_up(m, 8))
     tn = min(tn, _round_up(y.shape[0], 8))
-    return _fused_l2_nn_call(x, y, bool(sqrt), tm, tn, pallas_interpret())
+    return _fused_l2_nn_call(x, y, bool(sqrt), tm, tn, pallas_interpret(),
+                             kernel_precision=kernel_precision)

@@ -54,6 +54,13 @@ trainset = stage("subsample",
 centers = stage("EM train (20 iters)", lambda: kmeans_balanced.
                 build_hierarchical(trainset, nlists, 20))
 
+# stage 2b: the bf16 single-pass tier — candidate trainer default if the
+# speedup holds; compare center quality via downstream recall before
+# switching (the A/B consumer is BASELINE.md's build table)
+stage("EM train (20 iters, bf16 tier)",
+      lambda: kmeans_balanced.balanced_kmeans(trainset, nlists, 20,
+                                              kernel_precision="bf16"))
+
 # stage 3: full-dataset predict (a second fused_l2_nn shape → compile)
 labels = stage("predict full", lambda: kmeans_balanced.predict(db, centers))
 
