@@ -80,12 +80,14 @@ def kernel_matmul_mode(interpret: bool = False):
         name = os.environ.get("RAFT_TPU_KERNEL_PRECISION", "bf16x3").lower()
         if name == "bf16x3":
             _kernel_resolved = "bf16x3"
+        elif name == "bf16":  # docs/tuning.md per-call spelling
+            _kernel_resolved = lax.Precision.DEFAULT
         elif name in _TABLE and name != "high":
             _kernel_resolved = _TABLE[name]
         else:
             raise ValueError(
                 f"RAFT_TPU_KERNEL_PRECISION={name!r}: "
-                "want bf16x3|highest|default")
+                "want bf16x3|bf16|highest|default")
     return _kernel_resolved
 
 

@@ -104,7 +104,7 @@ def build(dataset, params: IndexParams = IndexParams(),
     sub = x[rng.choice(n, t_rows, replace=False)] if t_rows < n else x
     centers = kmeans_balanced.build_hierarchical(
         jnp.asarray(sub), params.n_lists, params.kmeans_n_iters,
-        kernel_precision=getattr(params, "kmeans_kernel_precision", None),
+        kernel_precision=params.kmeans_kernel_precision,
         res=res)
 
     # pass 1: labels only (n·4 bytes of bookkeeping) — keeps peak host

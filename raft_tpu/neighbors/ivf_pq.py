@@ -174,7 +174,8 @@ def make_rotation_matrix(dim: int, rot_dim: int, force_random: bool = False,
 
 
 def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
-                                  n_codes: int, n_iters: int, seed: int):
+                                  n_codes: int, n_iters: int, seed: int,
+                                  kernel_precision=None):
     """Per-subspace k-means over residual subvectors (reference
     train_per_subset, ivf_pq_build.cuh:464). The Python loop dispatches
     pq_dim sequential trainers, but each is the balanced trainer whose
@@ -184,7 +185,8 @@ def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
     books = []
     for s in range(pq_dim):
         books.append(kmeans_balanced.balanced_kmeans(
-            sub[:, s, :], n_codes, n_iters=n_iters, seed=seed + s))
+            sub[:, s, :], n_codes, n_iters=n_iters, seed=seed + s,
+            kernel_precision=kernel_precision))
     return jnp.stack(books)  # (pq_dim, n_codes, pq_len)
 
 
@@ -387,7 +389,8 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
         cb_trainset = residuals_rot
     pq_centers = _train_codebooks_per_subspace(
         cb_trainset, pq_dim, pq_len, n_codes,
-        params.kmeans_n_iters, seed + 2)
+        params.kmeans_n_iters, seed + 2,
+        kernel_precision=params.kmeans_kernel_precision)
 
     codes = _encode(residuals_rot, pq_centers)  # (n, pq_dim) u8
 

@@ -1,0 +1,133 @@
+"""Bisect which piece of the fused IVF-Flat search kills the remote
+compiler.
+
+Twice now (2026-07-31 build-path sorts — fixed; 2026-08-01 the fused
+search itself) the axon remote-compile service has died mid-compile of
+an IVF program while having just served several heavy compiles (the
+balanced-EM build, the 8x-chained fused brute kNN). This script compiles
+and runs each stage of ``fused_list_search`` SEPARATELY, smallest
+first, flushing a line before every submission — so if the service dies,
+the log names the exact program in flight.
+
+Pieces, in submission order (bench shapes 500k x 128, 1024 lists,
+64 probes, 1000 queries, unless RUNG=small):
+  1. coarse    — coarse_probes (GEMM + Pallas select_k)
+  2. invert    — _invert_probes (argsort + scatter)
+  3. gather    — query row gather through the inverted table
+  4. scan      — the Pallas list-scan kernel alone (_list_scan_call)
+  5. merge     — merge_candidates (double-gather + Pallas select_k)
+  6. fused     — the whole single-dispatch search
+  7. chained   — 4x-chained fused search (the measurement program)
+
+Run: PYTHONPATH=.:/root/.axon_site python tools/ivf_compile_bisect.py
+Env: RUNG=small|full (default small), RAFT_TPU_PALLAS to force tiers.
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
+
+RUNG = os.environ.get("RUNG", "small")
+if RUNG == "smoke":  # CPU harness check (run without /root/.axon_site)
+    jax.config.update("jax_platforms", "cpu")
+    N, D, NLISTS, NPROBES, NQ, K = 2_000, 32, 16, 4, 64, 8
+elif RUNG == "small":
+    N, D, NLISTS, NPROBES, NQ, K = 50_000, 128, 256, 16, 256, 32
+elif RUNG == "full":
+    N, D, NLISTS, NPROBES, NQ, K = 500_000, 128, 1024, 64, 1000, 32
+else:  # a typo must NEVER fall through to the heaviest compile
+    raise SystemExit(f"RUNG={RUNG!r}: want smoke|small|full")
+
+print(jax.devices(), f"rung={RUNG}", flush=True)
+
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import _ivf_scan as S
+
+key = jax.random.key(0)
+db = jax.random.normal(jax.random.fold_in(key, 1), (N, D))
+q = jax.random.normal(jax.random.fold_in(key, 2), (NQ, D))
+jax.block_until_ready((db, q))
+
+
+def step(name, fn):
+    print(f"[bisect] submitting: {name}", flush=True)
+    t0 = time.perf_counter()
+    out = fn()
+    leaves = jax.tree.leaves(out)
+    if leaves and not isinstance(leaves[0], jax.Array):
+        # unregistered container (e.g. ivf_flat.Index): sync its arrays
+        leaves = [v for v in vars(leaves[0]).values()
+                  if isinstance(v, jax.Array)]
+    for leaf in leaves:
+        np.asarray(jax.device_get(jnp.ravel(leaf)[:1]))
+    print(f"[bisect] OK {name}: {time.perf_counter() - t0:.1f} s",
+          flush=True)
+    return out
+
+
+idx = step("build", lambda: ivf_flat.build(
+    db, ivf_flat.IndexParams(n_lists=NLISTS, kmeans_n_iters=10)))
+max_list = idx.lists_data.shape[1]
+
+probes = step("coarse", lambda: S.coarse_probes(
+    q, idx.centers, NPROBES, use_pallas=True))
+cap = S.probe_cap(probes, NLISTS)
+print(f"[bisect] cap={cap} max_list={max_list}", flush=True)
+
+inv = step("invert", lambda: jax.jit(
+    lambda p: S._invert_probes(p, NLISTS, cap))(probes))
+qmap, inv_pos = inv
+
+qsub = step("gather", lambda: jax.jit(
+    lambda qq, qm: S.gather_query_rows(qq, qm))(q, qmap))
+
+# the Pallas kernel alone, at the exact fused-path layout
+from raft_tpu.ops.pallas_ivf_scan import _Layout, _list_scan_call, _pick_lc
+from raft_tpu.ops.dispatch import pallas_interpret
+
+lay = _Layout(probes, NLISTS, max_list, cap, 0, K)
+data_p = lay.pad_lists(idx.lists_data, max_list)
+norms_p = lay.pad_lists(idx.lists_norms, max_list)
+ids_p = lay.pad_lists(idx.lists_indices, max_list, fill=-1)
+qsub_p = jax.jit(lambda qq, qm: S.gather_query_rows(qq, qm))(
+    q, lay.padded_qmap())
+lc = _pick_lc(NLISTS, lay.mlp, lay.capp, D, data_p.dtype.itemsize)
+print(f"[bisect] bins={lay.bins} lc={lc}", flush=True)
+
+cd, ci = step("scan", lambda: _list_scan_call(
+    qsub_p, data_p, norms_p, ids_p, lay.bins, lc, 1.0,
+    pallas_interpret()))
+
+step("merge", lambda: lay.merge(cd, ci, probes, K, False))
+
+sp = ivf_flat.SearchParams(n_probes=NPROBES, probe_cap=cap)
+step("fused", lambda: ivf_flat.search(idx, q, K, sp))
+
+CHAIN = 4
+qs = jax.random.normal(jax.random.fold_in(key, 3), (CHAIN, NQ, D))
+
+
+@jax.jit
+def chained(qb):
+    acc = jnp.zeros((), jnp.float32)
+    for i in range(CHAIN):
+        dd, ii = ivf_flat.search(idx, qb[i], K, sp)
+        acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
+    return acc
+
+
+step("chained", lambda: chained(qs))
+
+# timing at this rung (marginal, chained)
+best = np.inf
+for _ in range(3):
+    t0 = time.perf_counter()
+    np.asarray(jax.device_get(chained(qs)))
+    best = min(best, (time.perf_counter() - t0) / CHAIN)
+print(f"[bisect] chained marginal: {best*1e3:.2f} ms -> "
+      f"{NQ/best:.0f} QPS", flush=True)

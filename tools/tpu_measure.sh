@@ -1,23 +1,43 @@
 #!/usr/bin/env bash
 # Staged TPU measurement sequence (run when the axon tunnel is healthy).
-# Writes one log per stage under tools/measure_out/. NEVER kill a stage
-# mid-compile: a killed remote compile wedges the tunnel for hours
-# (see .claude/skills/verify) — stages get generous timeouts instead,
-# and the probe uses tunnel_probe.sh (parks, never kills).
+# Writes one log per stage under tools/measure_out/.
+#
+# NO `timeout` around TPU clients: SIGTERM mid-remote-compile is the
+# documented tunnel-wedge trigger (.claude/skills/verify; BASELINE.md
+# round-2/3 notes), so a kill-switch is strictly worse than any hang it
+# guards against. If a stage hangs, leave it parked and investigate —
+# 2026-08-01: the remote service died ON ITS OWN chewing the fused-IVF
+# search compile, with no client kill involved; the bisect ladder below
+# exists to name the culprit program before anything big is submitted.
+#
+# Stage order is risk-ordered: each stage re-probes the tunnel first so
+# a service death in stage N doesn't waste stages N+1... on a corpse.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
 OUT=tools/measure_out
 mkdir -p "$OUT"
 
-echo "== probe (parks on hang; see $OUT/tunnel_probe.log)"
-bash tools/tunnel_probe.sh 120 || { echo "tunnel not healthy; abort"; exit 1; }
+probe() {
+  bash tools/tunnel_probe.sh 120 || {
+    echo "tunnel not healthy before stage $1; stopping"; exit 1; }
+}
 
+probe start
+
+echo "== 0. compile bisect ladder (names the program that kills the"
+echo "==    remote compiler, if any; small rung then full rung)"
+RUNG=small python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_small.log"
+probe bisect-full
+RUNG=full python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_full.log"
+
+probe 1
 echo "== 1. fused IVF-Flat operating-point A/B (brute baseline + sweep)"
-timeout 5400 python tools/profile_ivf_fused.py 2>&1 | tee "$OUT/ivf_fused_ab.log"
+python tools/profile_ivf_fused.py 2>&1 | tee "$OUT/ivf_fused_ab.log"
 
+probe 2
 echo "== 2. IVF-PQ scan modes (in-kernel decode vs reconstruct) + fp8 LUT"
-timeout 3600 python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_modes.log"
+python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_modes.log"
 import time, jax
 import jax.numpy as jnp
 import numpy as np
@@ -46,18 +66,21 @@ for name, kw in cases:
           f"recall@{k}={rec:.4f}", flush=True)
 EOF
 
+probe 3
 echo "== 3. build profile (compile vs compute split)"
-timeout 2400 python tools/profile_ivf_build.py 2>&1 | tee "$OUT/build_profile.log"
+python tools/profile_ivf_build.py 2>&1 | tee "$OUT/build_profile.log"
 
+probe 4
 echo "== 4. gated bench suite"
-timeout 3600 python bench_suite.py --gate 2>&1 | tee "$OUT/suite.log"
+python bench_suite.py --gate 2>&1 | tee "$OUT/suite.log"
 
+probe 4b
 echo "== 4b. reference-scale shapes (2M/10M x 128, 10k x 8192)"
-BENCH_BIG=1 timeout 7200 python bench_suite.py \
+BENCH_BIG=1 python bench_suite.py \
   brute_2m fused_wide ivf_10m 2>&1 | tee "$OUT/suite_big.log"
 
-echo "== 5. headline bench (child budget 2400s x probe + retries: keep"
-echo "==    the outer timeout comfortably above it)"
-timeout 8000 python bench.py 2>&1 | tee "$OUT/headline.log"
+probe 5
+echo "== 5. headline bench"
+python bench.py 2>&1 | tee "$OUT/headline.log"
 
 echo "== done; update BASELINE.md + PERF_GATES + ivf_pq auto default from $OUT"
