@@ -139,11 +139,39 @@ def _list_scan_call(qsub, data, norms, ids, bins: int, lc: int,
     return cd, ci
 
 
+_LC_ENV = None
+
+
+def _lc_env() -> int:
+    """``RAFT_TPU_IVF_LC`` resolved once per process (see ``_pick_lc``)."""
+    global _LC_ENV
+    if _LC_ENV is None:
+        import os
+        _LC_ENV = int(os.environ.get("RAFT_TPU_IVF_LC", "0"))
+    return _LC_ENV
+
+
 def _pick_lc(n_lists: int, max_list: int, cap: int, dim: int,
              itemsize: int) -> int:
     """Lists per grid cell: enough to amortize per-step overhead while
     the (LC·max_list·dim) data block + score blocks stay well under the
-    VMEM cap (double-buffered)."""
+    VMEM cap (double-buffered).
+
+    ``RAFT_TPU_IVF_LC`` overrides: ``1`` = grid-per-list, the PQ
+    kernel's structure and a ~lc×-smaller Mosaic program — the A/B knob
+    for the 2026-08-01 remote-compiler death whose prime suspect is
+    this kernel's Python-unrolled list loop
+    (tools/ivf_compile_bisect.py). Read ONCE, at first use: this runs
+    at trace time inside the jitted fused search and the jit cache does
+    not key on it, so an in-process env flip after a search has
+    compiled would silently re-execute the old program — set it before
+    the first search (the bisect ladder runs one process per value)."""
+    env = _lc_env()
+    if env > 0:
+        lc = min(env, n_lists)
+        while n_lists % lc:
+            lc -= 1
+        return lc
     per_list = (max_list * dim * itemsize          # data block
                 + cap * dim * 4                    # gathered queries
                 + max_list * cap * 4               # score block

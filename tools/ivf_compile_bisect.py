@@ -1,4 +1,4 @@
-"""Bisect which piece of the fused IVF-Flat search kills the remote
+"""Bisect which piece of the fused IVF search kills the remote
 compiler.
 
 Twice now (2026-07-31 build-path sorts — fixed; 2026-08-01 the fused
@@ -20,7 +20,11 @@ Pieces, in submission order (bench shapes 500k x 128, 1024 lists,
   7. chained   — 4x-chained fused search (the measurement program)
 
 Run: PYTHONPATH=.:/root/.axon_site python tools/ivf_compile_bisect.py
-Env: RUNG=small|full (default small), RAFT_TPU_PALLAS to force tiers.
+Env: RUNG=smoke|small|full (default small); FAMILY=flat|pq (default
+flat — pq pieces: build / coarse / code-scan / fused / chained, coarser
+because the flat rungs already isolate the shared invert/gather/merge
+glue); RAFT_TPU_PALLAS to force tiers; RAFT_TPU_IVF_LC=1 for the
+grid-per-list flat-kernel variant.
 """
 import os
 import time
@@ -70,6 +74,62 @@ def step(name, fn):
     return out
 
 
+CHAIN = 4
+
+
+def run_chained(tag, search_fn):
+    """Shared tail of both families: compile the CHAIN-long chained
+    search (the measurement program), then report its best-of-3
+    marginal in-jit ms — the protocol must stay identical across
+    families for the QPS numbers to be comparable."""
+    qs = jax.random.normal(jax.random.fold_in(key, 3), (CHAIN, NQ, D))
+
+    @jax.jit
+    def chained(qb):
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(CHAIN):
+            dd, ii = search_fn(qb[i])
+            acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
+        return acc
+
+    step(f"{tag}chained", lambda: chained(qs))
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(chained(qs)))
+        best = min(best, (time.perf_counter() - t0) / CHAIN)
+    print(f"[bisect] {tag}chained marginal: {best*1e3:.2f} ms -> "
+          f"{NQ/best:.0f} QPS", flush=True)
+
+
+FAMILY = os.environ.get("FAMILY", "flat")
+if FAMILY == "pq":
+    from raft_tpu.neighbors import ivf_pq
+
+    idx = step("pq build", lambda: ivf_pq.build(
+        db, ivf_pq.IndexParams(n_lists=NLISTS, kmeans_n_iters=10)))
+    probes = step("pq coarse", lambda: S.coarse_probes(
+        q, idx.centers, NPROBES, use_pallas=True))
+    cap = S.probe_cap(probes, NLISTS)
+    print(f"[bisect] cap={cap} max_list={idx.codes.shape[1]}", flush=True)
+
+    from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
+    q_rot = q @ idx.rotation_matrix.T
+    norms = idx.code_norms
+
+    step("pq code-scan", lambda: jax.jit(
+        lambda qr, pr: ivf_pq_code_scan_pallas(
+            qr, idx.centers_rot, idx.pq_centers, idx.codes, norms,
+            idx.lists_indices, pr, K, cap))(q_rot, probes))
+
+    sp = ivf_pq.SearchParams(n_probes=NPROBES, probe_cap=cap,
+                             scan_mode="codes")
+    step("pq fused", lambda: ivf_pq.search(idx, q, K, sp))
+    run_chained("pq ", lambda qb: ivf_pq.search(idx, qb, K, sp))
+    raise SystemExit(0)
+elif FAMILY != "flat":
+    raise SystemExit(f"FAMILY={FAMILY!r}: want flat|pq")
+
 idx = step("build", lambda: ivf_flat.build(
     db, ivf_flat.IndexParams(n_lists=NLISTS, kmeans_n_iters=10)))
 max_list = idx.lists_data.shape[1]
@@ -107,27 +167,4 @@ step("merge", lambda: lay.merge(cd, ci, probes, K, False))
 
 sp = ivf_flat.SearchParams(n_probes=NPROBES, probe_cap=cap)
 step("fused", lambda: ivf_flat.search(idx, q, K, sp))
-
-CHAIN = 4
-qs = jax.random.normal(jax.random.fold_in(key, 3), (CHAIN, NQ, D))
-
-
-@jax.jit
-def chained(qb):
-    acc = jnp.zeros((), jnp.float32)
-    for i in range(CHAIN):
-        dd, ii = ivf_flat.search(idx, qb[i], K, sp)
-        acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
-    return acc
-
-
-step("chained", lambda: chained(qs))
-
-# timing at this rung (marginal, chained)
-best = np.inf
-for _ in range(3):
-    t0 = time.perf_counter()
-    np.asarray(jax.device_get(chained(qs)))
-    best = min(best, (time.perf_counter() - t0) / CHAIN)
-print(f"[bisect] chained marginal: {best*1e3:.2f} ms -> "
-      f"{NQ/best:.0f} QPS", flush=True)
+run_chained("", lambda qb: ivf_flat.search(idx, qb, K, sp))

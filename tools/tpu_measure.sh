@@ -26,10 +26,27 @@ probe() {
 probe start
 
 echo "== 0. compile bisect ladder (names the program that kills the"
-echo "==    remote compiler, if any; small rung then full rung)"
+echo "==    remote compiler, if any; small rung then full rung)."
+echo "==    lc=1 first: grid-per-list is the ~8x-smaller Mosaic program"
+echo "==    (the auto lc-unrolled variant is the prime crash suspect)"
+RUNG=small RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_small_lc1.log"
+probe bisect-small-auto
 RUNG=small python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_small.log"
-probe bisect-full
+probe bisect-full-lc1
+RUNG=full RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_full_lc1.log"
+probe bisect-full-auto
 RUNG=full python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_full.log"
+
+probe bisect-pq
+echo "== 0b. PQ bisect ladder (the pq kernel's pq_dim-unrolled decode"
+echo "==     loop is its own compile-size hazard)"
+RUNG=small FAMILY=pq python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_pq_small.log"
+probe bisect-pq-full
+RUNG=full FAMILY=pq python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_pq_full.log"
 
 probe 1
 echo "== 1. fused IVF-Flat operating-point A/B (brute baseline + sweep)"
