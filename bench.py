@@ -268,8 +268,36 @@ def _run_child(extra_env, timeout_s):
     return None, f"{rc_note}: " + " | ".join(tail)
 
 
+def _relay_listening() -> bool:
+    """Is the axon tunnel's local relay up? (Its compile port listens on
+    loopback; when the remote side crashes the relay dies with it and
+    nothing listens — observed 2026-08-01.)"""
+    import socket
+    try:
+        with socket.create_connection(("127.0.0.1", 8093), timeout=2):
+            return True
+    except OSError:
+        return False
+
+
 def parent_main():
     errors = []
+    # the tunnel has died mid-round twice; if the relay is down when the
+    # driver runs us, wait a bounded window for the remote side to
+    # respawn it before burning the probe/degrade path — a recovered
+    # tunnel minutes later is a green round artifact, a CPU fallback is
+    # another worthless one (round-2 postmortem). Only wait where the
+    # axon tunnel is actually configured: off the TPU host the relay
+    # will never appear and the degrade path should decide in minutes.
+    wait_s = (float(os.environ.get("BENCH_WAIT_TUNNEL", 900))
+              if os.path.isdir("/root/.axon_site") else 0.0)
+    waited = 0.0
+    while not _relay_listening() and waited < wait_s:
+        time.sleep(30)
+        waited += 30
+    if waited:
+        errors.append(f"relay down; waited {int(waited)}s"
+                      + ("" if _relay_listening() else " (still down)"))
     # canary first: a wedged tunnel hangs (never errors) at first
     # dispatch, and burning TPU_ATTEMPTS × CHILD_TIMEOUT on hangs could
     # outlive the driver's budget. A short probe decides in minutes.

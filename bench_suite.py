@@ -187,7 +187,10 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
     d, nq, k = 128, 1000, 32
     db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
     q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
-    params = ivf_flat.IndexParams(n_lists=nlists)
+    # kmeans_n_iters=10 vs the parity default 20: measured downstream-
+    # recall-neutral for IVF-Flat (BASELINE.md 2026-08-01 A/B) and ~2×
+    # build; the row reports its own recall so the trade is visible
+    params = ivf_flat.IndexParams(n_lists=nlists, kmeans_n_iters=10)
     t_build0 = time.perf_counter()
     index = ivf_flat.build(db, params)
     _sync(index.centers)
@@ -235,7 +238,10 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
     d, nq, k = 128, 1000, 32
     db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
     q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
-    params = ivf_pq.IndexParams(n_lists=nlists)
+    # 10 EM iters: ~0.3% recall cost on random data (the bench
+    # distribution; ~1% on clustered — BASELINE.md A/B), recall rides
+    # in the row
+    params = ivf_pq.IndexParams(n_lists=nlists, kmeans_n_iters=10)
     t_build0 = time.perf_counter()
     index = ivf_pq.build(db, params)
     _sync(index.centers)

@@ -58,6 +58,10 @@ class CodebookGen(enum.IntEnum):
 class IndexParams:
     n_lists: int = 1024
     metric: DistanceType = DistanceType.L2Expanded
+    # reference-parity default; it feeds BOTH the coarse trainer and the
+    # PQ codebook trainers. 10 costs ~0.3% recall on random data but
+    # ~1% on clustered (codebook under-convergence, 2026-08-01 A/B) —
+    # the speed knob stays at call sites
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
     pq_bits: int = 8          # 4..8 in the reference
