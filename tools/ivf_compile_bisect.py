@@ -102,6 +102,12 @@ def run_chained(tag, search_fn):
           f"{NQ/best:.0f} QPS", flush=True)
 
 
+from raft_tpu.ops.dispatch import pallas_enabled, pallas_interpret
+
+# honors RAFT_TPU_PALLAS (set `always` for CPU smoke of the kernel
+# steps — they run interpreted; `never` = the XLA-tier rung)
+use_pallas = pallas_enabled()
+
 FAMILY = os.environ.get("FAMILY", "flat")
 if FAMILY == "pq":
     from raft_tpu.neighbors import ivf_pq
@@ -109,21 +115,26 @@ if FAMILY == "pq":
     idx = step("pq build", lambda: ivf_pq.build(
         db, ivf_pq.IndexParams(n_lists=NLISTS, kmeans_n_iters=10)))
     probes = step("pq coarse", lambda: S.coarse_probes(
-        q, idx.centers, NPROBES, use_pallas=True))
+        q, idx.centers, NPROBES, use_pallas=use_pallas))
     cap = S.probe_cap(probes, NLISTS)
     print(f"[bisect] cap={cap} max_list={idx.codes.shape[1]}", flush=True)
 
-    from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
-    q_rot = q @ idx.rotation_matrix.T
-    norms = idx.code_norms
+    if use_pallas:
+        from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
+        q_rot = q @ idx.rotation_matrix.T
+        norms = idx.code_norms
 
-    step("pq code-scan", lambda: jax.jit(
-        lambda qr, pr: ivf_pq_code_scan_pallas(
-            qr, idx.centers_rot, idx.pq_centers, idx.codes, norms,
-            idx.lists_indices, pr, K, cap))(q_rot, probes))
+        step("pq code-scan", lambda: jax.jit(
+            lambda qr, pr: ivf_pq_code_scan_pallas(
+                qr, idx.centers_rot, idx.pq_centers, idx.codes, norms,
+                idx.lists_indices, pr, K, cap))(q_rot, probes))
+    else:
+        print("[bisect] pallas disabled: skipping pq code-scan (fused/"
+              "chained route the reconstruct scan)", flush=True)
 
-    sp = ivf_pq.SearchParams(n_probes=NPROBES, probe_cap=cap,
-                             scan_mode="codes")
+    sp = ivf_pq.SearchParams(
+        n_probes=NPROBES, probe_cap=cap,
+        scan_mode="codes" if use_pallas else "reconstruct")
     step("pq fused", lambda: ivf_pq.search(idx, q, K, sp))
     run_chained("pq ", lambda qb: ivf_pq.search(idx, qb, K, sp))
     raise SystemExit(0)
@@ -135,7 +146,7 @@ idx = step("build", lambda: ivf_flat.build(
 max_list = idx.lists_data.shape[1]
 
 probes = step("coarse", lambda: S.coarse_probes(
-    q, idx.centers, NPROBES, use_pallas=True))
+    q, idx.centers, NPROBES, use_pallas=use_pallas))
 cap = S.probe_cap(probes, NLISTS)
 print(f"[bisect] cap={cap} max_list={max_list}", flush=True)
 
@@ -146,24 +157,28 @@ qmap, inv_pos = inv
 qsub = step("gather", lambda: jax.jit(
     lambda qq, qm: S.gather_query_rows(qq, qm))(q, qmap))
 
-# the Pallas kernel alone, at the exact fused-path layout
-from raft_tpu.ops.pallas_ivf_scan import _Layout, _list_scan_call, _pick_lc
-from raft_tpu.ops.dispatch import pallas_interpret
+if use_pallas:
+    # the Pallas kernel alone, at the exact fused-path layout
+    from raft_tpu.ops.pallas_ivf_scan import (_Layout, _list_scan_call,
+                                              _pick_lc)
 
-lay = _Layout(probes, NLISTS, max_list, cap, 0, K)
-data_p = lay.pad_lists(idx.lists_data, max_list)
-norms_p = lay.pad_lists(idx.lists_norms, max_list)
-ids_p = lay.pad_lists(idx.lists_indices, max_list, fill=-1)
-qsub_p = jax.jit(lambda qq, qm: S.gather_query_rows(qq, qm))(
-    q, lay.padded_qmap())
-lc = _pick_lc(NLISTS, lay.mlp, lay.capp, D, data_p.dtype.itemsize)
-print(f"[bisect] bins={lay.bins} lc={lc}", flush=True)
+    lay = _Layout(probes, NLISTS, max_list, cap, 0, K)
+    data_p = lay.pad_lists(idx.lists_data, max_list)
+    norms_p = lay.pad_lists(idx.lists_norms, max_list)
+    ids_p = lay.pad_lists(idx.lists_indices, max_list, fill=-1)
+    qsub_p = jax.jit(lambda qq, qm: S.gather_query_rows(qq, qm))(
+        q, lay.padded_qmap())
+    lc = _pick_lc(NLISTS, lay.mlp, lay.capp, D, data_p.dtype.itemsize)
+    print(f"[bisect] bins={lay.bins} lc={lc}", flush=True)
 
-cd, ci = step("scan", lambda: _list_scan_call(
-    qsub_p, data_p, norms_p, ids_p, lay.bins, lc, 1.0,
-    pallas_interpret()))
+    cd, ci = step("scan", lambda: _list_scan_call(
+        qsub_p, data_p, norms_p, ids_p, lay.bins, lc, 1.0,
+        pallas_interpret()))
 
-step("merge", lambda: lay.merge(cd, ci, probes, K, False))
+    step("merge", lambda: lay.merge(cd, ci, probes, K, False))
+else:
+    print("[bisect] pallas disabled: skipping kernel-only steps "
+          "(fused/chained route the XLA inverted_scan)", flush=True)
 
 sp = ivf_flat.SearchParams(n_probes=NPROBES, probe_cap=cap)
 step("fused", lambda: ivf_flat.search(idx, q, K, sp))

@@ -33,6 +33,11 @@ RUNG=small RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_small_lc1.log"
 probe bisect-small-auto
 RUNG=small python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_small.log"
+probe bisect-small-xla
+# XLA-tier rung: isolates Mosaic-vs-XLA if a kernel rung kills the
+# compiler, and gives the inverted_scan fallback a QPS data point
+RUNG=small RAFT_TPU_PALLAS=never python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_small_xla.log"
 probe bisect-full-lc1
 RUNG=full RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_full_lc1.log"
