@@ -5,7 +5,10 @@ elementwise family the Pallas tile kernel.
 
     python examples/01_pairwise_distance.py
 """
+import _backend
 import numpy as np
+
+_backend.ensure_backend()  # cpu fallback when the backend is down
 
 from raft_tpu.random import make_blobs
 from raft_tpu.distance import pairwise_distance

@@ -2,7 +2,10 @@
 
     python examples/02_ann_ivf.py
 """
+import _backend
 import tempfile
+
+_backend.ensure_backend()  # cpu fallback when the backend is down
 
 import numpy as np
 

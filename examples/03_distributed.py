@@ -9,6 +9,7 @@ analogue):
     RAFT_TPU_COORDINATOR=host0:1234 RAFT_TPU_NUM_PROCS=2 \
     RAFT_TPU_PROC_ID=$RANK python examples/03_distributed.py
 """
+import _backend
 import numpy as np
 
 from raft_tpu.comms import Session, detect_launcher, build_launcher_resources
@@ -18,9 +19,12 @@ from raft_tpu.random import make_blobs
 
 world = detect_launcher()
 if world.num_processes > 1:
+    # NO backend touch before this: jax.distributed rendezvous must
+    # precede device init (raft_tpu/comms/launcher.py ordering)
     res = build_launcher_resources(world=world)   # launcher-driven path
     mesh = res.mesh
 else:
+    _backend.ensure_backend()  # cpu fallback when the backend is down
     session = Session(axis_names=("data",)).init()
     res, mesh = session.resources, session.mesh
 

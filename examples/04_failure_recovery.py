@@ -22,9 +22,16 @@ Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      JAX_PLATFORMS=cpu python examples/04_failure_recovery.py
 """
 
+import _backend
 import time
 
 import numpy as np
+
+N_RANKS = 8
+# the demo mesh needs N_RANKS devices: force the CPU virtual mesh
+# (must run before jax initializes any backend)
+_backend.ensure_backend(min_devices=N_RANKS)
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -32,8 +39,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raft_tpu.comms import Status, build_comms
 from raft_tpu.comms.health import HealthMonitor
 from raft_tpu.parallel import make_mesh
-
-N_RANKS = 8
 mesh = make_mesh(axis_names=("data",))
 comms = build_comms(mesh, "data")
 

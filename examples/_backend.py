@@ -1,0 +1,49 @@
+"""Shared example prologue: fall back to CPU when the configured JAX
+backend is unavailable (e.g. ``JAX_PLATFORMS`` points at an accelerator
+plugin whose transport is down), so every example runs anywhere.
+
+No import-time side effects — initializing a backend before
+``jax.distributed.initialize`` breaks multi-process rendezvous
+(``raft_tpu/comms/launcher.py`` documents the ordering), so each
+example calls :func:`ensure_backend` at the right point itself;
+``examples/03_distributed.py`` skips it entirely for launcher-driven
+multi-process runs.
+"""
+import os
+
+
+def ensure_backend(min_devices: int = 1) -> str:
+    """Make a usable backend available and return its platform name.
+
+    Falls back to CPU when the configured backend fails to initialize.
+    ``min_devices``: mesh examples need N devices; when the available
+    backend has fewer, switch to CPU and force a virtual device count
+    (the tests/conftest.py XLA_FLAGS mechanism) — this must run before
+    the first backend touch of the process.
+    """
+    import jax
+
+    if min_devices > 1:
+        # decide BEFORE initializing any backend: forcing host devices
+        # has no effect once a backend exists
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{min_devices}").strip()
+        jax.config.update("jax_platforms", "cpu")
+        n = jax.device_count()
+        if n < min_devices:
+            raise SystemExit(
+                f"[examples] need {min_devices} devices, have {n} — "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{min_devices} before starting Python")
+        return jax.devices()[0].platform
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError as e:
+        print(f"[examples] configured backend unavailable ({e!s:.80}); "
+              "falling back to cpu")
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
