@@ -286,6 +286,55 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
         "build_s": round(t_build, 2)})
 
 
+def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=64,
+                 label=None):
+    # the 1-bit tier (raft_tpu/neighbors/ivf_bq.py): wall QPS includes
+    # the host rescore; device_marginal_qps chains the jitted device
+    # phase alone (estimator scan), the gbench stream methodology
+    import jax
+    from raft_tpu.neighbors import ivf_bq
+    key = jax.random.key(12)
+    d, nq, k = 128, 1000, 32
+    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    t_build0 = time.perf_counter()
+    index = ivf_bq.build(db, ivf_bq.IndexParams(n_lists=nlists,
+                                                kmeans_n_iters=10))
+    _sync(index.bits)
+    t_build = time.perf_counter() - t_build0
+    sp = ivf_bq.SearchParams(n_probes=n_probes)
+    d_f, i_f = ivf_bq.search(index, q, k, sp)  # warm + measure cap
+    rec = _ivf_recall(i_f, db, q, k)
+    t = _time(lambda: ivf_bq.search(index, q, k, sp), reps=3)
+    # pin the measured cap so nothing syncs inside the chained trace
+    sp_est = ivf_bq.SearchParams(n_probes=n_probes, rescore_factor=0,
+                                 probe_cap=index.cap_cache[(nq, n_probes)])
+    reps = _chain_reps()
+    qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
+
+    def run1(qq, centers, centers_rot, rot, bits, norms2, scales, ids):
+        import dataclasses
+        idx2 = dataclasses.replace(index, centers=centers,
+                                   centers_rot=centers_rot,
+                                   rotation_matrix=rot, bits=bits,
+                                   norms2=norms2, scales=scales,
+                                   lists_indices=ids, raw=None)
+        return ivf_bq.search(idx2, qq, k, sp_est)
+
+    t_marg = _chained_search_time(
+        run1, qb, reps, index.centers, index.centers_rot,
+        index.rotation_matrix, index.bits, index.norms2, index.scales,
+        index.lists_indices)
+    results.append({
+        "metric": (label or
+                   f"ivf_bq_search_{n//1000}kx{d}_q{nq}_k{k}"
+                   f"_p{n_probes}_qps"),
+        "value": round(nq / t, 1), "unit": "queries/s",
+        "recall": round(rec, 4),
+        "device_marginal_qps": round(nq / t_marg, 1),
+        "build_s": round(t_build, 2)})
+
+
 def _big_enabled() -> bool:
     """Reference-scale shapes (cpp/bench/neighbors/knn.cuh:380-389:
     2M/10M×128, 10k×8192) — hours on the CPU mesh, so opt-in via
@@ -422,9 +471,9 @@ def bench_host_ivf(results):
 
 
 _CASES = [bench_pairwise_distance, bench_fused_l2_nn, bench_select_k,
-          bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_linalg_random,
-          bench_ball_cover, bench_sparse_wide, bench_host_ivf,
-          bench_brute_2m, bench_fused_wide, bench_ivf_10m]
+          bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_ivf_bq,
+          bench_linalg_random, bench_ball_cover, bench_sparse_wide,
+          bench_host_ivf, bench_brute_2m, bench_fused_wide, bench_ivf_10m]
 
 
 def run_all(cases=None):

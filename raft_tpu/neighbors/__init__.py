@@ -19,6 +19,7 @@ from raft_tpu.neighbors.brute_force import knn, brute_force_knn, knn_merge_parts
 from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors_l2sq
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import ivf_bq
 from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.neighbors import serialize
@@ -29,6 +30,7 @@ __all__ = [
     "IndexParams", "SearchParams",
     "select_k", "knn", "brute_force_knn", "knn_merge_parts", "fused_l2_knn",
     "haversine_knn",
-    "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ball_cover", "refine",
+    "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ivf_bq", "ball_cover",
+    "refine",
     "serialize", "processing", "host_memory",
 ]

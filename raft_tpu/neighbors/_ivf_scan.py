@@ -125,6 +125,28 @@ def _score_block(qsub, data, norms, scale):
     return qq[:, :, None] + norms[:, None, :] - 2.0 * ip
 
 
+def binned_partial_topk(d, lid, bins: int):
+    """Binned (min, argmin) along the trailing list axis — the TPU-KNN
+    partial top-k shared by the XLA-tier scans (contiguous column bins;
+    the Pallas kernel uses strided bins instead — see its docstring).
+    ``d`` (..., cap, ML) scores, ``lid`` (..., ML) global ids (−1 pad)
+    → per-bin ``(min (..., cap, bins), min-id)``; of two hits in one
+    bin only the nearer survives (ties: smallest id)."""
+    *lead, cap, max_list = d.shape
+    b = -(-max_list // bins)
+    pad = bins * b - max_list
+    dp = jnp.pad(d, [(0, 0)] * (d.ndim - 1) + [(0, pad)],
+                 constant_values=jnp.inf)
+    db_ = dp.reshape(*lead, cap, bins, b)
+    cd = jnp.min(db_, axis=-1)
+    col = jnp.pad(jnp.broadcast_to(lid[..., None, :], d.shape),
+                  [(0, 0)] * (d.ndim - 1) + [(0, pad)],
+                  constant_values=-1).reshape(*lead, cap, bins, b)
+    big = jnp.iinfo(jnp.int32).max
+    gl = jnp.min(jnp.where(db_ == cd[..., None], col, big), axis=-1)
+    return cd, jnp.where(gl == big, -1, gl)
+
+
 def merge_candidates(cand_d, cand_i, probes, inv_pos, k: int,
                      sqrt: bool, use_pallas_select: bool = False,
                      cap: Optional[int] = None):
@@ -229,20 +251,7 @@ def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
         d = _score_block(qsub, dat, nrm, scale)
         d = jnp.where(lid[:, None, :] >= 0, jnp.maximum(d, 0.0), jnp.inf)
         if bins > 0 and kk < max_list:
-            b = -(-max_list // kk)                       # bin width
-            pad = kk * b - max_list
-            dp = jnp.pad(d, ((0, 0), (0, 0), (0, pad)),
-                         constant_values=jnp.inf)
-            db_ = dp.reshape(chunk, cap, kk, b)
-            cd = jnp.min(db_, axis=3)                    # (chunk, cap, kk)
-            col = jnp.pad(
-                jnp.broadcast_to(lid[:, None, :], (chunk, cap, max_list)),
-                ((0, 0), (0, 0), (0, pad)), constant_values=-1
-            ).reshape(chunk, cap, kk, b)
-            big = jnp.iinfo(jnp.int32).max
-            gl = jnp.min(jnp.where(db_ == cd[..., None], col, big), axis=3)
-            gl = jnp.where(gl == big, -1, gl)
-            return cd, gl
+            return binned_partial_topk(d, lid, kk)
         flat = d.reshape(chunk * cap, max_list)
         cd, csel = lax.top_k(-flat, kk)
         cd = -cd

@@ -1,0 +1,286 @@
+"""IVF-BQ: binary-quantized inverted-file index (1 bit/dim + per-row
+scale), with exact host-side rescoring.
+
+A capability tier beyond the reference's IVF-Flat/IVF-PQ axis
+(`spatial/knn/detail/ivf_flat_build.cuh:228`, `ivf_pq_build.cuh:908`
+define the build/search structure mirrored here), following the
+sign-random-rotation binary-quantization pattern of the IVF-RaBitQ
+line of work (PAPERS.md). Why it earns its place on TPU:
+
+* **Memory**: d/8 bits + 8 B per vector — 100M×128 ≈ **2.4 GB**, so
+  the BASELINE.md north-star dataset fits a single v5e chip's HBM with
+  room to spare (f32 IVF-Flat needs 51 GB, IVF-PQ codes ≈ 3.2 GB).
+* **Build speed**: NO codebook training — beyond the shared coarse
+  k-means the encode is one subtract + sign, so build ≈ IVF-Flat's
+  coarse phase alone (the reference's PQ `train_per_subset` loop
+  disappears entirely).
+* **MXU scoring**: the quantized scan is a plain ±1 bf16 matmul —
+  decode is shift/mask VPU work and the estimator rides the MXU at
+  full tile shapes; no LUT gathers anywhere.
+
+Scoring model (residual form, like IVF-PQ): for query q probing list
+l with center c_l, and a stored point x = c_l + r,
+
+    ||q − x||² = ||q_l||² + ||r||² − 2⟨q_l, r⟩,   q_l = q − c_l
+    ⟨q_l, r⟩ ≈ s·⟨q_l, sign(r)⟩,                 s = mean(|r|)
+
+(s·sign(r) is the best {±s}^d approximation of r in L2.) The
+estimator ranks candidates; `rescore_factor`·k survivors are re-ranked
+with EXACT f32 distances against the raw vectors kept host-side (the
+`host_memory` role: device holds bits, host holds truth), so returned
+distances are exact and recall approaches the probe ceiling.
+
+XLA-tier formulation only (chunked decode tiles + einsum): one jitted
+dispatch for the device phase, no bespoke Mosaic kernel — deliberate,
+given the 2026-08-01 remote-compiler incidents; a Pallas in-VMEM
+unpack tier is the follow-up once the bisect ladder clears it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.core import trace
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.util.host_sample import sample_rows
+
+
+@dataclass
+class IndexParams:
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    kmeans_n_iters: int = 10          # coarse only; there is no codebook
+    kmeans_trainset_fraction: float = 0.5
+    kmeans_kernel_precision: object = None
+    # keep the raw f32 vectors on HOST for exact rescoring (the
+    # device never stores them); False = estimator-only index
+    keep_raw: bool = True
+
+
+@dataclass
+class SearchParams:
+    n_probes: int = 20
+    # rescore_factor·k estimator candidates are re-ranked exactly on
+    # host; 0 disables rescoring (estimator distances returned). 8 by
+    # default: the estimator, not the probe set, is the recall limiter
+    # (measured 0.77 → 0.88 recall@10 going 4 → 8 on clustered 50k×64)
+    rescore_factor: int = 8
+    # inverted-table width policy, as ivf_flat (see _ivf_scan.resolve_cap)
+    probe_cap: int = 0
+    # per-list candidate bins (0 = auto ≥ 4k, exact when ≥ max_list)
+    scan_bins: int = 0
+
+
+@dataclass
+class Index:
+    centers: jax.Array          # (n_lists, dim) f32
+    centers_rot: jax.Array      # (n_lists, dim) f32 — P @ centers
+    rotation_matrix: jax.Array  # (dim, dim) random orthogonal P
+    bits: jax.Array             # (n_lists, max_list, words) uint32
+    norms2: jax.Array           # (n_lists, max_list) f32  ||r||²
+    scales: jax.Array           # (n_lists, max_list) f32  mean|r|
+    lists_indices: jax.Array    # (n_lists, max_list) int32, -1 pad
+    list_sizes: jax.Array       # (n_lists,) int32
+    metric: DistanceType
+    size: int
+    raw: Optional[np.ndarray] = None   # (n, dim) f32 host copy
+    cap_cache: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def words(self) -> int:
+        return self.bits.shape[2]
+
+
+def _pack_bits(r) -> jax.Array:
+    """sign bits of (n, d) → (n, ceil(d/32)) uint32, bit i of word w =
+    (r[:, 32w+i] >= 0)."""
+    n, d = r.shape
+    pad = (-d) % 32
+    b = (r >= 0).astype(jnp.uint32)
+    if pad:
+        b = jnp.pad(b, ((0, 0), (0, pad)))
+    b = b.reshape(n, -1, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    return jnp.sum(b << shifts, axis=2, dtype=jnp.uint32)
+
+
+def _unpack_pm1(words, d: int, dtype=jnp.bfloat16) -> jax.Array:
+    """(..., w) uint32 → (..., d) ±1: the decode tile. VPU shift/mask;
+    the result feeds the MXU einsum directly."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    flat = bits.reshape(*words.shape[:-1], words.shape[-1] * 32)[..., :d]
+    return (2.0 * flat.astype(dtype) - 1.0).astype(dtype)
+
+
+def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
+    """Coarse k-means + sign-encode residuals (no codebook training —
+    the build-speed headline of the binary tier)."""
+    x = as_array(dataset).astype(jnp.float32)
+    n, d = x.shape
+    expects(params.n_lists <= n, "ivf_bq.build: n_lists > n_samples")
+    expects(params.metric in (DistanceType.L2Expanded,
+                              DistanceType.L2SqrtExpanded),
+            "ivf_bq: L2 metrics only (got %s)", params.metric)
+    with trace.range("ivf_bq::build(%d, %d)", n, params.n_lists):
+        n_train = max(params.n_lists,
+                      int(n * params.kmeans_trainset_fraction))
+        trainset = x[sample_rows(n, n_train, 0)] if n_train < n else x
+        centers = kmeans_balanced.build_hierarchical(
+            trainset, params.n_lists, params.kmeans_n_iters,
+            kernel_precision=params.kmeans_kernel_precision, res=res)
+        labels = kmeans_balanced.predict(x, centers, res=res)
+        # random rotation before the sign code (the RaBitQ trick, via
+        # the same construction as ivf_pq.make_rotation_matrix):
+        # isotropizes residual coordinates so each bit carries ~equal
+        # information. Neutral on already-isotropic data (gaussian /
+        # post-kmeans blobs measure within noise), load-bearing on
+        # anisotropic real features (low-rank/correlated dims would
+        # otherwise waste bits); kept unconditional like the reference's
+        # PQ rotation
+        from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+        rot = make_rotation_matrix(d, d, force_random=True)
+        r = (x - centers[labels]) @ rot.T
+        norms2 = jnp.sum(r * r, axis=1)
+        scales = jnp.mean(jnp.abs(r), axis=1)
+        words = _pack_bits(r)
+        # bucketize one combined payload: word bit-patterns ride as f32
+        # bitcasts (never computed on), norms/scales as plain columns
+        from raft_tpu.neighbors.ivf_flat import _bucketize
+        payload = jnp.concatenate(
+            [lax.bitcast_convert_type(words, jnp.float32),
+             norms2[:, None], scales[:, None]], axis=1)
+        bucketed, idx, _, counts = _bucketize(payload, labels,
+                                              params.n_lists)
+        w = words.shape[1]
+        bits = lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32)
+        raw = np.asarray(jax.device_get(x)) if params.keep_raw else None
+    return Index(centers=centers, centers_rot=centers @ rot.T,
+                 rotation_matrix=rot, bits=bits,
+                 norms2=bucketed[:, :, w],
+                 scales=bucketed[:, :, w + 1],
+                 lists_indices=idx, list_sizes=counts,
+                 metric=params.metric, size=n, raw=raw)
+
+
+@functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
+                                             "cap", "chunk", "dim"))
+def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
+                     scales, ids, *, kk: int, bins: int, n_probes: int,
+                     cap: int, chunk: int, dim: int):
+    """Single-dispatch device phase: coarse GEMM + top-k probes, query
+    rotation, probe inversion, chunked decode-tile estimator scan,
+    candidate merge. Returns (est dists (nq, kk), global ids (nq, kk))
+    — estimator ordering, squared-L2 scale."""
+    from raft_tpu.neighbors import _ivf_scan as S
+    nq = queries.shape[0]
+    n_lists, max_list = ids.shape
+    probes = S.coarse_probes(queries, centers, n_probes)
+    q_rot = queries @ rot.T      # orthogonal: L2 geometry unchanged
+    qmap, inv_pos = S._invert_probes(probes, n_lists, cap)
+
+    n_chunks = n_lists // chunk
+    qmap_c = qmap.reshape(n_chunks, chunk, cap)
+    bits_c = bits.reshape(n_chunks, chunk, max_list, -1)
+    n2_c = norms2.reshape(n_chunks, chunk, max_list)
+    sc_c = scales.reshape(n_chunks, chunk, max_list)
+    ids_c = ids.reshape(n_chunks, chunk, max_list)
+    cent_c = centers_rot.reshape(n_chunks, chunk, dim)
+
+    def one_chunk(args):
+        qm, bw, n2, sc, lid, cl = args
+        qsub = q_rot[jnp.clip(qm, 0, nq - 1)] - cl[:, None, :]
+        pm1 = _unpack_pm1(bw, dim)                    # (chunk, ML, d) ±1
+        ip = jnp.einsum("gcd,gld->gcl", qsub.astype(jnp.bfloat16), pm1,
+                        preferred_element_type=jnp.float32)
+        qq = jnp.sum(qsub * qsub, axis=2)             # (chunk, cap)
+        est = (qq[:, :, None] + n2[:, None, :]
+               - 2.0 * sc[:, None, :] * ip)           # (chunk, cap, ML)
+        est = jnp.where(lid[:, None, :] >= 0, est, jnp.inf)
+        return S.binned_partial_topk(est, lid, bins)
+
+    cand_d, cand_i = lax.map(one_chunk,
+                             (qmap_c, bits_c, n2_c, sc_c, ids_c, cent_c))
+    cand_d = cand_d.reshape(n_lists, cap, -1)
+    cand_i = cand_i.reshape(n_lists, cap, -1)
+    return S.merge_candidates(cand_d, cand_i, probes, inv_pos, kk,
+                              sqrt=False, cap=cap)
+
+
+def _resolve(index: Index, queries, n_probes: int, pc: int) -> int:
+    from raft_tpu.neighbors import _ivf_scan as S
+    return S.resolve_cap(index.cap_cache, queries, index.centers,
+                         type("P", (), {"probe_cap": pc})(), n_probes,
+                         index.n_lists)
+
+
+def search(index: Index, queries, k: int,
+           params: SearchParams = SearchParams(), res=None
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Estimator scan on device (one dispatch) + exact host rescore.
+    Returned distances are exact squared-L2 (sqrt for the Sqrt metric)
+    when rescoring; estimator values otherwise."""
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == index.dim, "ivf_bq.search: dim mismatch")
+    n_probes = min(params.n_probes, index.n_lists)
+    rescore = params.rescore_factor > 0 and index.raw is not None
+    # no clamp to index.size: merge_candidates pads short candidate
+    # sets, preserving the (nq, k) output contract of the other indexes
+    kk = max(params.rescore_factor, 1) * k if rescore else k
+    cap = _resolve(index, q, n_probes, params.probe_cap)
+    max_list = index.bits.shape[1]
+    bins = min(params.scan_bins or max(4 * kk, 64), max_list)
+    # chunk bound: BOTH the (chunk, cap, max_list) estimator block
+    # (the _ivf_scan._chunk_size budget every XLA-tier search uses)
+    # AND the (chunk, max_list, dim) decode tile must stay modest
+    from raft_tpu.neighbors._ivf_scan import (_chunk_size,
+                                              largest_divisor_at_most)
+    chunk = min(  # both are divisors of n_lists, so their min is too
+        _chunk_size(index.n_lists, cap, max_list),
+        largest_divisor_at_most(
+            index.n_lists,
+            max(1, (64 << 20) // max(1, max_list * index.dim * 2))))
+    with trace.range("ivf_bq::search(%d, %d)", q.shape[0], n_probes):
+        d_est, ids = _fused_bq_search(
+            q, index.centers, index.centers_rot, index.rotation_matrix,
+            index.bits, index.norms2, index.scales,
+            index.lists_indices, kk=kk, bins=bins,
+            n_probes=n_probes, cap=cap, chunk=chunk, dim=index.dim)
+        sqrt = index.metric == DistanceType.L2SqrtExpanded
+        if not rescore:
+            return (jnp.sqrt(jnp.maximum(d_est, 0.0)) if sqrt
+                    else d_est), ids
+        # host rescore: exact distances for the kk survivors
+        ids_h = np.asarray(jax.device_get(ids))
+        qh = np.asarray(jax.device_get(q))
+        cand = index.raw[np.maximum(ids_h, 0)]          # (nq, kk, d)
+        diff = cand - qh[:, None, :]
+        ex = np.einsum("qkd,qkd->qk", diff, diff)
+        ex = np.where(ids_h >= 0, ex, np.inf)
+        order = np.argsort(ex, axis=1)[:, :k]
+        d_out = np.take_along_axis(ex, order, axis=1)
+        i_out = np.take_along_axis(ids_h, order, axis=1)
+        i_out = np.where(np.isfinite(d_out), i_out, -1)
+        d_out = np.where(np.isfinite(d_out), d_out, np.inf)
+        if sqrt:
+            d_out = np.sqrt(np.maximum(d_out, 0.0))
+    return jnp.asarray(d_out), jnp.asarray(i_out)

@@ -123,6 +123,39 @@ def load_ivf_pq(path: str):
     return index
 
 
+def save_ivf_bq(index, path: str) -> None:
+    """Write an :class:`raft_tpu.neighbors.ivf_bq.Index`. The raw host
+    vectors (rescore tier) ride along when present."""
+    arrays = {"centers": index.centers, "centers_rot": index.centers_rot,
+              "rotation_matrix": index.rotation_matrix,
+              "bits": index.bits, "norms2": index.norms2,
+              "scales": index.scales,
+              "lists_indices": index.lists_indices,
+              "list_sizes": index.list_sizes}
+    if index.raw is not None:
+        arrays["raw"] = index.raw
+    _pack(path, "ivf_bq",
+          {"metric": int(index.metric), "size": int(index.size),
+           "has_raw": index.raw is not None}, arrays)
+
+
+def load_ivf_bq(path: str):
+    """Read an IVF-BQ index written by :func:`save_ivf_bq`."""
+    from raft_tpu.neighbors.ivf_bq import Index
+    meta, a = _unpack(path, "ivf_bq")
+    return Index(
+        centers=jnp.asarray(a["centers"]),
+        centers_rot=jnp.asarray(a["centers_rot"]),
+        rotation_matrix=jnp.asarray(a["rotation_matrix"]),
+        bits=jnp.asarray(a["bits"]),
+        norms2=jnp.asarray(a["norms2"]),
+        scales=jnp.asarray(a["scales"]),
+        lists_indices=jnp.asarray(a["lists_indices"]),
+        list_sizes=jnp.asarray(a["list_sizes"]),
+        metric=DistanceType(meta["metric"]), size=meta["size"],
+        raw=a["raw"] if meta.get("has_raw") else None)
+
+
 def save_host_ivf_flat(index, path: str) -> None:
     """Write a host-resident :class:`host_memory.HostIvfFlat`. The list
     arrays stream from host numpy — nothing touches the device."""
@@ -172,13 +205,15 @@ def load_ball_cover(path: str):
 
 def save(index, path: str) -> None:
     """Type-dispatching save for any supported ANN index."""
-    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_bq
     from raft_tpu.neighbors.ball_cover import BallCoverIndex
     from raft_tpu.neighbors.host_memory import HostIvfFlat
     if isinstance(index, ivf_flat.Index):
         save_ivf_flat(index, path)
     elif isinstance(index, ivf_pq.Index):
         save_ivf_pq(index, path)
+    elif isinstance(index, ivf_bq.Index):
+        save_ivf_bq(index, path)
     elif isinstance(index, HostIvfFlat):
         save_host_ivf_flat(index, path)
     elif isinstance(index, BallCoverIndex):
@@ -197,6 +232,8 @@ def load(path: str):
         return load_ivf_flat(path)
     if fmt == "ivf_pq":
         return load_ivf_pq(path)
+    if fmt == "ivf_bq":
+        return load_ivf_bq(path)
     if fmt == "host_ivf_flat":
         return load_host_ivf_flat(path)
     if fmt == "ball_cover":
