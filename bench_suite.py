@@ -306,8 +306,12 @@ def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=64,
     d_f, i_f = ivf_bq.search(index, q, k, sp)  # warm + measure cap
     rec = _ivf_recall(i_f, db, q, k)
     t = _time(lambda: ivf_bq.search(index, q, k, sp), reps=3)
-    # pin the measured cap so nothing syncs inside the chained trace
-    sp_est = ivf_bq.SearchParams(n_probes=n_probes, rescore_factor=0,
+    # chained device phase: SAME rescore_factor (kk and merge width are
+    # shaped by it whether or not raw vectors exist — ivf_bq.search
+    # docstring), raw stripped so the chain stays one jitted program,
+    # cap pinned so nothing syncs inside the trace
+    sp_est = ivf_bq.SearchParams(n_probes=n_probes,
+                                 rescore_factor=sp.rescore_factor,
                                  probe_cap=index.cap_cache[(nq, n_probes)])
     reps = _chain_reps()
     qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))

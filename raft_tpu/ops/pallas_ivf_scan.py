@@ -275,6 +275,124 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     return lay.merge(cd, ci, probes, k, sqrt)
 
 
+def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
+                    cd_ref, ci_ref, *, lc: int, bins: int, dim: int):
+    """Binary-quantized list scan (ivf_bq's fine phase): unpack the
+    1-bit sign codes to a transient ±1 bf16 tile IN VMEM — the 8×-HBM
+    win over reading bf16 rows — then the same transposed-score
+    geometry as ``_list_scan_kernel`` (rows on sublanes, probing
+    queries on lanes) and its strided binned partial top-k.
+
+    Estimator: ``est = ||q_l||² + ||r||² − 2·s·⟨q_l, sign(r)⟩``
+    (see ivf_bq.py). Shift/mask unpack loops over the w ≤ dim/32 words
+    in Python — w is 4 at d=128, so the unroll stays tiny.
+    """
+    for l in range(lc):
+        q = qsub_ref[l]                                  # (cap, dim) f32
+        words = bits_ref[l]                              # (ML, w) int32
+        ml = words.shape[0]
+        cap = q.shape[0]
+        w = words.shape[1]
+        cols = []
+        for j in range(w):
+            wj = words[:, j:j + 1]                       # (ML, 1)
+            sh = jax.lax.broadcasted_iota(jnp.int32, (1, 32), 1)
+            # (x >> s) & 1 extracts bit s for any int32 x, arithmetic
+            # shift included — only bit 0 of the shifted value is read
+            cols.append((jax.lax.shift_right_logical(
+                jnp.broadcast_to(wj, (ml, 32)),
+                jnp.broadcast_to(sh, (ml, 32))) & 1))
+        bits = jnp.concatenate(cols, axis=1)[:, :dim]    # (ML, dim) 0/1
+        pm1 = (2 * bits - 1).astype(jnp.bfloat16)        # ±1
+        ip = jax.lax.dot_general(
+            pm1, q.astype(jnp.bfloat16), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (ML, cap)
+        qq = jnp.sum(q * q, axis=1)[None, :]             # (1, cap)
+        n2 = norms2_ref[l, 0][:, None]                   # (ML, 1)
+        sc = scales_ref[l, 0][:, None]                   # (ML, 1)
+        ids = ids_ref[l, 0]                              # (ML,)
+        ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
+        d = n2 + qq - 2.0 * sc * ip
+        # NO maximum(d, 0) clamp here: the 1-bit estimator legitimately
+        # goes negative when it overshoots near a true neighbor, and
+        # clamping would collapse exactly the strongest candidates into
+        # id-order ties (unlike the exact-distance kernels, where the
+        # clamp only removes fp noise). The XLA tier matches.
+        d = jnp.where(ids_b >= 0, d, jnp.inf)
+        wb = ml // bins
+        db_ = d.reshape(wb, bins, cap)                   # strided bins
+        cd = jnp.min(db_, axis=0)
+        rb = ids_b.reshape(wb, bins, cap)
+        ci = jnp.min(jnp.where(db_ == cd[None, :, :], rb, _BIG_I32),
+                     axis=0)
+        ci = jnp.where(ci == _BIG_I32, -1, ci)
+        cd_ref[l] = cd.astype(cd_ref.dtype)
+        ci_ref[l] = ci
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "lc", "dim",
+                                             "interpret"))
+def _bq_scan_call(qsub, bits_i32, norms2, scales, ids, bins: int,
+                  lc: int, dim: int, interpret: bool):
+    n_lists, cap, _ = qsub.shape
+    max_list = bits_i32.shape[1]
+    w = bits_i32.shape[2]
+    gc = n_lists // lc
+    kern = functools.partial(_bq_scan_kernel, lc=lc, bins=bins, dim=dim)
+    norms3 = norms2[:, None, :]
+    scales3 = scales[:, None, :]
+    ids3 = ids[:, None, :]
+    cd, ci = pl.pallas_call(
+        kern,
+        grid=(gc,),
+        in_specs=[pl.BlockSpec((lc, cap, dim), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, max_list, w), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0))],
+        out_specs=[pl.BlockSpec((lc, bins, cap), lambda g: (g, 0, 0)),
+                   pl.BlockSpec((lc, bins, cap), lambda g: (g, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_lists, bins, cap),
+                                        jnp.float32),
+                   jax.ShapeDtypeStruct((n_lists, bins, cap), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_lists * max_list * cap * dim,
+            bytes_accessed=(4 * n_lists * max_list * w
+                            + 4 * n_lists * cap * dim
+                            + 8 * n_lists * bins * cap),
+            transcendentals=0),
+        interpret=interpret,
+    )(qsub, bits_i32, norms3, scales3, ids3)
+    return cd, ci
+
+
+def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
+                       lists_indices, probes, k: int, cap: int,
+                       bins: int = 0, sqrt: bool = False):
+    """Fused Pallas fine phase for ivf_bq: probe inversion + per-list
+    query gather (rotated, center-offset) + the in-VMEM unpack scan +
+    the shared candidate merge. Mirrors ``ivf_list_scan_pallas``."""
+    nq, dim = q_rot.shape
+    n_lists, max_list = lists_indices.shape
+    lay = _Layout(probes, n_lists, max_list, cap, bins, k)
+    bits_i32 = jax.lax.bitcast_convert_type(bits, jnp.int32)
+    bits_i32 = lay.pad_lists(bits_i32, max_list)
+    norms2 = lay.pad_lists(norms2, max_list)
+    scales = lay.pad_lists(scales, max_list)
+    lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
+    from raft_tpu.neighbors._ivf_scan import gather_query_rows
+    qg = gather_query_rows(q_rot, lay.padded_qmap())
+    qsub = qg - centers_rot[:, None, :]
+    # VMEM: the unpacked (ML, dim) bf16 tile + (ML, cap) scores dominate
+    lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim, 2)
+    cd, ci = _bq_scan_call(qsub, bits_i32, norms2, scales,
+                           lists_indices, lay.bins, lc, dim,
+                           pallas_interpret())
+    return lay.merge(cd, ci, probes, k, sqrt)
+
+
 def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
                     cd_ref, ci_ref, *, bins: int, metric: str, pq_dim: int,
                     pq_len: int, n_codes: int, lut_dtype,

@@ -293,6 +293,57 @@ class TestIvfListScanPallas:
         assert self._recall(i_b, i_r, k) >= 0.9
 
 
+class TestIvfBqScanPallas:
+    """In-VMEM unpack scan for the 1-bit tier (ops/pallas_ivf_scan.py
+    ``_bq_scan_kernel``; run under the interpreter here)."""
+
+    @pytest.fixture(scope="class")
+    def bq_index(self):
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.random import make_blobs
+        x, _ = make_blobs(n_samples=8000, n_features=64, centers=40,
+                          cluster_std=3.0, seed=0)
+        q, _ = make_blobs(n_samples=80, n_features=64, centers=40,
+                          cluster_std=3.0, seed=1)
+        x = jnp.asarray(np.asarray(x))
+        q = jnp.asarray(np.asarray(q))
+        idx = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=32,
+                                                 kmeans_n_iters=4))
+        return idx, x, q
+
+    def test_exact_bins_matches_xla_tier(self, bq_index, monkeypatch):
+        """With one row per bin both tiers' estimators are exact over
+        the probed lists, so the rescored top-k must agree."""
+        from raft_tpu.neighbors import ivf_bq
+        idx, x, q = bq_index
+        k, ml = 8, int(idx.lists_indices.shape[1])
+        sp = ivf_bq.SearchParams(n_probes=32, scan_bins=ml)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        d_p, i_p = ivf_bq.search(idx, q, k, sp)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "never")
+        d_x, i_x = ivf_bq.search(idx, q, k, sp)
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                                   rtol=1e-5)
+
+    def test_kernel_tier_recall_gate(self, bq_index, monkeypatch):
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        idx, x, q = bq_index
+        k = 8
+        # rescore_factor 16: recall is estimator-limited on this
+        # cluster_std=3.0 dataset (0.77 at 8, 0.88 at 16, flat in
+        # probes) — the wider exact re-rank is the recall lever
+        d, i = ivf_bq.search(idx, q, k,
+                             ivf_bq.SearchParams(n_probes=16,
+                                                 rescore_factor=16))
+        _, ie = brute_force_knn(x, q, k, mode="exact")
+        rec = np.mean([len(set(np.asarray(i)[r]) & set(np.asarray(ie)[r]))
+                       / k for r in range(q.shape[0])])
+        assert rec > 0.85, rec
+
+
 class TestIvfPqCodeScanPallas:
     """Code-resident IVF-PQ scan (ops/pallas_ivf_scan.py): u8 codes are
     the only persistent payload; decode tiles are transient."""
