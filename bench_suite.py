@@ -175,6 +175,13 @@ def _chained_search_time(search_fn, q_batches, reps, *operands):
     return _time(lambda: chain(q_batches, *operands), reps=2) / reps
 
 
+
+def _cached_cap(index, nq: int, n_probes: int) -> int:
+    """The probe cap the warm search measured and cached — keyed by the
+    active kernel tier (resolve_cap's cache key)."""
+    from raft_tpu.ops.dispatch import pallas_enabled
+    return index.cap_cache[(nq, n_probes, pallas_enabled())]
+
 def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
                    label=None):
     # cpp/bench/neighbors/knn/ivf_flat_*.cu — SEARCH scope (+BUILD:
@@ -204,7 +211,7 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
     rec = _ivf_recall(i_f, db, q, k)
     t = _time(lambda: ivf_flat.search(index, q, k, sp), reps=3)
     # chained marginal: pin the measured cap so nothing syncs in-jit
-    spp = dataclasses.replace(sp, probe_cap=index.cap_cache[(nq, n_probes)])
+    spp = dataclasses.replace(sp, probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
     qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
 
@@ -250,7 +257,7 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
     d_f, i_f = ivf_pq.search(index, q, k, sp)  # warm + measure cap
     rec = _ivf_recall(i_f, db, q, k)
     t = _time(lambda: ivf_pq.search(index, q, k, sp), reps=3)
-    spp = dataclasses.replace(sp, probe_cap=index.cap_cache[(nq, n_probes)])
+    spp = dataclasses.replace(sp, probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
     qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
 
@@ -312,7 +319,7 @@ def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=64,
     # cap pinned so nothing syncs inside the trace
     sp_est = ivf_bq.SearchParams(n_probes=n_probes,
                                  rescore_factor=sp.rescore_factor,
-                                 probe_cap=index.cap_cache[(nq, n_probes)])
+                                 probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
     qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
 

@@ -296,7 +296,10 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     pc = getattr(params, "probe_cap", 0)
     if pc > 0:
         return _round_cap(pc, queries.shape[0])
-    key = (queries.shape[0], n_probes)
+    # the tier is part of the key: a cap measured under one coarse
+    # selection program must not serve the other (a tie resolved
+    # differently could push a list past it — see below)
+    key = (queries.shape[0], n_probes, use_pallas)
     if pc == 0 and cache is not None and key in cache:
         return cache[key]
     # measure over the SAME coarse selection the serving search runs

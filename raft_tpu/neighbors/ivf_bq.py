@@ -227,20 +227,23 @@ def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
 
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
-                                             "cap"))
+                                             "cap", "gather"))
 def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
                             norms2, scales, ids, *, kk: int, bins: int,
-                            n_probes: int, cap: int):
+                            n_probes: int, cap: int,
+                            gather: str = "rows"):
     """Kernel-tier single-dispatch device phase: the in-VMEM unpack
     scan (``pallas_ivf_scan.ivf_bq_scan_pallas``) reads the 1-bit codes
     straight from HBM — 8× less scan bandwidth than the XLA tier's
-    materialized decode tiles."""
+    materialized decode tiles. ``gather`` is the RAFT_TPU_GATHER
+    strategy resolved OUTSIDE jit (the _ivf_scan contract)."""
     from raft_tpu.neighbors import _ivf_scan as S
     from raft_tpu.ops.pallas_ivf_scan import ivf_bq_scan_pallas
     probes = S.coarse_probes(queries, centers, n_probes, use_pallas=True)
     q_rot = queries @ rot.T
     return ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
-                              ids, probes, kk, cap, bins=bins)
+                              ids, probes, kk, cap, bins=bins,
+                              gather=gather)
 
 
 def _resolve(index: Index, queries, params: SearchParams,
@@ -252,6 +255,33 @@ def _resolve(index: Index, queries, params: SearchParams,
     return S.resolve_cap(index.cap_cache, queries, index.centers,
                          params, n_probes, index.n_lists,
                          use_pallas=use_pallas)
+
+
+def finish_search(d_est, ids, raw, q, k: int, sqrt: bool, rescore: bool
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Shared epilogue of the single-chip and distributed searches:
+    either slice the estimator top-k, or exactly re-rank the kk
+    survivors against the host-resident raw vectors (returned
+    distances are then exact squared-L2; sqrt per the metric)."""
+    if not rescore:
+        d_est, ids = d_est[:, :k], ids[:, :k]
+        if sqrt:
+            d_est = jnp.sqrt(jnp.maximum(d_est, 0.0))
+        return d_est, ids
+    ids_h = np.asarray(jax.device_get(ids))
+    qh = np.asarray(jax.device_get(q))
+    cand = raw[np.maximum(ids_h, 0)]                    # (nq, kk, d)
+    diff = cand - qh[:, None, :]
+    ex = np.einsum("qkd,qkd->qk", diff, diff)
+    ex = np.where(ids_h >= 0, ex, np.inf)
+    order = np.argsort(ex, axis=1)[:, :k]
+    d_out = np.take_along_axis(ex, order, axis=1)
+    i_out = np.take_along_axis(ids_h, order, axis=1)
+    i_out = np.where(np.isfinite(d_out), i_out, -1)
+    d_out = np.where(np.isfinite(d_out), d_out, np.inf)
+    if sqrt:
+        d_out = np.sqrt(np.maximum(d_out, 0.0))
+    return jnp.asarray(d_out), jnp.asarray(i_out)
 
 
 def search(index: Index, queries, k: int,
@@ -288,11 +318,12 @@ def search(index: Index, queries, k: int,
             max(1, (64 << 20) // max(1, max_list * index.dim * 2))))
     with trace.range("ivf_bq::search(%d, %d)", q.shape[0], n_probes):
         if use_pallas:
+            from raft_tpu.neighbors._ivf_scan import gather_mode
             d_est, ids = _fused_bq_search_pallas(
                 q, index.centers, index.centers_rot,
                 index.rotation_matrix, index.bits, index.norms2,
                 index.scales, index.lists_indices, kk=kk, bins=bins,
-                n_probes=n_probes, cap=cap)
+                n_probes=n_probes, cap=cap, gather=gather_mode())
         else:
             d_est, ids = _fused_bq_search(
                 q, index.centers, index.centers_rot,
@@ -300,23 +331,4 @@ def search(index: Index, queries, k: int,
                 index.scales, index.lists_indices, kk=kk, bins=bins,
                 n_probes=n_probes, cap=cap, chunk=chunk, dim=index.dim)
         sqrt = index.metric == DistanceType.L2SqrtExpanded
-        if not rescore:
-            d_est, ids = d_est[:, :k], ids[:, :k]
-            if sqrt:
-                d_est = jnp.sqrt(jnp.maximum(d_est, 0.0))
-            return d_est, ids
-        # host rescore: exact distances for the kk survivors
-        ids_h = np.asarray(jax.device_get(ids))
-        qh = np.asarray(jax.device_get(q))
-        cand = index.raw[np.maximum(ids_h, 0)]          # (nq, kk, d)
-        diff = cand - qh[:, None, :]
-        ex = np.einsum("qkd,qkd->qk", diff, diff)
-        ex = np.where(ids_h >= 0, ex, np.inf)
-        order = np.argsort(ex, axis=1)[:, :k]
-        d_out = np.take_along_axis(ex, order, axis=1)
-        i_out = np.take_along_axis(ids_h, order, axis=1)
-        i_out = np.where(np.isfinite(d_out), i_out, -1)
-        d_out = np.where(np.isfinite(d_out), d_out, np.inf)
-        if sqrt:
-            d_out = np.sqrt(np.maximum(d_out, 0.0))
-    return jnp.asarray(d_out), jnp.asarray(i_out)
+        return finish_search(d_est, ids, index.raw, q, k, sqrt, rescore)

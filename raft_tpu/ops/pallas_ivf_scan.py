@@ -370,7 +370,8 @@ def _bq_scan_call(qsub, bits_i32, norms2, scales, ids, bins: int,
 
 def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                        lists_indices, probes, k: int, cap: int,
-                       bins: int = 0, sqrt: bool = False):
+                       bins: int = 0, sqrt: bool = False,
+                       gather: str = ""):
     """Fused Pallas fine phase for ivf_bq: probe inversion + per-list
     query gather (rotated, center-offset) + the in-VMEM unpack scan +
     the shared candidate merge. Mirrors ``ivf_list_scan_pallas``."""
@@ -383,7 +384,7 @@ def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
     scales = lay.pad_lists(scales, max_list)
     lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
-    qg = gather_query_rows(q_rot, lay.padded_qmap())
+    qg = gather_query_rows(q_rot, lay.padded_qmap(), mode=gather)
     qsub = qg - centers_rot[:, None, :]
     # VMEM: the unpacked (ML, dim) bf16 tile + (ML, cap) scores dominate
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim, 2)

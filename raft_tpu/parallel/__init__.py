@@ -20,6 +20,8 @@ from raft_tpu.parallel.ivf import (
     distributed_ivf_flat_search_parts,
     distributed_ivf_pq_build,
     distributed_ivf_pq_search_parts,
+    distributed_ivf_bq_build,
+    distributed_ivf_bq_search_parts,
 )
 
 __all__ = [
@@ -31,4 +33,5 @@ __all__ = [
     "DistributedIvfFlat", "DistributedIvfPq",
     "distributed_ivf_flat_build", "distributed_ivf_flat_search_parts",
     "distributed_ivf_pq_build", "distributed_ivf_pq_search_parts",
+    "distributed_ivf_bq_build", "distributed_ivf_bq_search_parts",
 ]

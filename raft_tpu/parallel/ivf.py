@@ -663,3 +663,150 @@ def distributed_ivf_pq_search_parts(
                     dindex.parts_codes, dindex.parts_indices,
                     dindex.parts_norms, rep(q))
     return _postprocess(d, dindex.metric), i
+
+
+@dataclass
+class DistributedIvfBq:
+    """Row-sharded multi-part IVF-BQ index (the 1-bit tier of
+    ``neighbors/ivf_bq.py``, sharded like :class:`DistributedIvfFlat`).
+    ``raw`` optionally holds the FULL dataset host-side for exact
+    rescoring after the global estimator merge."""
+
+    centers: jax.Array        # (n_lists, dim) replicated
+    centers_rot: jax.Array    # (n_lists, dim) replicated
+    rotation_matrix: jax.Array
+    parts_bits: jax.Array     # (n_shards, n_lists, ml, w) uint32
+    parts_norms2: jax.Array   # (n_shards, n_lists, ml)
+    parts_scales: jax.Array   # (n_shards, n_lists, ml)
+    parts_indices: jax.Array  # (n_shards, n_lists, ml) global ids
+    metric: "DistanceType"
+    size: int
+    mesh: jax.sharding.Mesh
+    axis: str
+    raw: "object" = None      # host numpy (n, dim) f32 or None
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+
+def distributed_ivf_bq_build(
+    x, params=None, mesh: jax.sharding.Mesh = None, axis: str = "data",
+) -> DistributedIvfBq:
+    """Row-sharded IVF-BQ build: MNMG kmeans coarse phase, then each
+    shard sign-encodes and bucketizes its own rows — there is no
+    codebook, so beyond the coarse phase the build is one shard-local
+    jit (the binary tier's build-speed story survives sharding)."""
+    from raft_tpu.neighbors.ivf_bq import IndexParams, _pack_bits
+    from raft_tpu.neighbors.ivf_flat import _bucketize_static
+    from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+    from raft_tpu.parallel.kmeans import distributed_kmeans_fit
+    params = params or IndexParams()
+    expects(mesh is not None, "distributed build: mesh is required")
+    expects(params.metric in (DistanceType.L2Expanded,
+                              DistanceType.L2SqrtExpanded),
+            "distributed ivf_bq build: L2 metrics only (got %s)",
+            params.metric)
+    x = as_array(x).astype(jnp.float32)
+    n, dim = x.shape
+    n_lists = params.n_lists
+    expects(n_lists <= n, "distributed build: n_lists > n_samples")
+
+    centers, _, _ = distributed_kmeans_fit(
+        x, KMeansParams(n_clusters=n_lists,
+                        max_iter=params.kmeans_n_iters), mesh, axis)
+    rot = make_rotation_matrix(dim, dim, force_random=True)
+
+    xs, ids_s = _shard_rows(x, mesh, axis)
+    labels_s, ml, c_rep = _label_and_agree_width(
+        xs, ids_s, centers, mesh, axis, n_lists, "l2")
+    rot_rep = jax.device_put(rot, NamedSharding(mesh, P()))
+    w = -(-dim // 32)
+
+    def encode_local(x_loc, lbl_loc, ids_loc, c, rt):
+        lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
+        safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
+        r = (x_loc - c[lbl]) @ rt.T
+        payload = jnp.concatenate(
+            [lax.bitcast_convert_type(_pack_bits(r), jnp.float32),
+             jnp.sum(r * r, axis=1)[:, None],
+             jnp.mean(jnp.abs(r), axis=1)[:, None]], axis=1)
+        data, idx, _, _ = _bucketize_static(payload, lbl, safe_ids,
+                                            n_lists, ml)
+        return data[None], idx[None]
+
+    enc = jax.jit(jax.shard_map(
+        encode_local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis), P(), P()),
+        out_specs=(P(axis, None, None, None), P(axis, None, None))))
+    payload, pidx = enc(xs, labels_s, ids_s, c_rep, rot_rep)
+    bits = lax.bitcast_convert_type(payload[..., :w], jnp.uint32)
+    raw = None
+    if params.keep_raw:
+        import numpy as _np
+        raw = _np.asarray(jax.device_get(x))
+    return DistributedIvfBq(
+        centers=centers, centers_rot=centers @ rot.T,
+        rotation_matrix=rot, parts_bits=bits,
+        parts_norms2=payload[..., w], parts_scales=payload[..., w + 1],
+        parts_indices=pidx, metric=params.metric, size=n, mesh=mesh,
+        axis=axis, raw=raw)
+
+
+def distributed_ivf_bq_search_parts(
+    dindex: DistributedIvfBq, queries, k: int, params=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search the row-sharded binary index: every shard scans its
+    partial probed lists with the 1-bit estimator, the per-shard
+    candidates merge over the comm axis, and (when raw vectors exist)
+    the merged survivors are exactly re-ranked host-side."""
+    from raft_tpu.neighbors.ivf_bq import SearchParams, _unpack_pm1
+    from raft_tpu.neighbors.ivf_flat import _coarse_scores
+    params = params or SearchParams()
+    mesh, axis = dindex.mesh, dindex.axis
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == dindex.dim, "distributed search: dim mismatch")
+    n_probes = min(params.n_probes, dindex.n_lists)
+    rescore = params.rescore_factor > 0 and dindex.raw is not None
+    kk = max(params.rescore_factor, 1) * k
+    sqrt = dindex.metric == DistanceType.L2SqrtExpanded
+    dim = dindex.dim
+    comms = build_comms(mesh, axis)
+
+    def local(centers, centers_rot, rot, pbits, pn2, psc, pidx, q_rep):
+        coarse = _coarse_scores(q_rep, centers, "l2")
+        _, probes = lax.top_k(-coarse, n_probes)
+        q_rot = q_rep @ rot.T
+
+        def get_probe(p):
+            list_id = probes[:, p]                       # (nq,)
+            pm1 = _unpack_pm1(pbits[0][list_id], dim)    # (nq, ml, d)
+            ql = q_rot - centers_rot[list_id]            # (nq, d)
+            ip = jnp.einsum("qld,qd->ql", pm1,
+                            ql.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+            qq = jnp.sum(ql * ql, axis=1)[:, None]
+            est = qq + pn2[0][list_id] - 2.0 * psc[0][list_id] * ip
+            ids = pidx[0][list_id]
+            return jnp.where(ids >= 0, est, jnp.inf), ids
+
+        d, i = _fine_scan(q_rep, get_probe, kk, n_probes, axis)
+        return _global_merge(comms, axis, d, i, kk)
+
+    shmapped = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis, None, None, None),
+                  P(axis, None, None), P(axis, None, None),
+                  P(axis, None, None), P()),
+        out_specs=(P(), P())))
+    rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
+    d_est, ids = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
+                          rep(dindex.rotation_matrix), dindex.parts_bits,
+                          dindex.parts_norms2, dindex.parts_scales,
+                          dindex.parts_indices, rep(q))
+    from raft_tpu.neighbors.ivf_bq import finish_search
+    return finish_search(d_est, ids, dindex.raw, q, k, sqrt, rescore)

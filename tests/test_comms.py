@@ -775,6 +775,62 @@ class TestDistributedIvfBuild:
         rec = np.mean([len(set(i[r]) & set(ie[r])) / k for r in range(32)])
         assert rec >= 0.5, rec  # PQ-quantized exhaustive probe
 
+    def test_bq_build_search_parts(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        from raft_tpu.distance.distance_types import DistanceType
+        from raft_tpu.parallel import (distributed_ivf_bq_build,
+                                       distributed_ivf_bq_search_parts)
+        key = jax.random.key(3)
+        db = jax.random.normal(key, (2048, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (32, 32))
+        k = 10
+        mesh = self._mesh()
+        didx = distributed_ivf_bq_build(
+            db, ivf_bq.IndexParams(n_lists=16, kmeans_n_iters=3),
+            mesh, axis="data")
+        assert didx.parts_bits.dtype == jnp.uint32
+        assert didx.parts_bits.shape[0] == 8
+        # every dataset row appears exactly once across all parts
+        ids = np.asarray(didx.parts_indices)
+        assert sorted(ids[ids >= 0].tolist()) == list(range(2048))
+        # exhaustive probe + exact host rescore: the returned ids are
+        # the true neighbors of the estimator's kk survivors
+        d, i = distributed_ivf_bq_search_parts(
+            didx, q, k, ivf_bq.SearchParams(n_probes=16,
+                                            rescore_factor=16))
+        de, ie = brute_force_knn(db, q, k, DistanceType.L2Expanded)
+        ie, i = np.asarray(ie), np.asarray(i)
+        rec = np.mean([len(set(i[r]) & set(ie[r])) / k for r in range(32)])
+        assert rec >= 0.6, rec  # 1-bit estimator at d=32, rescored
+        # rescored distances are exact for the returned ids
+        dbn, qn = np.asarray(db), np.asarray(q)
+        want = np.sum((dbn[np.asarray(i)] - qn[:, None, :]) ** 2, axis=2)
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_bq_estimator_only_no_raw(self):
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.parallel import (distributed_ivf_bq_build,
+                                       distributed_ivf_bq_search_parts)
+        key = jax.random.key(4)
+        db = jax.random.normal(key, (1024, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (16, 32))
+        mesh = self._mesh()
+        didx = distributed_ivf_bq_build(
+            db, ivf_bq.IndexParams(n_lists=8, kmeans_n_iters=2,
+                                   keep_raw=False),
+            mesh, axis="data")
+        assert didx.raw is None
+        d, i = distributed_ivf_bq_search_parts(
+            didx, q, 5, ivf_bq.SearchParams(n_probes=8))
+        assert d.shape == (16, 5) and i.shape == (16, 5)
+        assert (np.asarray(i) >= 0).all()
+
 
 class TestSplitCommGroupedLowering:
     """VERDICT round-1 item 7: split-communicator collectives must lower

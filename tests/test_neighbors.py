@@ -193,10 +193,13 @@ class TestProbeCapPolicy:
             x, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=8))
         sp = ivf_flat.SearchParams(n_probes=8, scan_order="list")
         d1, i1 = ivf_flat.search(index, q, 10, sp)
-        assert (len(q), 8) in index.cap_cache
-        cap = index.cap_cache[(len(q), 8)]
+        # cache key carries the active kernel tier (False on the CPU
+        # mesh): a cap measured under one coarse-selection program must
+        # not serve the other
+        assert (len(q), 8, False) in index.cap_cache
+        cap = index.cap_cache[(len(q), 8, False)]
         d2, i2 = ivf_flat.search(index, q, 10, sp)  # cache hit
-        assert index.cap_cache[(len(q), 8)] == cap
+        assert index.cap_cache[(len(q), 8, False)] == cap
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
     def test_remeasure_matches_cached(self, dataset):
@@ -274,7 +277,7 @@ class TestProbeCapPolicy:
             x, ivf_pq.IndexParams(n_lists=32, kmeans_n_iters=8))
         d, i = ivf_pq.search(index, q, 10,
                              ivf_pq.SearchParams(n_probes=8))
-        assert (len(q), 8) in index.cap_cache
+        assert (len(q), 8, False) in index.cap_cache
 
 
 class TestIvfPq:
