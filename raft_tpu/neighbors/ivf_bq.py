@@ -30,10 +30,12 @@ with EXACT f32 distances against the raw vectors kept host-side (the
 `host_memory` role: device holds bits, host holds truth), so returned
 distances are exact and recall approaches the probe ceiling.
 
-XLA-tier formulation only (chunked decode tiles + einsum): one jitted
-dispatch for the device phase, no bespoke Mosaic kernel — deliberate,
-given the 2026-08-01 remote-compiler incidents; a Pallas in-VMEM
-unpack tier is the follow-up once the bisect ladder clears it.
+Two device tiers, routed by ``ops.dispatch``: the XLA formulation
+(chunked decode tiles + einsum) and the Pallas kernel
+(``pallas_ivf_scan._bq_scan_kernel``) that unpacks the bits INSIDE
+VMEM — the scan then reads 1 bit/dim from HBM instead of 16, the
+binary tier's bandwidth headline. Either way the device phase is one
+jitted dispatch.
 """
 
 from __future__ import annotations
@@ -224,6 +226,70 @@ def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
     cand_i = cand_i.reshape(n_lists, cap, -1)
     return S.merge_candidates(cand_d, cand_i, probes, inv_pos, kk,
                               sqrt=False, cap=cap)
+
+
+def extend(index: Index, new_vectors, new_indices=None, res=None
+           ) -> Index:
+    """Add vectors to an existing index (the ivf_flat/ivf_pq extend
+    contract, reference ``ivf_pq_build.cuh:605``): label against the
+    FROZEN centers, sign-encode with the frozen rotation, re-bucketize
+    the combined per-row payloads. Per-row payloads are immutable under
+    fixed centers+rotation, so old rows are moved, never re-encoded."""
+    x = as_array(new_vectors).astype(jnp.float32)
+    expects(x.ndim == 2 and x.shape[1] == index.dim,
+            "ivf_bq.extend: dim mismatch")
+    n_new = x.shape[0]
+    new_ids = (jnp.arange(index.size, index.size + n_new,
+                          dtype=jnp.int32)
+               if new_indices is None
+               else as_array(new_indices).astype(jnp.int32))
+    expects(new_ids.shape == (n_new,), "ivf_bq.extend: bad new_indices")
+    expects(bool((new_ids >= 0).all()),
+            "ivf_bq.extend: new_indices must be non-negative")
+    # the host rescore indexes `raw` BY global id — custom ids would
+    # misalign it; estimator-only (keep_raw=False) indexes are free to
+    # use any id scheme
+    expects(index.raw is None or new_indices is None,
+            "ivf_bq.extend: custom new_indices are only supported on "
+            "keep_raw=False indexes (raw rescore rows are id-indexed)")
+
+    n_lists, ml, w = index.bits.shape
+    # flat view of current contents; a slot's list id is its label
+    valid = (index.lists_indices >= 0).reshape(-1)
+    old_labels = jnp.broadcast_to(
+        jnp.arange(n_lists, dtype=jnp.int32)[:, None],
+        (n_lists, ml)).reshape(-1)[valid]
+    old_payload = jnp.concatenate(
+        [lax.bitcast_convert_type(index.bits, jnp.float32)
+         .reshape(-1, w)[valid],
+         index.norms2.reshape(-1)[valid][:, None],
+         index.scales.reshape(-1)[valid][:, None]], axis=1)
+    old_ids = index.lists_indices.reshape(-1)[valid]
+
+    new_labels = kmeans_balanced.predict(x, index.centers, res=res)
+    r = (x - index.centers[new_labels]) @ index.rotation_matrix.T
+    new_payload = jnp.concatenate(
+        [lax.bitcast_convert_type(_pack_bits(r), jnp.float32),
+         jnp.sum(r * r, axis=1)[:, None],
+         jnp.mean(jnp.abs(r), axis=1)[:, None]], axis=1)
+
+    from raft_tpu.neighbors.ivf_flat import _bucketize
+    payload = jnp.concatenate([old_payload, new_payload], axis=0)
+    labels = jnp.concatenate([old_labels, new_labels])
+    ids = jnp.concatenate([old_ids, new_ids])
+    bucketed, idx, _, counts = _bucketize(payload, labels, n_lists,
+                                          row_ids=ids)
+    raw = None
+    if index.raw is not None:
+        raw = np.concatenate([index.raw,
+                              np.asarray(jax.device_get(x))], axis=0)
+    return Index(
+        centers=index.centers, centers_rot=index.centers_rot,
+        rotation_matrix=index.rotation_matrix,
+        bits=lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32),
+        norms2=bucketed[:, :, w], scales=bucketed[:, :, w + 1],
+        lists_indices=idx, list_sizes=counts, metric=index.metric,
+        size=index.size + n_new, raw=raw)
 
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",

@@ -165,18 +165,22 @@ def _bucketize_static(x, labels, row_ids, n_lists: int, max_list: int,
     return data, idx, norms, counts
 
 
-def _bucketize(x, labels, n_lists: int, round_to: int = 8):
+def _bucketize(x, labels, n_lists: int, round_to: int = 8,
+               row_ids=None):
     """Scatter rows into padded per-list buckets — static-shape layout.
     The bucket width is sized from the observed max count (one host
-    sync); sharded builds pre-agree a width and call the static core."""
+    sync); sharded builds pre-agree a width and call the static core.
+    ``row_ids`` defaults to 0..n-1 (fresh builds); extends pass the
+    combined global ids."""
     n = x.shape[0]
     counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
                                  num_segments=n_lists)
     max_list = int(jax.device_get(jnp.max(counts)))
     max_list = max(round_to, (max_list + round_to - 1) // round_to * round_to)
+    if row_ids is None:
+        row_ids = jnp.arange(n, dtype=jnp.int32)
     data, idx, norms, counts = _bucketize_static(
-        x, labels, jnp.arange(n, dtype=jnp.int32), n_lists, max_list,
-        counts=counts)
+        x, labels, row_ids, n_lists, max_list, counts=counts)
     return data, idx, norms, counts
 
 

@@ -394,6 +394,26 @@ class TestIvfBq:
         assert index.words == 1
         assert int(jnp.sum(index.list_sizes)) == len(x)
 
+    def test_extend(self, dataset):
+        x, q = dataset
+        index = ivf_bq.build(x[:3000], ivf_bq.IndexParams(
+            n_lists=16, kmeans_n_iters=5))
+        index = ivf_bq.extend(index, x[3000:])
+        assert index.size == len(x)
+        assert index.raw.shape == (len(x), x.shape[1])
+        d, i = ivf_bq.search(index, q, 10,
+                             ivf_bq.SearchParams(n_probes=16,
+                                                 rescore_factor=16))
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        _, iref = nn.kneighbors(q)
+        assert recall(np.asarray(i), iref) > 0.8
+        # extended rows are findable: search for them directly
+        qe = np.asarray(x)[3500:3520]
+        _, ie2 = ivf_bq.search(index, qe, 1,
+                               ivf_bq.SearchParams(n_probes=16))
+        assert (np.asarray(ie2).ravel() == np.arange(3500, 3520)).mean() \
+            > 0.9
+
     def test_serialize_roundtrip(self, tmp_path, dataset):
         from raft_tpu.neighbors import serialize
         x, q = dataset
