@@ -7,7 +7,8 @@ define the build/search structure mirrored here), following the
 sign-random-rotation binary-quantization pattern of the IVF-RaBitQ
 line of work (PAPERS.md). Why it earns its place on TPU:
 
-* **Memory**: d/8 bits + 8 B per vector — 100M×128 ≈ **2.4 GB**, so
+* **Memory**: d/8 code bytes + 12 B stats + 4 B id per vector —
+  100M×128 ≈ **2.8 GB**, so
   the BASELINE.md north-star dataset fits a single v5e chip's HBM with
   room to spare (f32 IVF-Flat needs 51 GB, IVF-PQ codes ≈ 3.2 GB).
 * **Build speed**: NO codebook training — beyond the shared coarse

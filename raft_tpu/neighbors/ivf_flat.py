@@ -299,9 +299,8 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     all_data = jnp.concatenate([old_data, x_new], axis=0)
     all_ids = jnp.concatenate([old_ids, new_ids])
     labels = kmeans_balanced.predict(all_data, index.centers, res=res)
-    data, idx, norms, counts = _bucketize(all_data, labels, n_lists)
-    # idx holds row positions into all_data; translate to user ids
-    idx = jnp.where(idx >= 0, all_ids[jnp.clip(idx, 0, all_ids.shape[0] - 1)], -1)
+    data, idx, norms, counts = _bucketize(all_data, labels, n_lists,
+                                          row_ids=all_ids)
     data, norms, scale = _quantize_lists(data, norms, storage)
     return Index(centers=index.centers, lists_data=data, lists_indices=idx,
                  lists_norms=norms, list_sizes=counts, metric=index.metric,

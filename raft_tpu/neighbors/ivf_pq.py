@@ -455,11 +455,9 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     all_labels = jnp.concatenate([old_list[valid], labels], axis=0)
     all_ids = jnp.concatenate([flat_ids[valid], new_ids], axis=0)
 
-    bucketed, slot_idx, _, counts = _bucketize(
-        all_codes.astype(jnp.float32), all_labels, n_lists)
-    # _bucketize stores row positions; map back to the caller ids
-    idx = jnp.where(slot_idx >= 0, all_ids[jnp.clip(slot_idx, 0, None)],
-                    jnp.int32(-1))
+    bucketed, idx, _, counts = _bucketize(
+        all_codes.astype(jnp.float32), all_labels, n_lists,
+        row_ids=all_ids)
     codes_b = bucketed.astype(jnp.uint8)
     norms_fn = (_code_norms_per_cluster
                 if index.codebook_kind == CodebookGen.PER_CLUSTER

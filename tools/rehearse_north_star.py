@@ -11,7 +11,10 @@ the v5e-64 run is credible. This script:
   2. searches it (per-shard scan + cross-shard merge) and checks
      recall against an exact scan on a query subset,
   3. builds + searches a host-memory-resident index on a slice
-     (the reference's host-transfer strategies axis, knn.cuh:380-389).
+     (the reference's host-transfer strategies axis, knn.cuh:380-389),
+  4. builds + searches the 1-bit tier (neighbors/ivf_bq.py) sharded on
+     the mesh — the tier whose codes put 100M×128 in ~2.4 GB of HBM on
+     ONE chip; at full scale this leg runs unsharded.
 
 Dims/lists are sized for a single-core CPU host (the CI/driver box);
 on a real v5e-64 the same code runs with dim=128, n_lists=16k+, the
@@ -108,6 +111,34 @@ def main(n_rows: int = 10_000_000) -> None:
     print(f"[rehearsal] host-resident {n_host} rows: build {t_hbuild:.1f}s "
           f"search {t_hsearch:.1f}s", flush=True)
     assert np.asarray(hi).shape == (256, k)
+
+    # 4) the 1-bit tier, sharded (distributed build + estimator search
+    #    + exact host rescore); report the code footprint that makes
+    #    the single-chip 100M story
+    from raft_tpu.neighbors import ivf_bq
+    from raft_tpu.parallel.ivf import (distributed_ivf_bq_build,
+                                       distributed_ivf_bq_search_parts)
+    t0 = time.perf_counter()
+    bidx = distributed_ivf_bq_build(
+        x, ivf_bq.IndexParams(n_lists=n_lists, kmeans_n_iters=2),
+        mesh, axis="data")
+    jax.block_until_ready(bidx.parts_bits)
+    t_bq_build = time.perf_counter() - t0
+    code_gb = sum(a.size * a.dtype.itemsize for a in
+                  (bidx.parts_bits, bidx.parts_norms2,
+                   bidx.parts_scales, bidx.parts_indices)) / 1e9
+    t0 = time.perf_counter()
+    bd, bi = distributed_ivf_bq_search_parts(
+        bidx, q, k, ivf_bq.SearchParams(n_probes=n_probes,
+                                        rescore_factor=8))
+    t_bq_search = time.perf_counter() - t0
+    got_b = np.asarray(bi[:nq_check])
+    rec_b = np.mean([len(set(got_b[r]) & set(want[r])) / k
+                     for r in range(nq_check)])
+    print(f"[rehearsal] ivf_bq sharded: build {t_bq_build:.1f}s "
+          f"search {t_bq_search:.1f}s recall@{k}={rec_b:.3f} "
+          f"(codes+stats {code_gb:.2f} GB for {n_rows} rows)", flush=True)
+    assert rec_b >= n_probes / n_lists, (rec_b, n_probes / n_lists)
 
     print("[rehearsal] OK", flush=True)
 
