@@ -384,6 +384,27 @@ class TestIvfBq:
         assert (recall(np.asarray(i_rs), iref)
                 >= recall(np.asarray(i_est), iref))
 
+    def test_sqrt_metric(self, dataset):
+        x, q = dataset
+        index = ivf_bq.build(x, ivf_bq.IndexParams(
+            n_lists=16, kmeans_n_iters=4,
+            metric=DistanceType.L2SqrtExpanded))
+        d, i = ivf_bq.search(index, q, 5,
+                             ivf_bq.SearchParams(n_probes=16))
+        # rescored distances are exact EUCLIDEAN (sqrt) distances
+        x_np, q_np = np.asarray(x), np.asarray(q)
+        want = np.sqrt(np.sum(
+            (x_np[np.asarray(i)] - q_np[:, None, :]) ** 2, axis=2))
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-4,
+                                   atol=1e-4)
+        # estimator-only path applies sqrt too (no negative under root)
+        import dataclasses
+        idx2 = dataclasses.replace(index, raw=None)
+        d2, _ = ivf_bq.search(idx2, q, 5,
+                              ivf_bq.SearchParams(n_probes=16))
+        assert bool(np.isfinite(np.asarray(d2)).all())
+        assert bool((np.asarray(d2) >= 0).all())
+
     def test_memory_footprint(self, dataset):
         x, _ = dataset
         index = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=16,
