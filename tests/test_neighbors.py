@@ -427,7 +427,7 @@ class TestIvfBq:
                                                  rescore_factor=16))
         nn = NearestNeighbors(n_neighbors=10).fit(x)
         _, iref = nn.kneighbors(q)
-        assert recall(np.asarray(i), iref) > 0.8
+        assert recall(np.asarray(i), iref) > 0.85  # measured 0.903
         # extended rows are findable: search for them directly
         qe = np.asarray(x)[3500:3520]
         _, ie2 = ivf_bq.search(index, qe, 1,
