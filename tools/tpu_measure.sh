@@ -26,32 +26,31 @@ probe() {
 probe start
 
 echo "== 0. compile bisect ladder (names the program that kills the"
-echo "==    remote compiler, if any; small rung then full rung)."
-echo "==    lc=1 first: grid-per-list is the ~8x-smaller Mosaic program"
-echo "==    (the auto lc-unrolled variant is the prime crash suspect)"
+echo "==    remote compiler, if any). QPS-FIRST ORDER: the full-rung"
+echo "==    chained marginals ARE the headline IVF numbers, so the two"
+echo "==    windows the tunnel has granted so far would each have"
+echo "==    produced them before anything optional. lc=1 grid-per-list"
+echo "==    is the ~8x-smaller Mosaic program (the lc-unrolled variant"
+echo "==    is the prime crash suspect); auto-lc and XLA-tier runs"
+echo "==    follow once the numbers are banked."
 RUNG=small RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_small_lc1.log"
-probe bisect-small-auto
-RUNG=small python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_small.log"
-probe bisect-small-xla
-# XLA-tier rung: isolates Mosaic-vs-XLA if a kernel rung kills the
-# compiler, and gives the inverted_scan fallback a QPS data point
-RUNG=small RAFT_TPU_PALLAS=never python tools/ivf_compile_bisect.py 2>&1 \
-  | tee "$OUT/bisect_small_xla.log"
 probe bisect-full-lc1
 RUNG=full RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_full_lc1.log"
-probe bisect-full-auto
-RUNG=full python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_full.log"
-
 probe bisect-pq
-echo "== 0b. PQ bisect ladder (the pq kernel's pq_dim-unrolled decode"
-echo "==     loop is its own compile-size hazard)"
 RUNG=small FAMILY=pq python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_pq_small.log"
 probe bisect-pq-full
 RUNG=full FAMILY=pq python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_pq_full.log"
+probe bisect-full-auto
+RUNG=full python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_full.log"
+probe bisect-small-xla
+# XLA-tier rung: isolates Mosaic-vs-XLA if a kernel rung kills the
+# compiler, and gives the inverted_scan fallback a QPS data point
+RUNG=small RAFT_TPU_PALLAS=never python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_small_xla.log"
 
 probe 1
 echo "== 1. fused IVF-Flat operating-point A/B (brute baseline + sweep)"
