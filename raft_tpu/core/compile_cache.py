@@ -58,7 +58,26 @@ def enable(path: str | None = None) -> bool:
         # (machine-feature mismatch warnings, SIGILL risk). Callers
         # setting a platform must do so before enable().
         plat = getattr(jax.config, "jax_platforms", None) or "default"
-        path = os.path.join(base, str(plat).replace(",", "_"))
+        plat = str(plat).replace(",", "_")
+        if "cpu" in plat or plat == "default":
+            # CPU executables are AOT-compiled for THIS host's ISA; the
+            # repo (and its cache dir) persists across driver VMs with
+            # different CPU features, and loading a foreign entry risks
+            # SIGILL (cpu_aot_loader machine-feature warnings, observed
+            # 2026-08-01). Scope cpu entries by an ISA fingerprint;
+            # "default" may resolve to cpu, so it is fingerprinted too
+            # (accelerator entries are remote-compiled and lose nothing).
+            import hashlib
+            try:
+                with open("/proc/cpuinfo") as f:
+                    # x86 "flags", aarch64 "Features"
+                    flags = next((ln for ln in f
+                                  if ln.startswith(("flags", "Features"))),
+                                 "")
+            except OSError:
+                flags = ""
+            plat += "-" + hashlib.md5(flags.encode()).hexdigest()[:10]
+        path = os.path.join(base, plat)
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

@@ -33,12 +33,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+RUNG = os.environ.get("RUNG", "small")
+if RUNG == "smoke":  # CPU harness check (run without /root/.axon_site)
+    # platform BEFORE the cache: the cache dir is platform-scoped
+    jax.config.update("jax_platforms", "cpu")
+
 from raft_tpu.core.compile_cache import enable as _enable_cache
 _enable_cache()
 
-RUNG = os.environ.get("RUNG", "small")
-if RUNG == "smoke":  # CPU harness check (run without /root/.axon_site)
-    jax.config.update("jax_platforms", "cpu")
+if RUNG == "smoke":
     N, D, NLISTS, NPROBES, NQ, K = 2_000, 32, 16, 4, 64, 8
 elif RUNG == "small":
     N, D, NLISTS, NPROBES, NQ, K = 50_000, 128, 256, 16, 256, 32

@@ -9,10 +9,16 @@ Pallas fused_l2_nn shapes (~20-40 s each through the axon tunnel) and
 is timed cold (first call = compile + run) and warm (second call).
 
 Run: PYTHONPATH=.:/root/.axon_site python tools/profile_ivf_build.py
+Env: PROFILE_PLATFORM=cpu + PROFILE_N/PROFILE_NLISTS for a harness
+smoke at toy shapes (the campaign pre-flight).
 """
+import os
 import time
 
 import jax
+
+if os.environ.get("PROFILE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROFILE_PLATFORM"])
 import jax.numpy as jnp
 
 from raft_tpu.core.compile_cache import enable as _enable_cache
@@ -23,7 +29,9 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.neighbors import ivf_flat, ivf_pq
 
 key = jax.random.key(0)
-n, d, nlists = 500_000, 128, 1024
+n = int(os.environ.get("PROFILE_N", 500_000))
+nlists = int(os.environ.get("PROFILE_NLISTS", 1024))
+d = 128
 db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
 jax.block_until_ready(db)
 
@@ -47,7 +55,8 @@ def stage(name, fn):
 # remote-compile service)
 from raft_tpu.util.host_sample import sample_rows
 trainset = stage("subsample",
-                 lambda: db[sample_rows(n, max(nlists, n // 2), 0)])
+                 lambda: db[sample_rows(n, min(n, max(nlists, n // 2)),
+                                        0)])
 
 # stage 2: balanced EM on the trainset (the hierarchical trainer's flat
 # path at n_lists ≤ 16384)
