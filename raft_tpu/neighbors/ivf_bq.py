@@ -137,15 +137,25 @@ def _unpack_pm1(words, d: int, dtype=jnp.bfloat16) -> jax.Array:
     return (2.0 * flat.astype(dtype) - 1.0).astype(dtype)
 
 
+_SUPPORTED_METRICS = (DistanceType.L2Expanded,
+                      DistanceType.L2SqrtExpanded,
+                      DistanceType.InnerProduct,
+                      DistanceType.CosineExpanded)
+
+
 def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
     """Coarse k-means + sign-encode residuals (no codebook training —
-    the build-speed headline of the binary tier)."""
+    the build-speed headline of the binary tier). Cosine datasets are
+    row-normalized at build (the ivf_flat/processing.cuh convention) so
+    the ip scoring core applies; ``raw`` stores the normalized rows."""
     x = as_array(dataset).astype(jnp.float32)
     n, d = x.shape
     expects(params.n_lists <= n, "ivf_bq.build: n_lists > n_samples")
-    expects(params.metric in (DistanceType.L2Expanded,
-                              DistanceType.L2SqrtExpanded),
-            "ivf_bq: L2 metrics only (got %s)", params.metric)
+    expects(params.metric in _SUPPORTED_METRICS,
+            "ivf_bq: unsupported metric %s", params.metric)
+    if params.metric == DistanceType.CosineExpanded:
+        x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True),
+                            1e-30)
     with trace.range("ivf_bq::build(%d, %d)", n, params.n_lists):
         n_train = max(params.n_lists,
                       int(n * params.kmeans_trainset_fraction))
@@ -188,19 +198,22 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
 
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
-                                             "cap", "chunk", "dim"))
+                                             "cap", "chunk", "dim",
+                                             "kind"))
 def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
                      scales, ids, *, kk: int, bins: int, n_probes: int,
-                     cap: int, chunk: int, dim: int):
+                     cap: int, chunk: int, dim: int, kind: str = "l2"):
     """Single-dispatch device phase: coarse GEMM + top-k probes, query
     rotation, probe inversion, chunked decode-tile estimator scan,
     candidate merge. Returns (est dists (nq, kk), global ids (nq, kk))
-    — estimator ordering, squared-L2 scale."""
+    — estimator ordering, smaller-is-better (squared-L2 for the l2
+    core; NEGATED similarity ``−(q·c_l + s·⟨q_rot, sign(r_rot)⟩)``
+    for ip — the x = c_l + r decomposition of q·x)."""
     from raft_tpu.neighbors import _ivf_scan as S
     nq = queries.shape[0]
     n_lists, max_list = ids.shape
-    probes = S.coarse_probes(queries, centers, n_probes)
-    q_rot = queries @ rot.T      # orthogonal: L2 geometry unchanged
+    probes = S.coarse_probes(queries, centers, n_probes, kind=kind)
+    q_rot = queries @ rot.T      # orthogonal: geometry unchanged
     qmap, inv_pos = S._invert_probes(probes, n_lists, cap)
 
     n_chunks = n_lists // chunk
@@ -213,13 +226,25 @@ def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
 
     def one_chunk(args):
         qm, bw, n2, sc, lid, cl = args
-        qsub = q_rot[jnp.clip(qm, 0, nq - 1)] - cl[:, None, :]
+        qg = q_rot[jnp.clip(qm, 0, nq - 1)]           # (chunk, cap, d)
         pm1 = _unpack_pm1(bw, dim)                    # (chunk, ML, d) ±1
-        ip = jnp.einsum("gcd,gld->gcl", qsub.astype(jnp.bfloat16), pm1,
-                        preferred_element_type=jnp.float32)
-        qq = jnp.sum(qsub * qsub, axis=2)             # (chunk, cap)
-        est = (qq[:, :, None] + n2[:, None, :]
-               - 2.0 * sc[:, None, :] * ip)           # (chunk, cap, ML)
+        if kind == "ip":
+            from raft_tpu.core.precision import matmul_precision
+            ip = jnp.einsum("gcd,gld->gcl", qg.astype(jnp.bfloat16),
+                            pm1, preferred_element_type=jnp.float32)
+            # q·c_l dominates the estimator: full precision, like the
+            # Pallas tier's post-scan correction
+            corr = jnp.einsum("gcd,gd->gc", qg, cl,
+                              precision=matmul_precision(),
+                              preferred_element_type=jnp.float32)
+            est = -(corr[:, :, None] + sc[:, None, :] * ip)
+        else:
+            qsub = qg - cl[:, None, :]
+            ip = jnp.einsum("gcd,gld->gcl", qsub.astype(jnp.bfloat16),
+                            pm1, preferred_element_type=jnp.float32)
+            qq = jnp.sum(qsub * qsub, axis=2)         # (chunk, cap)
+            est = (qq[:, :, None] + n2[:, None, :]
+                   - 2.0 * sc[:, None, :] * ip)       # (chunk, cap, ML)
         est = jnp.where(lid[:, None, :] >= 0, est, jnp.inf)
         return S.binned_partial_topk(est, lid, bins)
 
@@ -241,6 +266,11 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
     x = as_array(new_vectors).astype(jnp.float32)
     expects(x.ndim == 2 and x.shape[1] == index.dim,
             "ivf_bq.extend: dim mismatch")
+    if index.metric == DistanceType.CosineExpanded:
+        # build() stores normalized rows; extended rows must match or
+        # the ip core scores raw dot products (ivf_flat.extend ditto)
+        x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True),
+                            1e-30)
     n_new = x.shape[0]
     new_ids = (jnp.arange(index.size, index.size + n_new,
                           dtype=jnp.int32)
@@ -296,11 +326,11 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
 
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
-                                             "cap", "gather"))
+                                             "cap", "gather", "kind"))
 def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
                             norms2, scales, ids, *, kk: int, bins: int,
                             n_probes: int, cap: int,
-                            gather: str = "rows"):
+                            gather: str = "rows", kind: str = "l2"):
     """Kernel-tier single-dispatch device phase: the in-VMEM unpack
     scan (``pallas_ivf_scan.ivf_bq_scan_pallas``) reads the 1-bit codes
     straight from HBM — 8× less scan bandwidth than the XLA tier's
@@ -308,40 +338,51 @@ def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
     strategy resolved OUTSIDE jit (the _ivf_scan contract)."""
     from raft_tpu.neighbors import _ivf_scan as S
     from raft_tpu.ops.pallas_ivf_scan import ivf_bq_scan_pallas
-    probes = S.coarse_probes(queries, centers, n_probes, use_pallas=True)
+    probes = S.coarse_probes(queries, centers, n_probes, kind=kind,
+                             use_pallas=True)
     q_rot = queries @ rot.T
     return ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                               ids, probes, kk, cap, bins=bins,
-                              gather=gather)
+                              gather=gather, metric=kind)
 
 
 def _resolve(index: Index, queries, params: SearchParams,
-             n_probes: int, use_pallas: bool) -> int:
+             n_probes: int, use_pallas: bool, kind: str = "l2") -> int:
     from raft_tpu.neighbors import _ivf_scan as S
-    # use_pallas must match the serving path's coarse selection — a tie
-    # resolved differently could push a list past the measured cap and
-    # silently shed probes (resolve_cap docstring)
+    # use_pallas/kind must match the serving path's coarse selection —
+    # a tie resolved differently could push a list past the measured
+    # cap and silently shed probes (resolve_cap docstring)
     return S.resolve_cap(index.cap_cache, queries, index.centers,
-                         params, n_probes, index.n_lists,
+                         params, n_probes, index.n_lists, kind=kind,
                          use_pallas=use_pallas)
 
 
-def finish_search(d_est, ids, raw, q, k: int, sqrt: bool, rescore: bool
+def finish_search(d_est, ids, raw, q, k: int,
+                  metric: DistanceType = DistanceType.L2Expanded,
+                  rescore: bool = False
                   ) -> Tuple[jax.Array, jax.Array]:
     """Shared epilogue of the single-chip and distributed searches:
     either slice the estimator top-k, or exactly re-rank the kk
-    survivors against the host-resident raw vectors (returned
-    distances are then exact squared-L2; sqrt per the metric)."""
+    survivors against the host-resident raw vectors. Internal scores
+    are uniformly smaller-is-better (−similarity for the ip core);
+    the ivf_flat output conventions are applied last (IP →
+    similarities, cosine → 1 − cos, L2Sqrt → euclidean)."""
+    from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
+    kind = _metric_kind(metric)
+    sqrt = metric == DistanceType.L2SqrtExpanded
     if not rescore:
         d_est, ids = d_est[:, :k], ids[:, :k]
         if sqrt:
             d_est = jnp.sqrt(jnp.maximum(d_est, 0.0))
-        return d_est, ids
+        return _postprocess(d_est, metric), ids
     ids_h = np.asarray(jax.device_get(ids))
     qh = np.asarray(jax.device_get(q))
     cand = raw[np.maximum(ids_h, 0)]                    # (nq, kk, d)
-    diff = cand - qh[:, None, :]
-    ex = np.einsum("qkd,qkd->qk", diff, diff)
+    if kind == "ip":
+        ex = -np.einsum("qkd,qd->qk", cand, qh)         # −similarity
+    else:
+        diff = cand - qh[:, None, :]
+        ex = np.einsum("qkd,qkd->qk", diff, diff)
     ex = np.where(ids_h >= 0, ex, np.inf)
     order = np.argsort(ex, axis=1)[:, :k]
     d_out = np.take_along_axis(ex, order, axis=1)
@@ -350,7 +391,7 @@ def finish_search(d_est, ids, raw, q, k: int, sqrt: bool, rescore: bool
     d_out = np.where(np.isfinite(d_out), d_out, np.inf)
     if sqrt:
         d_out = np.sqrt(np.maximum(d_out, 0.0))
-    return jnp.asarray(d_out), jnp.asarray(i_out)
+    return _postprocess(jnp.asarray(d_out), metric), jnp.asarray(i_out)
 
 
 def search(index: Index, queries, k: int,
@@ -361,6 +402,11 @@ def search(index: Index, queries, k: int,
     when rescoring; estimator values otherwise."""
     q = as_array(queries).astype(jnp.float32)
     expects(q.shape[1] == index.dim, "ivf_bq.search: dim mismatch")
+    from raft_tpu.neighbors.ivf_flat import _metric_kind
+    kind = _metric_kind(index.metric)
+    if index.metric == DistanceType.CosineExpanded:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                            1e-30)
     n_probes = min(params.n_probes, index.n_lists)
     rescore = params.rescore_factor > 0 and index.raw is not None
     # rescore_factor shapes the DEVICE phase (candidate count) whether
@@ -372,7 +418,7 @@ def search(index: Index, queries, k: int,
     kk = max(params.rescore_factor, 1) * k
     from raft_tpu.ops.dispatch import pallas_enabled
     use_pallas = pallas_enabled()
-    cap = _resolve(index, q, params, n_probes, use_pallas)
+    cap = _resolve(index, q, params, n_probes, use_pallas, kind=kind)
     max_list = index.bits.shape[1]
     # auto bins: a 32x-oversampled GLOBAL candidate pool (n_probes·bins
     # ≈ 32·kk, floor 128/list) instead of the flat/pq per-list 4·k rule
@@ -403,12 +449,14 @@ def search(index: Index, queries, k: int,
                 q, index.centers, index.centers_rot,
                 index.rotation_matrix, index.bits, index.norms2,
                 index.scales, index.lists_indices, kk=kk, bins=bins,
-                n_probes=n_probes, cap=cap, gather=gather_mode())
+                n_probes=n_probes, cap=cap, gather=gather_mode(),
+                kind=kind)
         else:
             d_est, ids = _fused_bq_search(
                 q, index.centers, index.centers_rot,
                 index.rotation_matrix, index.bits, index.norms2,
                 index.scales, index.lists_indices, kk=kk, bins=bins,
-                n_probes=n_probes, cap=cap, chunk=chunk, dim=index.dim)
-        sqrt = index.metric == DistanceType.L2SqrtExpanded
-        return finish_search(d_est, ids, index.raw, q, k, sqrt, rescore)
+                n_probes=n_probes, cap=cap, chunk=chunk, dim=index.dim,
+                kind=kind)
+        return finish_search(d_est, ids, index.raw, q, k,
+                             metric=index.metric, rescore=rescore)

@@ -276,7 +276,8 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
 
 
 def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
-                    cd_ref, ci_ref, *, lc: int, bins: int, dim: int):
+                    cd_ref, ci_ref, *, lc: int, bins: int, dim: int,
+                    metric: str):
     """Binary-quantized list scan (ivf_bq's fine phase): unpack the
     1-bit sign codes to a transient ±1 bf16 tile IN VMEM — the 8×-HBM
     win over reading bf16 rows — then the same transposed-score
@@ -312,7 +313,13 @@ def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
         sc = scales_ref[l, 0][:, None]                   # (ML, 1)
         ids = ids_ref[l, 0]                              # (ML,)
         ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
-        d = n2 + qq - 2.0 * sc * ip
+        if metric == "ip":
+            # estimator core −s·⟨q, dec⟩; the per-(list, query) center
+            # term −q·c_l is a rank-1 correction applied to the
+            # candidate blocks AFTER the scan (the ivf_pq ip pattern)
+            d = -(sc * ip)
+        else:
+            d = n2 + qq - 2.0 * sc * ip
         # NO maximum(d, 0) clamp here: the 1-bit estimator legitimately
         # goes negative when it overshoots near a true neighbor, and
         # clamping would collapse exactly the strongest candidates into
@@ -331,14 +338,16 @@ def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("bins", "lc", "dim",
-                                             "interpret"))
+                                             "interpret", "metric"))
 def _bq_scan_call(qsub, bits_i32, norms2, scales, ids, bins: int,
-                  lc: int, dim: int, interpret: bool):
+                  lc: int, dim: int, interpret: bool,
+                  metric: str = "l2"):
     n_lists, cap, _ = qsub.shape
     max_list = bits_i32.shape[1]
     w = bits_i32.shape[2]
     gc = n_lists // lc
-    kern = functools.partial(_bq_scan_kernel, lc=lc, bins=bins, dim=dim)
+    kern = functools.partial(_bq_scan_kernel, lc=lc, bins=bins, dim=dim,
+                             metric=metric)
     norms3 = norms2[:, None, :]
     scales3 = scales[:, None, :]
     ids3 = ids[:, None, :]
@@ -371,10 +380,12 @@ def _bq_scan_call(qsub, bits_i32, norms2, scales, ids, bins: int,
 def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                        lists_indices, probes, k: int, cap: int,
                        bins: int = 0, sqrt: bool = False,
-                       gather: str = ""):
+                       gather: str = "", metric: str = "l2"):
     """Fused Pallas fine phase for ivf_bq: probe inversion + per-list
-    query gather (rotated, center-offset) + the in-VMEM unpack scan +
-    the shared candidate merge. Mirrors ``ivf_list_scan_pallas``."""
+    query gather (rotated; center-offset for the l2 core) + the in-VMEM
+    unpack scan + the shared candidate merge. Mirrors
+    ``ivf_list_scan_pallas``; ``metric`` "ip" scores negated
+    similarities with the center term applied post-scan."""
     nq, dim = q_rot.shape
     n_lists, max_list = lists_indices.shape
     lay = _Layout(probes, n_lists, max_list, cap, bins, k)
@@ -385,12 +396,19 @@ def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
     lists_indices = lay.pad_lists(lists_indices, max_list, fill=-1)
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
     qg = gather_query_rows(q_rot, lay.padded_qmap(), mode=gather)
-    qsub = qg - centers_rot[:, None, :]
+    qsub = qg if metric == "ip" else qg - centers_rot[:, None, :]
     # VMEM: the unpacked (ML, dim) bf16 tile + (ML, cap) scores dominate
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim, 2)
     cd, ci = _bq_scan_call(qsub, bits_i32, norms2, scales,
                            lists_indices, lay.bins, lc, dim,
-                           pallas_interpret())
+                           pallas_interpret(), metric=metric)
+    if metric == "ip":
+        # kernel scored −s·⟨q, dec⟩; complete −q·x with the center term
+        from raft_tpu.core.precision import matmul_precision
+        corr = jnp.einsum("lqd,ld->lq", qsub, centers_rot,
+                          precision=matmul_precision(),
+                          preferred_element_type=jnp.float32)
+        cd = cd.astype(jnp.float32) - corr[:, None, :]  # (L, bins, capp)
     return lay.merge(cd, ci, probes, k, sqrt)
 
 

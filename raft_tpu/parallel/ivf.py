@@ -773,7 +773,6 @@ def distributed_ivf_bq_search_parts(
     n_probes = min(params.n_probes, dindex.n_lists)
     rescore = params.rescore_factor > 0 and dindex.raw is not None
     kk = max(params.rescore_factor, 1) * k
-    sqrt = dindex.metric == DistanceType.L2SqrtExpanded
     dim = dindex.dim
     comms = build_comms(mesh, axis)
 
@@ -809,4 +808,5 @@ def distributed_ivf_bq_search_parts(
                           dindex.parts_norms2, dindex.parts_scales,
                           dindex.parts_indices, rep(q))
     from raft_tpu.neighbors.ivf_bq import finish_search
-    return finish_search(d_est, ids, dindex.raw, q, k, sqrt, rescore)
+    return finish_search(d_est, ids, dindex.raw, q, k,
+                         metric=dindex.metric, rescore=rescore)

@@ -405,6 +405,41 @@ class TestIvfBq:
         assert bool(np.isfinite(np.asarray(d2)).all())
         assert bool((np.asarray(d2) >= 0).all())
 
+    def test_inner_product(self, dataset):
+        x, q = dataset
+        index = ivf_bq.build(x, ivf_bq.IndexParams(
+            n_lists=32, kmeans_n_iters=8,
+            metric=DistanceType.InnerProduct))
+        d, i = ivf_bq.search(index, q, 10,
+                             ivf_bq.SearchParams(n_probes=16,
+                                                 rescore_factor=16))
+        ips = np.asarray(q) @ np.asarray(x).T
+        iref = np.argsort(-ips, axis=1)[:, :10]
+        assert recall(np.asarray(i), iref) > 0.75
+        # rescored outputs are EXACT similarities, descending
+        got_d, got_i = np.asarray(d), np.asarray(i)
+        want = np.take_along_axis(ips, got_i, axis=1)
+        np.testing.assert_allclose(got_d, want, rtol=1e-4, atol=1e-4)
+        assert bool((np.diff(got_d, axis=1) <= 1e-5).all())
+
+    def test_cosine(self, dataset):
+        x, q = dataset
+        index = ivf_bq.build(x, ivf_bq.IndexParams(
+            n_lists=32, kmeans_n_iters=8,
+            metric=DistanceType.CosineExpanded))
+        d, i = ivf_bq.search(index, q, 10,
+                             ivf_bq.SearchParams(n_probes=16,
+                                                 rescore_factor=16))
+        xn = np.asarray(x) / np.linalg.norm(x, axis=1, keepdims=True)
+        qn = np.asarray(q) / np.linalg.norm(q, axis=1, keepdims=True)
+        cos = qn @ xn.T
+        iref = np.argsort(-cos, axis=1)[:, :10]
+        assert recall(np.asarray(i), iref) > 0.75
+        # 1 - cos outputs, ascending
+        want = 1.0 - np.take_along_axis(cos, np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-4,
+                                   atol=1e-4)
+
     def test_memory_footprint(self, dataset):
         x, _ = dataset
         index = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=16,
