@@ -398,10 +398,21 @@ def search(index: Index, queries, k: int,
            params: SearchParams = SearchParams(), res=None
            ) -> Tuple[jax.Array, jax.Array]:
     """Estimator scan on device (one dispatch) + exact host rescore.
-    Returned distances are exact squared-L2 (sqrt for the Sqrt metric)
-    when rescoring; estimator values otherwise."""
+    When rescoring, returned values are exact and follow the family
+    output conventions (ivf_flat._postprocess): squared-L2 ascending
+    (euclidean for the Sqrt metric), similarities DESCENDING for
+    InnerProduct, 1 − cos ascending for cosine; estimator values in
+    the same conventions otherwise."""
     q = as_array(queries).astype(jnp.float32)
     expects(q.shape[1] == index.dim, "ivf_bq.search: dim mismatch")
+    from raft_tpu.neighbors.ann_types import (MAX_QUERY_BATCH,
+                                              batched_search)
+    if q.shape[0] > MAX_QUERY_BATCH:
+        # reference batching loop (ivf_pq_search.cuh:1234 role): bounds
+        # the inverted-table width (cap ≤ nq) and reuses one compiled
+        # shape per batch
+        return batched_search(
+            lambda qb: search(index, qb, k, params, res=res), q)
     from raft_tpu.neighbors.ivf_flat import _metric_kind
     kind = _metric_kind(index.metric)
     if index.metric == DistanceType.CosineExpanded:

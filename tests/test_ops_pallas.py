@@ -326,6 +326,30 @@ class TestIvfBqScanPallas:
         np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
                                    rtol=1e-5)
 
+    @pytest.mark.parametrize("metric", ["ip", "cosine"])
+    def test_kernel_tier_matches_xla_on_ip_metrics(self, bq_index,
+                                                   metric, monkeypatch):
+        """The kernel's ip branch (−s·⟨q,dec⟩ + post-scan center
+        correction) must rank like the XLA tier; with exact bins the
+        rescored outputs are identical."""
+        from raft_tpu.distance import DistanceType
+        from raft_tpu.neighbors import ivf_bq
+        _, x, q = bq_index
+        m = (DistanceType.InnerProduct if metric == "ip"
+             else DistanceType.CosineExpanded)
+        idx = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=32,
+                                                 kmeans_n_iters=4,
+                                                 metric=m))
+        ml = int(idx.lists_indices.shape[1])
+        sp = ivf_bq.SearchParams(n_probes=32, scan_bins=ml)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        d_p, i_p = ivf_bq.search(idx, q, 8, sp)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "never")
+        d_x, i_x = ivf_bq.search(idx, q, 8, sp)
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_kernel_tier_recall_gate(self, bq_index, monkeypatch):
         from raft_tpu.neighbors import ivf_bq
         from raft_tpu.neighbors.brute_force import brute_force_knn
