@@ -20,8 +20,8 @@ Pieces, in submission order (bench shapes 500k x 128, 1024 lists,
   7. chained   — 4x-chained fused search (the measurement program)
 
 Run: PYTHONPATH=.:/root/.axon_site python tools/ivf_compile_bisect.py
-Env: RUNG=smoke|small|full (default small); FAMILY=flat|pq (default
-flat — pq pieces: build / coarse / code-scan / fused / chained, coarser
+Env: RUNG=smoke|small|full (default small); FAMILY=flat|pq|bq (default
+flat — pq/bq pieces: build / coarse / scan / fused / chained, coarser
 because the flat rungs already isolate the shared invert/gather/merge
 glue); RAFT_TPU_PALLAS to force tiers; RAFT_TPU_IVF_LC=1 for the
 grid-per-list flat-kernel variant.
@@ -141,8 +141,40 @@ if FAMILY == "pq":
     step("pq fused", lambda: ivf_pq.search(idx, q, K, sp))
     run_chained("pq ", lambda qb: ivf_pq.search(idx, qb, K, sp))
     raise SystemExit(0)
+elif FAMILY == "bq":
+    from raft_tpu.neighbors import ivf_bq
+
+    # keep_raw=False + the serving-default rescore_factor: the chained
+    # step must compile the TRUE serving-width device program (kk =
+    # rescore_factor·k candidate merge) while staying one jit-able
+    # dispatch — rescore_factor shapes the device phase with or without
+    # raw vectors (ivf_bq.search docstring)
+    idx = step("bq build", lambda: ivf_bq.build(
+        db, ivf_bq.IndexParams(n_lists=NLISTS, kmeans_n_iters=10,
+                               keep_raw=False)))
+    probes = step("bq coarse", lambda: S.coarse_probes(
+        q, idx.centers, NPROBES, use_pallas=use_pallas))
+    cap = S.probe_cap(probes, NLISTS)
+    print(f"[bisect] cap={cap} max_list={idx.bits.shape[1]}", flush=True)
+
+    if use_pallas:
+        from raft_tpu.ops.pallas_ivf_scan import ivf_bq_scan_pallas
+        q_rot = q @ idx.rotation_matrix.T
+
+        step("bq unpack-scan", lambda: jax.jit(
+            lambda qr, pr: ivf_bq_scan_pallas(
+                qr, idx.centers_rot, idx.bits, idx.norms2, idx.scales,
+                idx.lists_indices, pr, K, cap))(q_rot, probes))
+    else:
+        print("[bisect] pallas disabled: skipping bq unpack-scan "
+              "(fused/chained route the XLA decode tiles)", flush=True)
+
+    sp = ivf_bq.SearchParams(n_probes=NPROBES, probe_cap=cap)
+    step("bq fused", lambda: ivf_bq.search(idx, q, K, sp))
+    run_chained("bq ", lambda qb: ivf_bq.search(idx, qb, K, sp))
+    raise SystemExit(0)
 elif FAMILY != "flat":
-    raise SystemExit(f"FAMILY={FAMILY!r}: want flat|pq")
+    raise SystemExit(f"FAMILY={FAMILY!r}: want flat|pq|bq")
 
 idx = step("build", lambda: ivf_flat.build(
     db, ivf_flat.IndexParams(n_lists=NLISTS, kmeans_n_iters=10)))

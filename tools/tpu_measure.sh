@@ -44,6 +44,12 @@ RUNG=small FAMILY=pq python tools/ivf_compile_bisect.py 2>&1 \
 probe bisect-pq-full
 RUNG=full FAMILY=pq python tools/ivf_compile_bisect.py 2>&1 \
   | tee "$OUT/bisect_pq_full.log"
+probe bisect-bq
+RUNG=small FAMILY=bq python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_bq_small.log"
+probe bisect-bq-full
+RUNG=full FAMILY=bq python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_bq_full.log"
 probe bisect-full-auto
 RUNG=full python tools/ivf_compile_bisect.py 2>&1 | tee "$OUT/bisect_full.log"
 probe bisect-small-xla
