@@ -184,7 +184,15 @@ def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
     train_per_subset, ivf_pq_build.cuh:464). The Python loop dispatches
     pq_dim sequential trainers, but each is the balanced trainer whose
     init/balancing beats a batched plain-EM by ~0.2 recall at equal
-    iterations (measured; the batched variant was tried and reverted)."""
+    iterations (measured; the batched variant was tried and reverted).
+    A SECOND batched attempt (2026-08-01) kept the full balanced
+    semantics in one jit (grouped (g, n, C) EM with per-member
+    approx_max_k reseed): recall matched exactly but build got ~25%
+    SLOWER on CPU (44.3 vs 34.9 s at 50k×128/pq_dim=32 — the big
+    materialized blocks lose to fused_l2_nn's tiled scan), and the
+    sequential loop's dispatches pipeline asynchronously anyway, so
+    the loop stays. Don't retry without a TPU measurement showing the
+    dispatch chain actually binds."""
     sub = residuals_rot.reshape(-1, pq_dim, pq_len)  # (n, pq_dim, pq_len)
     books = []
     for s in range(pq_dim):
