@@ -1,0 +1,46 @@
+"""Tests for the bench harness machinery (bench_suite.check_gates
+- the perf-regression gate, the role of the reference's recall
+thresholds + gbench tracking)."""
+
+
+class TestPerfGates:
+    """The bench perf-regression gate machinery (bench_suite.check_gates
+    — the role of the reference's recall thresholds + gbench tracking)."""
+
+    def _rows(self, **over):
+        rows = [{"metric": "pairwise_L2Expanded_8192x8192x256_ms",
+                 "value": 10.0},
+                {"metric": "pairwise_L1_8192x8192x256_ms", "value": 50.0},
+                {"metric": "ivf_flat_search_500kx128_q1000_k32_p64_qps",
+                 "value": 50_000.0}]
+        for r in rows:
+            if r["metric"] in over:
+                r["value"] = over[r["metric"]]
+        return rows
+
+    def test_all_pass(self):
+        import bench_suite
+        assert bench_suite.check_gates(self._rows()) == []
+
+    def test_ceiling_trip(self):
+        import bench_suite
+        fails = bench_suite.check_gates(self._rows(
+            **{"pairwise_L2Expanded_8192x8192x256_ms": 99.0}))
+        assert [f["metric"] for f in fails] == \
+            ["pairwise_L2Expanded_8192x8192x256_ms"]
+        assert fails[0]["kind"] == "ceiling"
+
+    def test_qps_floor_trip(self):
+        import bench_suite
+        fails = bench_suite.check_gates(self._rows(
+            **{"ivf_flat_search_500kx128_q1000_k32_p64_qps": 100.0}))
+        assert fails and fails[0]["kind"] == "floor"
+
+    def test_missing_metric_is_a_failure(self):
+        """A gate must never pass by not running (require_all mode)."""
+        import bench_suite
+        rows = self._rows()[:-1]  # drop the gated ivf row
+        fails = bench_suite.check_gates(rows, require_all=True)
+        assert any(f["kind"] == "missing" for f in fails)
+        # case-filtered runs don't charge unselected gates
+        assert bench_suite.check_gates(rows, require_all=False) == []

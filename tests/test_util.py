@@ -105,3 +105,4 @@ class TestHostSample:
         np.testing.assert_array_equal(
             idx, np.asarray(sample_rows(n, 4096, seed=3)))
         assert (idx != np.asarray(sample_rows(n, 4096, seed=4))).any()
+
