@@ -183,7 +183,7 @@ def _cached_cap(index, nq: int, n_probes: int) -> int:
     return index.cap_cache[(nq, n_probes, pallas_enabled())]
 
 def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
-                   label=None):
+                   label=None, storage_dtype="float32"):
     # cpp/bench/neighbors/knn/ivf_flat_*.cu — SEARCH scope (+BUILD:
     # cold = first build incl. compiles; warm = steady-state rebuild,
     # the gbench BUILD-scope iteration analogue)
@@ -197,7 +197,8 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
     # kmeans_n_iters=10 vs the parity default 20: measured downstream-
     # recall-neutral for IVF-Flat (BASELINE.md 2026-08-01 A/B) and ~2×
     # build; the row reports its own recall so the trade is visible
-    params = ivf_flat.IndexParams(n_lists=nlists, kmeans_n_iters=10)
+    params = ivf_flat.IndexParams(n_lists=nlists, kmeans_n_iters=10,
+                                  storage_dtype=storage_dtype)
     t_build0 = time.perf_counter()
     index = ivf_flat.build(db, params)
     _sync(index.centers)
@@ -291,6 +292,17 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
         "recall": round(rec, 4),
         "marginal_qps": round(nq / t_marg, 1),
         "build_s": round(t_build, 2)})
+
+
+def bench_ivf_flat_int8(results, n=500_000, nlists=1024, n_probes=64):
+    # the reference's int8_t dataset axis (cpp/bench/neighbors/knn/
+    # ivf_flat_int8_t_int64_t.cu): narrow list storage quarters the
+    # bytes every probe scans; same harness, one knob
+    bench_ivf_flat(
+        results, n=n, nlists=nlists, n_probes=n_probes,
+        storage_dtype="int8",
+        label=(f"ivf_flat_int8_search_{n//1000}kx128_q1000_k32"
+               f"_p{n_probes}_qps"))
 
 
 def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=64,
@@ -483,8 +495,9 @@ def bench_host_ivf(results):
 
 _CASES = [bench_pairwise_distance, bench_fused_l2_nn, bench_select_k,
           bench_kmeans, bench_ivf_flat, bench_ivf_pq, bench_ivf_bq,
-          bench_linalg_random, bench_ball_cover, bench_sparse_wide,
-          bench_host_ivf, bench_brute_2m, bench_fused_wide, bench_ivf_10m]
+          bench_ivf_flat_int8, bench_linalg_random, bench_ball_cover,
+          bench_sparse_wide, bench_host_ivf, bench_brute_2m,
+          bench_fused_wide, bench_ivf_10m]
 
 
 def run_all(cases=None):
