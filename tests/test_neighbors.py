@@ -525,6 +525,36 @@ class TestBallCover:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestSpatialKnnFacade:
+    """Legacy ``raft::spatial::knn`` surface (raft_tpu/spatial/knn.py —
+    the reference's runtime-dispatched ANN entry points,
+    ann_quantized.cuh:67-160)."""
+
+    def test_dispatch_by_params_type(self, dataset):
+        from raft_tpu.spatial.knn import (approx_knn_build_index,
+                                          approx_knn_search)
+        x, q = dataset
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        _, iref = nn.kneighbors(q)
+        for params, sp, floor in (
+                (ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5),
+                 ivf_flat.SearchParams(n_probes=16), 0.99),
+                (ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=5),
+                 ivf_pq.SearchParams(n_probes=16), 0.4)):
+            idx = approx_knn_build_index(x, params)
+            d, i = approx_knn_search(idx, q, 10, sp)
+            assert recall(np.asarray(i), iref) > floor
+
+    def test_unknown_types_rejected(self, dataset):
+        from raft_tpu.spatial.knn import (approx_knn_build_index,
+                                          approx_knn_search)
+        x, _ = dataset
+        with pytest.raises(TypeError):
+            approx_knn_build_index(x, object())
+        with pytest.raises(TypeError):
+            approx_knn_search(object(), x[:5], 3)
+
+
 class TestSerialize:
     """Index save/load round-trip (raft_tpu/neighbors/serialize.py — the
     explicit improvement over the reference snapshot, SURVEY.md §5)."""
