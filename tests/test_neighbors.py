@@ -525,6 +525,44 @@ class TestBallCover:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestSerializeEdges:
+    """Format/robustness edges of the save/load layer."""
+
+    def test_wrong_format_rejected(self, tmp_path):
+        import jax
+        from raft_tpu.core.error import LogicError
+        from raft_tpu.neighbors import ivf_flat, serialize
+        db = jax.random.normal(jax.random.key(0), (500, 8))
+        idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=4,
+                                                      kmeans_n_iters=2))
+        path = str(tmp_path / "x.npz")
+        serialize.save(idx, path)
+        with pytest.raises(LogicError):
+            serialize.load_ivf_pq(path)  # flat file via pq loader
+
+    def test_unknown_payload_rejected(self, tmp_path):
+        import numpy as _np
+        from raft_tpu.neighbors import serialize
+        bad = str(tmp_path / "bad.npz")
+        _np.savez(bad, a=_np.zeros(3))
+        with pytest.raises(Exception):
+            serialize.load(bad)  # no __meta__ record
+
+    def test_bq_estimator_only_roundtrip(self, tmp_path, dataset):
+        from raft_tpu.neighbors import serialize
+        x, q = dataset
+        idx = ivf_bq.build(x[:1000], ivf_bq.IndexParams(
+            n_lists=8, kmeans_n_iters=3, keep_raw=False))
+        path = str(tmp_path / "bq_noraw.npz")
+        serialize.save(idx, path)
+        idx2 = serialize.load(path)
+        assert idx2.raw is None
+        sp = ivf_bq.SearchParams(n_probes=4)
+        d1, i1 = ivf_bq.search(idx, q, 5, sp)
+        d2, i2 = ivf_bq.search(idx2, q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
 class TestSpatialKnnFacade:
     """Legacy ``raft::spatial::knn`` surface (raft_tpu/spatial/knn.py —
     the reference's runtime-dispatched ANN entry points,
