@@ -25,11 +25,14 @@ l with center c_l, and a stored point x = c_l + r,
     ||q − x||² = ||q_l||² + ||r||² − 2⟨q_l, r⟩,   q_l = q − c_l
     ⟨q_l, r⟩ ≈ s·⟨q_l, sign(r)⟩,                 s = mean(|r|)
 
-(s·sign(r) is the best {±s}^d approximation of r in L2.) The
-estimator ranks candidates; `rescore_factor`·k survivors are re-ranked
-with EXACT f32 distances against the raw vectors kept host-side (the
-`host_memory` role: device holds bits, host holds truth), so returned
-distances are exact and recall approaches the probe ceiling.
+(s·sign(r) is the best {±s}^d approximation of r in L2.) Inner
+product uses the same decomposition — ``q·x ≈ q·c_l + s·⟨q_rot,
+sign(r_rot)⟩`` — and cosine rides the ip core after row
+normalization. The estimator ranks candidates; `rescore_factor`·k
+survivors are re-ranked with EXACT f32 scores against the raw vectors
+kept host-side (the `host_memory` role: device holds bits, host holds
+truth), so returned values are exact and recall approaches the probe
+ceiling.
 
 Two device tiers, routed by ``ops.dispatch``: the XLA formulation
 (chunked decode tiles + einsum) and the Pallas kernel
