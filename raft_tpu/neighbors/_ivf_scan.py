@@ -333,18 +333,21 @@ def gather_mode() -> str:
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
                                              "bins", "sqrt", "kind",
                                              "use_pallas", "gather",
-                                             "internal_dtype"))
+                                             "internal_dtype", "lc"))
 def fused_list_search(queries, centers, data, norms, ids, scale, *,
                       k: int, n_probes: int, cap: int, bins: int,
                       sqrt: bool, kind: str, use_pallas: bool,
-                      gather: str = "rows", internal_dtype=None):
+                      gather: str = "rows", internal_dtype=None,
+                      lc: int = 0):
     """Single-dispatch list-major IVF-Flat search: coarse probe GEMM +
     top-k, probe inversion, query gather, the list scan (Pallas kernel or
     XLA tier) and the candidate merge — ONE jitted computation. The
     reference's search is likewise one stream of kernels with no host
     round-trips (``ivf_flat_search.cuh:1057``); on the tunneled axon
     platform each avoided dispatch saves ~22 ms, which is why the fused
-    form, not the kernel, was the round-3 QPS lever."""
+    form, not the kernel, was the round-3 QPS lever. ``lc`` (static):
+    kernel lists-per-grid-cell, 0 = auto — resolved by callers via
+    ``pallas_ivf_scan.lc_mode()`` outside jit so the cache keys on it."""
     probes = coarse_probes(queries, centers, n_probes, kind=kind,
                            use_pallas=use_pallas)
     if use_pallas:
@@ -353,7 +356,8 @@ def fused_list_search(queries, centers, data, norms, ids, scale, *,
                                     cap, scale=scale, bins=bins,
                                     sqrt=sqrt, metric=kind,
                                     gather=gather,
-                                    internal_dtype=internal_dtype)
+                                    internal_dtype=internal_dtype,
+                                    lc=lc)
     # XLA tier scores the l2 core only; search() gates routing
     chunk = _chunk_size(ids.shape[0], cap, ids.shape[1])
     return inverted_scan(queries, data, norms, ids, probes, k, cap,

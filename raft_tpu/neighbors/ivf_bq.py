@@ -329,16 +329,19 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
 
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
-                                             "cap", "gather", "kind"))
+                                             "cap", "gather", "kind",
+                                             "lc"))
 def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
                             norms2, scales, ids, *, kk: int, bins: int,
                             n_probes: int, cap: int,
-                            gather: str = "rows", kind: str = "l2"):
+                            gather: str = "rows", kind: str = "l2",
+                            lc: int = 0):
     """Kernel-tier single-dispatch device phase: the in-VMEM unpack
     scan (``pallas_ivf_scan.ivf_bq_scan_pallas``) reads the 1-bit codes
     straight from HBM — 8× less scan bandwidth than the XLA tier's
     materialized decode tiles. ``gather`` is the RAFT_TPU_GATHER
-    strategy resolved OUTSIDE jit (the _ivf_scan contract)."""
+    strategy resolved OUTSIDE jit (the _ivf_scan contract); ``lc``
+    likewise (``pallas_ivf_scan.lc_mode``), 0 = auto."""
     from raft_tpu.neighbors import _ivf_scan as S
     from raft_tpu.ops.pallas_ivf_scan import ivf_bq_scan_pallas
     probes = S.coarse_probes(queries, centers, n_probes, kind=kind,
@@ -346,7 +349,7 @@ def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
     q_rot = queries @ rot.T
     return ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                               ids, probes, kk, cap, bins=bins,
-                              gather=gather, metric=kind)
+                              gather=gather, metric=kind, lc=lc)
 
 
 def _resolve(index: Index, queries, params: SearchParams,
@@ -457,20 +460,43 @@ def search(index: Index, queries, k: int,
             index.n_lists,
             max(1, (64 << 20) // max(1, max_list * index.dim * 2))))
     with trace.range("ivf_bq::search(%d, %d)", q.shape[0], n_probes):
-        if use_pallas:
+        from raft_tpu.ops.compile_budget import run_tiers
+        from raft_tpu.ops.pallas_ivf_scan import lc_mode
+
+        def pallas_tier(lc):
             from raft_tpu.neighbors._ivf_scan import gather_mode
-            d_est, ids = _fused_bq_search_pallas(
+            return lambda: _fused_bq_search_pallas(
                 q, index.centers, index.centers_rot,
                 index.rotation_matrix, index.bits, index.norms2,
                 index.scales, index.lists_indices, kk=kk, bins=bins,
                 n_probes=n_probes, cap=cap, gather=gather_mode(),
-                kind=kind)
-        else:
-            d_est, ids = _fused_bq_search(
-                q, index.centers, index.centers_rot,
-                index.rotation_matrix, index.bits, index.norms2,
-                index.scales, index.lists_indices, kk=kk, bins=bins,
-                n_probes=n_probes, cap=cap, chunk=chunk, dim=index.dim,
-                kind=kind)
+                kind=kind, lc=lc)
+
+        # compile-budget ladder (ops/compile_budget.py): Pallas unpack
+        # scan → Pallas grid-per-list → the XLA decode-tile
+        # formulation (proven-compilable tail)
+        tiers = []
+        if use_pallas:
+            from raft_tpu.ops.pallas_ivf_scan import _pick_lc
+            lc0 = lc_mode()
+            tiers.append((f"pallas_lc{lc0 or 'auto'}", pallas_tier(lc0)))
+            # no lc=1 rung when the first tier already resolves to it
+            # (see ivf_flat.search)
+            auto_lc = _pick_lc(index.n_lists, max_list, cap,
+                               index.dim, 2)
+            if lc0 != 1 and not (lc0 == 0 and auto_lc == 1):
+                tiers.append(("pallas_lc1", pallas_tier(1)))
+        tiers.append(("xla_decode", lambda: _fused_bq_search(
+            q, index.centers, index.centers_rot,
+            index.rotation_matrix, index.bits, index.norms2,
+            index.scales, index.lists_indices, kk=kk, bins=bins,
+            n_probes=n_probes, cap=cap, chunk=chunk, dim=index.dim,
+            kind=kind)))
+        # key covers every program-shaping static (see ivf_flat.search)
+        from raft_tpu.neighbors._ivf_scan import gather_mode
+        shape_key = (f"ivf_bq[{q.shape[0]}x{index.dim},kk={kk},"
+                     f"p={n_probes},cap={cap},L={index.n_lists},"
+                     f"bins={bins},{kind},g={gather_mode()}]")
+        d_est, ids = run_tiers(shape_key, tiers)
         return finish_search(d_est, ids, index.raw, q, k,
                              metric=index.metric, rescore=rescore)

@@ -416,17 +416,65 @@ def search(index: Index, queries, k: int,
                      "list" if use_list else "probe"):
         if use_list:
             from raft_tpu.neighbors import _ivf_scan
+            from raft_tpu.ops.compile_budget import run_tiers
+            from raft_tpu.ops.pallas_ivf_scan import lc_mode
+            use_pallas = pallas_enabled()
             cap = _ivf_scan.resolve_cap(index.cap_cache, q,
                                         index.centers, params, n_probes,
                                         index.n_lists, kind=kind,
-                                        use_pallas=pallas_enabled())
-            d, i = _ivf_scan.fused_list_search(
-                q, index.centers, index.lists_data, index.lists_norms,
-                index.lists_indices, jnp.float32(index.scale), k=k,
-                n_probes=n_probes, cap=cap, bins=params.scan_bins,
-                sqrt=sqrt, kind=kind, use_pallas=pallas_enabled(),
-                gather=_ivf_scan.gather_mode(),
-                internal_dtype=params.internal_distance_dtype)
+                                        use_pallas=use_pallas)
+
+            def fused(pallas: bool, lc: int = 0):
+                return lambda: _ivf_scan.fused_list_search(
+                    q, index.centers, index.lists_data,
+                    index.lists_norms, index.lists_indices,
+                    jnp.float32(index.scale), k=k, n_probes=n_probes,
+                    cap=cap, bins=params.scan_bins, sqrt=sqrt,
+                    kind=kind, use_pallas=pallas,
+                    gather=_ivf_scan.gather_mode(),
+                    internal_dtype=params.internal_distance_dtype,
+                    lc=lc)
+
+            # compile-budget ladder, structurally simplest LAST (see
+            # ops/compile_budget.py): Pallas kernel (auto or env lc) →
+            # Pallas grid-per-list (loop-free body) → XLA inverted scan
+            # (l2 core only) → probe-major eager scan (always
+            # compiles — small per-probe programs)
+            lc0 = lc_mode()
+            tiers = []
+            if use_pallas:
+                from raft_tpu.ops.pallas_ivf_scan import _pick_lc
+                tiers.append((f"pallas_lc{lc0 or 'auto'}",
+                              fused(True, lc0)))
+                # skip the lc=1 rung when the first tier already IS
+                # lc=1 (explicitly, or via the auto pick — approximated
+                # on unpadded shapes): re-submitting the same program
+                # would burn a second budget on a wedged service
+                auto_lc = _pick_lc(index.n_lists,
+                                   index.lists_data.shape[1], cap,
+                                   index.dim,
+                                   index.lists_data.dtype.itemsize)
+                if lc0 != 1 and not (lc0 == 0 and auto_lc == 1):
+                    tiers.append(("pallas_lc1", fused(True, 1)))
+            if kind == "l2":
+                tiers.append(("xla_inverted", fused(False)))
+            tiers.append(("probe_major", lambda: _search_impl(
+                q, index.centers, index.lists_data,
+                index.lists_indices, index.lists_norms,
+                jnp.float32(index.scale), k, n_probes, sqrt,
+                kind=kind)))
+            # the key must cover EVERY static arg that changes the
+            # compiled program — tier state shared across distinct
+            # programs would bypass the budget for never-compiled
+            # variants (r4 review finding)
+            shape_key = (f"ivf_flat[{nq}x{index.dim},k={k},"
+                         f"p={n_probes},cap={cap},L={index.n_lists},"
+                         f"ml={index.lists_data.shape[1]},"
+                         f"{kind},sqrt={sqrt},b={params.scan_bins},"
+                         f"g={_ivf_scan.gather_mode()},"
+                         f"idt={jnp.dtype(params.internal_distance_dtype).name},"
+                         f"dt={index.lists_data.dtype.name}]")
+            d, i = run_tiers(shape_key, tiers)
         else:
             d, i = _search_impl(q, index.centers, index.lists_data,
                                 index.lists_indices, index.lists_norms,

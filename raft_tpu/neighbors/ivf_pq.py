@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import enum
 import functools
+import threading
 from dataclasses import dataclass, field as dataclasses_field
 from typing import Optional, Tuple
 
@@ -709,6 +710,12 @@ def _fused_code_search(q, centers, centers_rot, rot, pq_centers, codes,
         per_cluster=per_cluster, gather=gather)
 
 
+# guards the lazy reconstruction-cache materialization: ladder
+# fallback tiers can run on a compile-budget thread concurrently with
+# the inline tail (see _recon_materialize)
+_DECODE_LOCK = threading.Lock()
+
+
 def search(index: Index, queries, k: int,
            params: SearchParams = SearchParams(), res=None
            ) -> Tuple[jax.Array, jax.Array]:
@@ -768,8 +775,55 @@ def search(index: Index, queries, k: int,
             or scan_mode == "codes",
             "ivf_pq: lut_dtype=float8_e4m3fn requires scan_mode='codes' "
             "(resolved scan_mode is %r)", scan_mode)
+    def _recon_materialize():
+        # lock: ladder fallback tiers may run in a compile-budget
+        # thread while a later tier runs inline on the main thread —
+        # an unguarded check-then-set here would materialize the ~8×
+        # decoded cache TWICE (peak-HBM hazard) and race the index
+        # mutation (r4 review finding). The decode programs themselves
+        # are simple proven-compilable gathers, so holding the lock
+        # across them is bounded in practice.
+        if index.decoded is not None and index.decoded_norms is not None:
+            return
+        with _DECODE_LOCK:
+            if index.decoded is None:
+                dec_fn = (_decode_lists_per_cluster if per_cluster
+                          else _decode_lists)
+                index.decoded = dec_fn(
+                    index.codes, index.pq_centers, index.lists_indices)
+            if index.decoded_norms is None:
+                # alias the exact build-time norms — same quantity
+                index.decoded_norms = _norms(index)
+
+    def _recon_list():
+        """Reconstruct-cache fused list scan (l2 core only)."""
+        from raft_tpu.neighbors import _ivf_scan
+        _recon_materialize()
+        cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
+                                    params, n_probes, index.n_lists)
+        # lists hold decoded rotated residuals: the scan offsets
+        # each list's queries by its rotated center so the einsum
+        # scores ||(q_rot - c_l) - decoded||²
+        return _ivf_scan.fused_reconstruct_list_search(
+            q, index.centers, index.centers_rot,
+            index.rotation_matrix, index.decoded,
+            index.decoded_norms, index.lists_indices, k=k,
+            n_probes=n_probes, cap=cap, bins=params.scan_bins,
+            sqrt=sqrt)
+
+    def _recon_probe():
+        """Probe-major reconstruct scan — small per-probe programs,
+        the always-compilable tail of the codes ladder."""
+        _recon_materialize()
+        return _search_impl_reconstruct(
+            q, index.centers, index.centers_rot,
+            index.rotation_matrix, index.decoded,
+            index.decoded_norms, index.lists_indices,
+            k, n_probes, sqrt, kind=kind)
+
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
+        from raft_tpu.ops.compile_budget import run_tiers
         # RAII range (reference nvtx scope in search, ivf_pq_search.cuh:
         # 1263): exception-safe, unlike a bare push/pop pair
         with trace.range("ivf_pq::search(codes)"):
@@ -793,25 +847,41 @@ def search(index: Index, queries, k: int,
                 code_norms = index.code_norms_fp8
             else:
                 code_norms = _norms(index)  # derives once, older indexes
-            d, i = _fused_code_search(
-                q, index.centers, index.centers_rot,
-                index.rotation_matrix, index.pq_centers, index.codes,
-                code_norms, index.lists_indices, k=k, n_probes=n_probes,
-                cap=cap, bins=params.scan_bins, sqrt=sqrt, kind=kind,
-                lut_dtype=params.lut_dtype,
-                internal_dtype=params.internal_distance_dtype,
-                per_cluster=per_cluster, gather=_ivf_scan.gather_mode())
+
+            def codes_tier():
+                return _fused_code_search(
+                    q, index.centers, index.centers_rot,
+                    index.rotation_matrix, index.pq_centers, index.codes,
+                    code_norms, index.lists_indices, k=k,
+                    n_probes=n_probes, cap=cap, bins=params.scan_bins,
+                    sqrt=sqrt, kind=kind, lut_dtype=params.lut_dtype,
+                    internal_dtype=params.internal_distance_dtype,
+                    per_cluster=per_cluster,
+                    gather=_ivf_scan.gather_mode())
+
+            # compile-budget ladder (ops/compile_budget.py): the Pallas
+            # code scan, then the reconstruct-cache XLA formulations
+            # (which trade the codes' memory footprint for a proven
+            # program shape). NOTE the fallbacks score bf16
+            # reconstructions — same recall class, not bit-identical.
+            tiers = [("pallas_codes", codes_tier)]
+            if kind == "l2":
+                tiers.append(("xla_reconstruct_list", _recon_list))
+            tiers.append(("reconstruct_probe_major", _recon_probe))
+            # key covers every program-shaping static (see
+            # ivf_flat.search)
+            shape_key = (f"ivf_pq[{q.shape[0]}x{index.dim},k={k},"
+                         f"p={n_probes},cap={cap},L={index.n_lists},"
+                         f"pq={index.pq_dim}x{index.pq_bits}b,"
+                         f"{kind},sqrt={sqrt},b={params.scan_bins},"
+                         f"lut={jnp.dtype(params.lut_dtype).name},"
+                         f"idt={jnp.dtype(params.internal_distance_dtype).name},"
+                         f"pc={per_cluster},"
+                         f"g={_ivf_scan.gather_mode()}]")
+            d, i = run_tiers(shape_key, tiers)
         return _postprocess(d, index.metric), i
     if scan_mode == "reconstruct":
         with trace.range("ivf_pq::search(reconstruct)"):
-            if index.decoded is None:
-                dec_fn = (_decode_lists_per_cluster if per_cluster
-                          else _decode_lists)
-                index.decoded = dec_fn(
-                    index.codes, index.pq_centers, index.lists_indices)
-            if index.decoded_norms is None:
-                # alias the exact build-time norms — same quantity
-                index.decoded_norms = _norms(index)
             nq = q.shape[0]
             from raft_tpu.neighbors.ann_types import list_order_auto
             use_list = (kind == "l2"
@@ -820,24 +890,8 @@ def search(index: Index, queries, k: int,
                                  and list_order_auto(nq, n_probes,
                                                      index.n_lists))))
             if use_list:
-                from raft_tpu.neighbors import _ivf_scan
-                cap = _ivf_scan.resolve_cap(index.cap_cache, q,
-                                            index.centers, params,
-                                            n_probes, index.n_lists)
-                # lists hold decoded rotated residuals: the scan offsets
-                # each list's queries by its rotated center so the einsum
-                # scores ||(q_rot - c_l) - decoded||²
-                return _ivf_scan.fused_reconstruct_list_search(
-                    q, index.centers, index.centers_rot,
-                    index.rotation_matrix, index.decoded,
-                    index.decoded_norms, index.lists_indices, k=k,
-                    n_probes=n_probes, cap=cap, bins=params.scan_bins,
-                    sqrt=sqrt)
-            d, i = _search_impl_reconstruct(
-                q, index.centers, index.centers_rot,
-                index.rotation_matrix, index.decoded,
-                index.decoded_norms, index.lists_indices,
-                k, n_probes, sqrt, kind=kind)
+                return _recon_list()
+            d, i = _recon_probe()
         return _postprocess(d, index.metric), i
     with trace.range("ivf_pq::search(lut)"):
         d, i = _search_impl(q, index.centers, index.centers_rot,

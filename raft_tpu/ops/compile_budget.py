@@ -1,0 +1,181 @@
+"""Compile-time budget + automatic tier fallback for fused searches.
+
+Why this exists: on 2026-08-01 the first compile of the fused IVF-Flat
+search sat 75 minutes on the remote TPU compile service and the
+service died under it (BASELINE.md round-3 notes). The reference's
+search always compiles — its kernels are precompiled template
+instantiations (``ivf_flat_search.cuh:1026`` launcher) — so a search
+that can wedge an entire round on one pathological compile is a
+library defect, not an ops problem. This module is the in-library
+defense:
+
+* every fused-search entry runs as a ladder of TIERS, structurally
+  simplest-last (Pallas auto-lc → Pallas lc=1 → XLA formulation →
+  probe-major eager scan);
+* the first call of a tier is given a wall-clock compile budget
+  (``RAFT_TPU_COMPILE_BUDGET_S``, default 300 s on TPU backends,
+  disabled elsewhere); a tier that exceeds it is marked POISONED for
+  the process and the next tier serves the query instead;
+* the over-budget compile is **parked, never killed** — a client
+  killed mid-remote-compile is the known service-wedge trigger
+  (tools/tunnel_probe.sh) — it keeps running in a daemon thread, and
+  if it eventually completes the tier un-poisons (its executable sits
+  in the process-wide jit cache, so later same-shape calls are cheap);
+* a tier that has succeeded once runs inline with no thread or budget
+  (the jit cache makes repeat calls microseconds of Python).
+
+The ladder therefore guarantees: no search blocks longer than
+``budget × (len(tiers) − 1)`` before reaching the always-compilable
+probe-major tail, and no compile is ever aborted mid-flight.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from raft_tpu.core.logger import logger
+
+# tier state, process-global: (ladder name, tier name) -> True
+_OK: dict = {}
+# (ladder name, tier name) -> wall time the budget expired
+_POISONED: dict = {}
+_LOCK = threading.Lock()
+
+
+def budget_s() -> float:
+    """Compile budget in seconds; 0 disables budgeting (tiers run
+    inline). Default: 300 s when the default backend is a real TPU
+    (where remote compiles have hung), else 0 — CPU/interpret compiles
+    are fast and tests stay deterministic."""
+    env = os.environ.get("RAFT_TPU_COMPILE_BUDGET_S")
+    if env is not None:
+        return float(env)
+    import jax
+    return 300.0 if jax.default_backend() == "tpu" else 0.0
+
+
+def tier_state(ladder: str, tier: str) -> str:
+    """"ok" | "poisoned" | "untried" — introspection for tests/tools."""
+    key = (ladder, tier)
+    with _LOCK:
+        if key in _OK:
+            return "ok"
+        if key in _POISONED:
+            return "poisoned"
+    return "untried"
+
+
+def snapshot() -> dict:
+    """``{ladder: {tier: "ok"|"poisoned"}}`` — for tools/logs (the
+    bisect ladder prints this so a parked compile is still NAMED even
+    though the search it was part of served from a fallback tier)."""
+    with _LOCK:
+        out: dict = {}
+        for (name, tier) in _OK:
+            out.setdefault(name, {})[tier] = "ok"
+        for (name, tier) in _POISONED:
+            out.setdefault(name, {}).setdefault(tier, "poisoned")
+    return out
+
+
+def reset(ladder: Optional[str] = None) -> None:
+    """Forget tier state (all ladders, or one) — test/bench helper."""
+    with _LOCK:
+        for d in (_OK, _POISONED):
+            for key in [k for k in d
+                        if ladder is None or k[0] == ladder]:
+                del d[key]
+
+
+def _run_inline(name: str, tname: str, thunk: Callable):
+    out = thunk()
+    with _LOCK:
+        _OK[(name, tname)] = True
+    return out
+
+
+def run_tiers(name: str, tiers: Sequence[Tuple[str, Callable]],
+              budget: Optional[float] = None):
+    """Run the first tier of ``tiers`` that completes within the
+    compile budget; fall down the ladder on timeout or error.
+
+    ``tiers``: ``[(tier_name, thunk)]`` — each thunk traces, compiles
+    (first call) and executes its formulation; order them structurally
+    simplest-LAST. The final tier always runs inline (there is nothing
+    to fall back to, and parking it would leave the caller with no
+    result), so put the proven-compilable formulation there.
+    """
+    assert tiers, "run_tiers: empty ladder"
+    b = budget_s() if budget is None else budget
+    errors: List[Tuple[str, BaseException]] = []
+    for i, (tname, thunk) in enumerate(tiers):
+        key = (name, tname)
+        last = i == len(tiers) - 1
+        with _LOCK:
+            ok = key in _OK
+            poisoned = key in _POISONED and not ok
+        if poisoned:
+            continue
+        if b <= 0 or ok or last:
+            try:
+                return _run_inline(name, tname, thunk)
+            except Exception as e:  # noqa: BLE001 - ladder semantics
+                if last:
+                    raise
+                errors.append((tname, e))
+                logger.warn("%s: tier %s failed (%s); falling back",
+                            name, tname, type(e).__name__)
+                continue
+        result: dict = {}
+        done = threading.Event()
+
+        def work(thunk=thunk, result=result, done=done, key=key):
+            try:
+                result["out"] = thunk()
+            except BaseException as e:  # noqa: BLE001
+                result["err"] = e
+            finally:
+                with _LOCK:
+                    if "err" not in result:
+                        # late completion un-poisons: the executable is
+                        # now in the jit cache, future calls are cheap
+                        _OK[key] = True
+                        _POISONED.pop(key, None)
+                done.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"raft-tpu-compile-{tname}")
+        t.start()
+        if done.wait(b):
+            if "err" in result:
+                errors.append((tname, result["err"]))
+                logger.warn("%s: tier %s failed (%s); falling back",
+                            name, tname,
+                            type(result["err"]).__name__)
+                continue
+            with _LOCK:
+                _OK[key] = True
+            return result["out"]
+        with _LOCK:
+            _POISONED[key] = time.time()
+        logger.warn(
+            "%s: tier %s exceeded the %.0f s compile budget; compile "
+            "PARKED (never killed — see compile_budget docstring), "
+            "falling back to the next tier", name, tname, b)
+    # every tier poisoned/failed and the last raised nothing? only
+    # reachable when the last tier was skipped as poisoned — run it
+    # anyway (a poisoned final tier may have un-poisoned since, and
+    # inline is the only option left)
+    tname, thunk = tiers[-1]
+    try:
+        return _run_inline(name, tname, thunk)
+    except Exception:
+        if errors:
+            logger.error("%s: all %d tiers failed; earlier errors: %s",
+                         name, len(tiers),
+                         "; ".join(f"{t}: {type(e).__name__}"
+                                   for t, e in errors))
+        raise

@@ -47,7 +47,8 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
                       cd_ref, ci_ref, *, lc: int, bins: int, metric: str,
                       precision):
     scale = scale_ref[0, 0]
-    for l in range(lc):
+
+    def one_list(l):
         q = qsub_ref[l]                                  # (cap, dim)
         y = data_ref[l]                                  # (ML, dim)
         ml = y.shape[0]
@@ -91,6 +92,17 @@ def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
         ci = jnp.where(ci == _BIG_I32, -1, ci)
         cd_ref[l] = cd.astype(cd_ref.dtype)
         ci_ref[l] = ci
+
+    # lc > 1 iterates via fori_loop so the Mosaic program stays ONE
+    # list-body regardless of lc — a Python loop here unrolls lc
+    # matmul+epilogue copies into the kernel, and that unbounded
+    # program growth is the prime suspect in the 2026-08-01 75-minute
+    # remote-compile hang (VERDICT r3). lc == 1 stays loop-free (the
+    # structurally simplest fallback tier).
+    if lc == 1:
+        one_list(0)
+    else:
+        jax.lax.fori_loop(0, lc, lambda l, c: (one_list(l), c)[1], 0)
 
 
 @functools.partial(jax.jit, static_argnames=("bins", "lc", "metric",
@@ -139,36 +151,29 @@ def _list_scan_call(qsub, data, norms, ids, bins: int, lc: int,
     return cd, ci
 
 
-_LC_ENV = None
-
-
-def _lc_env() -> int:
-    """``RAFT_TPU_IVF_LC`` resolved once per process (see ``_pick_lc``)."""
-    global _LC_ENV
-    if _LC_ENV is None:
-        import os
-        _LC_ENV = int(os.environ.get("RAFT_TPU_IVF_LC", "0"))
-    return _LC_ENV
+def lc_mode() -> int:
+    """Resolve the ``RAFT_TPU_IVF_LC`` override OUTSIDE jit (the
+    ``gather_mode()`` contract): callers thread the value through the
+    fused searches as a static argument, so the jit cache keys on it
+    and an in-process env flip takes effect on the next call instead of
+    silently re-executing the first-compiled program. 0 = auto."""
+    import os
+    return int(os.environ.get("RAFT_TPU_IVF_LC", "0"))
 
 
 def _pick_lc(n_lists: int, max_list: int, cap: int, dim: int,
-             itemsize: int) -> int:
+             itemsize: int, override: int = 0) -> int:
     """Lists per grid cell: enough to amortize per-step overhead while
     the (LC·max_list·dim) data block + score blocks stay well under the
     VMEM cap (double-buffered).
 
-    ``RAFT_TPU_IVF_LC`` overrides: ``1`` = grid-per-list, the PQ
-    kernel's structure and a ~lc×-smaller Mosaic program — the A/B knob
-    for the 2026-08-01 remote-compiler death whose prime suspect is
-    this kernel's Python-unrolled list loop
-    (tools/ivf_compile_bisect.py). Read ONCE, at first use: this runs
-    at trace time inside the jitted fused search and the jit cache does
-    not key on it, so an in-process env flip after a search has
-    compiled would silently re-execute the old program — set it before
-    the first search (the bisect ladder runs one process per value)."""
-    env = _lc_env()
-    if env > 0:
-        lc = min(env, n_lists)
+    ``override`` > 0 pins the value (snapped down to a divisor of
+    n_lists) — resolved from ``RAFT_TPU_IVF_LC`` by ``lc_mode()`` at
+    the public search entries and threaded here statically. ``1`` =
+    grid-per-list: the PQ kernel's structure, loop-free kernel body,
+    the compile-budget ladder's middle tier."""
+    if override > 0:
+        lc = min(override, n_lists)
         while n_lists % lc:
             lc -= 1
         return lc
@@ -177,8 +182,9 @@ def _pick_lc(n_lists: int, max_list: int, cap: int, dim: int,
                 + max_list * cap * 4               # score block
                 + max_list * (4 + 4))              # norms + ids
     budget = _VMEM_LIMIT // 3
-    # ≤ 8: the kernel body Python-unrolls lc list iterations — VMEM is
-    # not the only bound, Mosaic program size is too
+    # ≤ 8 bounds the grid-step working set; the kernel body itself is
+    # lc-independent now (fori_loop), so this is a VMEM/pipelining
+    # knob, not a program-size one
     lc = max(1, min(8, budget // max(per_list, 1)))
     while n_lists % lc:
         lc -= 1
@@ -240,15 +246,17 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
                          probes, k: int, cap: int, scale=1.0,
                          bins: int = 0, sqrt: bool = False,
                          metric: str = "l2", gather: str = "",
-                         internal_dtype=None):
+                         internal_dtype=None, lc: int = 0):
     """Fused list-major IVF-Flat fine scan + merge.
 
     ``queries`` (nq, dim) f32; ``lists_data`` (n_lists, max_list, dim)
     f32/bf16/int8; ``probes`` (nq, n_probes) int32; ``cap`` the inverted
     table width (``_ivf_scan.probe_cap``). ``bins``: see ``_Layout``.
     ``metric``: "l2" (squared, ``sqrt`` optional) or "ip" (returns
-    NEGATED similarities, ascending — callers postprocess). Returns
-    (dists (nq, k), ids (nq, k)) sorted best-first.
+    NEGATED similarities, ascending — callers postprocess). ``lc``:
+    lists per grid cell, 0 = auto (callers resolve ``lc_mode()``
+    outside jit). Returns (dists (nq, k), ids (nq, k)) sorted
+    best-first.
     """
     nq, dim = queries.shape
     n_lists, max_list = lists_indices.shape
@@ -264,7 +272,7 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
     qsub = gather_query_rows(queries, lay.padded_qmap(), mode=gather)
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim,
-                  lists_data.dtype.itemsize)
+                  lists_data.dtype.itemsize, override=lc)
     # internal_dtype: candidate-block dtype carried to the merge (the
     # IVF-PQ internal_distance_dtype role) — bf16 halves the kernel's
     # HBM writeback+readback; the merge re-ranks in f32 either way
@@ -286,9 +294,11 @@ def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
 
     Estimator: ``est = ||q_l||² + ||r||² − 2·s·⟨q_l, sign(r)⟩``
     (see ivf_bq.py). Shift/mask unpack loops over the w ≤ dim/32 words
-    in Python — w is 4 at d=128, so the unroll stays tiny.
+    in Python — w is 4 at d=128, so that unroll stays tiny; the list
+    loop is a fori_loop like ``_list_scan_kernel``'s (program size
+    must not scale with lc).
     """
-    for l in range(lc):
+    def one_list(l):
         q = qsub_ref[l]                                  # (cap, dim) f32
         words = bits_ref[l]                              # (ML, w) int32
         ml = words.shape[0]
@@ -336,6 +346,11 @@ def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
         cd_ref[l] = cd.astype(cd_ref.dtype)
         ci_ref[l] = ci
 
+    if lc == 1:
+        one_list(0)
+    else:
+        jax.lax.fori_loop(0, lc, lambda l, c: (one_list(l), c)[1], 0)
+
 
 @functools.partial(jax.jit, static_argnames=("bins", "lc", "dim",
                                              "interpret", "metric"))
@@ -380,7 +395,8 @@ def _bq_scan_call(qsub, bits_i32, norms2, scales, ids, bins: int,
 def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                        lists_indices, probes, k: int, cap: int,
                        bins: int = 0, sqrt: bool = False,
-                       gather: str = "", metric: str = "l2"):
+                       gather: str = "", metric: str = "l2",
+                       lc: int = 0):
     """Fused Pallas fine phase for ivf_bq: probe inversion + per-list
     query gather (rotated; center-offset for the l2 core) + the in-VMEM
     unpack scan + the shared candidate merge. Mirrors
@@ -398,7 +414,7 @@ def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
     qg = gather_query_rows(q_rot, lay.padded_qmap(), mode=gather)
     qsub = qg if metric == "ip" else qg - centers_rot[:, None, :]
     # VMEM: the unpacked (ML, dim) bf16 tile + (ML, cap) scores dominate
-    lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim, 2)
+    lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim, 2, override=lc)
     cd, ci = _bq_scan_call(qsub, bits_i32, norms2, scales,
                            lists_indices, lay.bins, lc, dim,
                            pallas_interpret(), metric=metric)

@@ -61,6 +61,14 @@ q = jax.random.normal(jax.random.fold_in(key, 2), (NQ, D))
 jax.block_until_ready((db, q))
 
 
+def tier_report():
+    """Print the compile-budget ladder outcomes: a fused/chained step
+    that served from a fallback tier still NAMES the parked culprit."""
+    from raft_tpu.ops.compile_budget import snapshot
+    for ladder, tiers in snapshot().items():
+        print(f"[bisect] tiers {ladder}: {tiers}", flush=True)
+
+
 def step(name, fn):
     print(f"[bisect] submitting: {name}", flush=True)
     t0 = time.perf_counter()
@@ -140,6 +148,7 @@ if FAMILY == "pq":
         scan_mode="codes" if use_pallas else "reconstruct")
     step("pq fused", lambda: ivf_pq.search(idx, q, K, sp))
     run_chained("pq ", lambda qb: ivf_pq.search(idx, qb, K, sp))
+    tier_report()
     raise SystemExit(0)
 elif FAMILY == "bq":
     from raft_tpu.neighbors import ivf_bq
@@ -172,6 +181,7 @@ elif FAMILY == "bq":
     sp = ivf_bq.SearchParams(n_probes=NPROBES, probe_cap=cap)
     step("bq fused", lambda: ivf_bq.search(idx, q, K, sp))
     run_chained("bq ", lambda qb: ivf_bq.search(idx, qb, K, sp))
+    tier_report()
     raise SystemExit(0)
 elif FAMILY != "flat":
     raise SystemExit(f"FAMILY={FAMILY!r}: want flat|pq|bq")
@@ -195,7 +205,7 @@ qsub = step("gather", lambda: jax.jit(
 if use_pallas:
     # the Pallas kernel alone, at the exact fused-path layout
     from raft_tpu.ops.pallas_ivf_scan import (_Layout, _list_scan_call,
-                                              _pick_lc)
+                                              _pick_lc, lc_mode)
 
     lay = _Layout(probes, NLISTS, max_list, cap, 0, K)
     data_p = lay.pad_lists(idx.lists_data, max_list)
@@ -203,7 +213,8 @@ if use_pallas:
     ids_p = lay.pad_lists(idx.lists_indices, max_list, fill=-1)
     qsub_p = jax.jit(lambda qq, qm: S.gather_query_rows(qq, qm))(
         q, lay.padded_qmap())
-    lc = _pick_lc(NLISTS, lay.mlp, lay.capp, D, data_p.dtype.itemsize)
+    lc = _pick_lc(NLISTS, lay.mlp, lay.capp, D, data_p.dtype.itemsize,
+                  override=lc_mode())
     print(f"[bisect] bins={lay.bins} lc={lc}", flush=True)
 
     cd, ci = step("scan", lambda: _list_scan_call(
@@ -218,3 +229,4 @@ else:
 sp = ivf_flat.SearchParams(n_probes=NPROBES, probe_cap=cap)
 step("fused", lambda: ivf_flat.search(idx, q, K, sp))
 run_chained("", lambda qb: ivf_flat.search(idx, qb, K, sp))
+tier_report()
