@@ -181,21 +181,29 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         norms2 = jnp.sum(r * r, axis=1)
         scales = jnp.mean(jnp.abs(r), axis=1)
         words = _pack_bits(r)
-        # bucketize one combined payload: word bit-patterns ride as f32
-        # bitcasts (never computed on), norms/scales as plain columns
+        # bucketize one combined INT32 payload (word bit-patterns +
+        # bitcast norm/scale columns): int32 has no canonicalization
+        # hazard, unlike f32 whose NaN-patterned bitcasts XLA may
+        # rewrite in concatenate/gather/scatter (ADVICE r3 #2); the
+        # squared-norm pass over the payload is skipped outright
         from raft_tpu.neighbors.ivf_flat import _bucketize
         payload = jnp.concatenate(
-            [lax.bitcast_convert_type(words, jnp.float32),
-             norms2[:, None], scales[:, None]], axis=1)
+            [lax.bitcast_convert_type(words, jnp.int32),
+             lax.bitcast_convert_type(norms2[:, None], jnp.int32),
+             lax.bitcast_convert_type(scales[:, None], jnp.int32)],
+            axis=1)
         bucketed, idx, _, counts = _bucketize(payload, labels,
-                                              params.n_lists)
+                                              params.n_lists,
+                                              compute_norms=False)
         w = words.shape[1]
         bits = lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32)
         raw = np.asarray(jax.device_get(x)) if params.keep_raw else None
     return Index(centers=centers, centers_rot=centers @ rot.T,
                  rotation_matrix=rot, bits=bits,
-                 norms2=bucketed[:, :, w],
-                 scales=bucketed[:, :, w + 1],
+                 norms2=lax.bitcast_convert_type(bucketed[:, :, w],
+                                                 jnp.float32),
+                 scales=lax.bitcast_convert_type(bucketed[:, :, w + 1],
+                                                 jnp.float32),
                  lists_indices=idx, list_sizes=counts,
                  metric=params.metric, size=n, raw=raw)
 
@@ -295,26 +303,34 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
     old_labels = jnp.broadcast_to(
         jnp.arange(n_lists, dtype=jnp.int32)[:, None],
         (n_lists, ml)).reshape(-1)[valid]
+    # int32 payload end-to-end (see build): bit words never ride as f32
     old_payload = jnp.concatenate(
-        [lax.bitcast_convert_type(index.bits, jnp.float32)
+        [lax.bitcast_convert_type(index.bits, jnp.int32)
          .reshape(-1, w)[valid],
-         index.norms2.reshape(-1)[valid][:, None],
-         index.scales.reshape(-1)[valid][:, None]], axis=1)
+         lax.bitcast_convert_type(
+             index.norms2.reshape(-1)[valid][:, None], jnp.int32),
+         lax.bitcast_convert_type(
+             index.scales.reshape(-1)[valid][:, None], jnp.int32)],
+        axis=1)
     old_ids = index.lists_indices.reshape(-1)[valid]
 
     new_labels = kmeans_balanced.predict(x, index.centers, res=res)
     r = (x - index.centers[new_labels]) @ index.rotation_matrix.T
     new_payload = jnp.concatenate(
-        [lax.bitcast_convert_type(_pack_bits(r), jnp.float32),
-         jnp.sum(r * r, axis=1)[:, None],
-         jnp.mean(jnp.abs(r), axis=1)[:, None]], axis=1)
+        [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
+         lax.bitcast_convert_type(
+             jnp.sum(r * r, axis=1)[:, None], jnp.int32),
+         lax.bitcast_convert_type(
+             jnp.mean(jnp.abs(r), axis=1)[:, None], jnp.int32)],
+        axis=1)
 
     from raft_tpu.neighbors.ivf_flat import _bucketize
     payload = jnp.concatenate([old_payload, new_payload], axis=0)
     labels = jnp.concatenate([old_labels, new_labels])
     ids = jnp.concatenate([old_ids, new_ids])
     bucketed, idx, _, counts = _bucketize(payload, labels, n_lists,
-                                          row_ids=ids)
+                                          row_ids=ids,
+                                          compute_norms=False)
     raw = None
     if index.raw is not None:
         raw = np.concatenate([index.raw,
@@ -323,7 +339,9 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
         centers=index.centers, centers_rot=index.centers_rot,
         rotation_matrix=index.rotation_matrix,
         bits=lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32),
-        norms2=bucketed[:, :, w], scales=bucketed[:, :, w + 1],
+        norms2=lax.bitcast_convert_type(bucketed[:, :, w], jnp.float32),
+        scales=lax.bitcast_convert_type(bucketed[:, :, w + 1],
+                                        jnp.float32),
         lists_indices=idx, list_sizes=counts, metric=index.metric,
         size=index.size + n_new, raw=raw)
 
@@ -375,7 +393,10 @@ def finish_search(d_est, ids, raw, q, k: int,
     similarities, cosine → 1 − cos, L2Sqrt → euclidean)."""
     from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
     kind = _metric_kind(metric)
-    sqrt = metric == DistanceType.L2SqrtExpanded
+    # both Sqrt metrics: ivf_pq routes through here too and supports
+    # L2SqrtUnexpanded (r4 review finding)
+    sqrt = metric in (DistanceType.L2SqrtExpanded,
+                      DistanceType.L2SqrtUnexpanded)
     if not rescore:
         d_est, ids = d_est[:, :k], ids[:, :k]
         if sqrt:
@@ -425,6 +446,15 @@ def search(index: Index, queries, k: int,
         q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
                             1e-30)
     n_probes = min(params.n_probes, index.n_lists)
+    # mirror the n_probes/probe_cap validation style: a negative value
+    # would bypass the auto-bins branch ('or' catches only 0) and fail
+    # deep in the scan with an opaque reshape error (ADVICE r3 #4)
+    expects(params.scan_bins >= 0,
+            "ivf_bq.search: scan_bins must be >= 0 (0 = auto), got %d",
+            params.scan_bins)
+    expects(params.rescore_factor >= 0,
+            "ivf_bq.search: rescore_factor must be >= 0, got %d",
+            params.rescore_factor)
     rescore = params.rescore_factor > 0 and index.raw is not None
     # rescore_factor shapes the DEVICE phase (candidate count) whether
     # or not raw vectors exist — so an estimator-only index (or a bench

@@ -133,15 +133,20 @@ def _coarse_scores(queries, centers, kind: str):
     return _l2_expanded(queries, centers, sqrt=False)
 
 
-@functools.partial(jax.jit, static_argnames=("n_lists", "max_list"))
+@functools.partial(jax.jit, static_argnames=("n_lists", "max_list",
+                                             "compute_norms"))
 def _bucketize_static(x, labels, row_ids, n_lists: int, max_list: int,
-                      counts=None):
+                      counts=None, compute_norms: bool = True):
     """jit-safe core of :func:`_bucketize`: scatter rows into padded
     per-list buckets of a caller-chosen static width. ``row_ids`` are
     the ids stored for each row (global ids in sharded builds); rows
     whose list position overflows ``max_list`` are dropped (cannot
     happen when max_list ≥ the true max count). ``counts`` may be
-    passed by callers that already computed the per-list totals."""
+    passed by callers that already computed the per-list totals.
+    ``compute_norms=False`` (integer bit-payloads — ivf_bq) skips the
+    squared-norm pass and returns ``norms=None``: payloads that are
+    not real numbers must ride as int32, never as f32 bitcasts whose
+    NaN patterns XLA may canonicalize (ADVICE r3 #2)."""
     n, dim = x.shape
     if counts is None:
         counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
@@ -160,13 +165,15 @@ def _bucketize_static(x, labels, row_ids, n_lists: int, max_list: int,
                                 mode="drop")
     data = data[:-1].reshape(n_lists, max_list, dim)
     idx = idx[:-1].reshape(n_lists, max_list)
+    if not compute_norms:
+        return data, idx, None, counts
     norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
     norms = jnp.where(idx >= 0, norms, 0.0)
     return data, idx, norms, counts
 
 
 def _bucketize(x, labels, n_lists: int, round_to: int = 8,
-               row_ids=None):
+               row_ids=None, compute_norms: bool = True):
     """Scatter rows into padded per-list buckets — static-shape layout.
     The bucket width is sized from the observed max count (one host
     sync); sharded builds pre-agree a width and call the static core.
@@ -180,7 +187,8 @@ def _bucketize(x, labels, n_lists: int, round_to: int = 8,
     if row_ids is None:
         row_ids = jnp.arange(n, dtype=jnp.int32)
     data, idx, norms, counts = _bucketize_static(
-        x, labels, row_ids, n_lists, max_list, counts=counts)
+        x, labels, row_ids, n_lists, max_list, counts=counts,
+        compute_norms=compute_norms)
     return data, idx, norms, counts
 
 

@@ -36,6 +36,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from raft_tpu.core import trace
@@ -71,6 +72,11 @@ class IndexParams:
     force_random_rotation: bool = False
     # Pallas matmul tier for the balanced-EM trainer (docs/tuning.md)
     kmeans_kernel_precision: object = None
+    # keep the raw f32 vectors on HOST for exact rescoring
+    # (SearchParams.rescore_factor — the refine.cuh role fused into
+    # search, the ivf_bq pattern). The device never stores them; an
+    # estimator-only index stays pq_dim+8 bytes/vector
+    keep_raw: bool = False
 
 
 @dataclass
@@ -92,6 +98,16 @@ class SearchParams:
     #           persists an ~8x cache over the codes);
     # "lut" = per-probe f32 LUT + gather scan (the CUDA formulation)
     scan_mode: str = "auto"
+    # rescore_factor·k estimator candidates re-ranked EXACTLY against
+    # the host-resident raw vectors (requires keep_raw=True at build;
+    # the reference's refine.cuh step fused into search, the ivf_bq
+    # pattern). PQ distances are estimates — the codebook quantization
+    # error, not the probe set, limits recall at high probes — so the
+    # ≥0.9-recall operating points run with rescoring. 0 disables
+    # (estimator distances returned). Like ivf_bq, a factor > 0 shapes
+    # the DEVICE phase (kk = factor·k candidates) even without raw, so
+    # benches chain the true serving program.
+    rescore_factor: int = 0
     # "probe"/"list"/"auto" — see ivf_flat.SearchParams.scan_order;
     # list-major applies to the reconstruct scan only
     scan_order: str = "auto"
@@ -133,6 +149,9 @@ class Index:
     # quantized books so the L2 epilogue matches what the kernel decodes
     # (lazy, like decoded)
     code_norms_fp8: Optional[jax.Array] = None
+    # raw f32 vectors on HOST (keep_raw builds), indexed by global id —
+    # the exact-rescore corpus (ivf_bq.Index.raw role)
+    raw: Optional["np.ndarray"] = None
     # measured inverted-table widths keyed (nq, n_probes) — see
     # _ivf_scan.resolve_cap (not index identity; not serialized)
     cap_cache: dict = dataclasses_field(default_factory=dict, repr=False,
@@ -393,7 +412,9 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
                      metric=params.metric, pq_bits=params.pq_bits, size=n,
                      codebook_kind=CodebookGen.PER_CLUSTER,
                      code_norms=_code_norms_per_cluster(codes_b, books,
-                                                        idx))
+                                                        idx),
+                     raw=(np.asarray(jax.device_get(x))
+                          if params.keep_raw else None))
 
     n_cb_train = min(n, 1 << 16)
     if n_cb_train < n:
@@ -419,7 +440,9 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
                  rotation_matrix=rot, pq_centers=pq_centers, codes=codes_b,
                  lists_indices=idx, list_sizes=counts, metric=params.metric,
                  pq_bits=params.pq_bits, size=n,
-                 code_norms=_code_norms(codes_b, pq_centers, idx))
+                 code_norms=_code_norms(codes_b, pq_centers, idx),
+                 raw=(np.asarray(jax.device_get(x))
+                      if params.keep_raw else None))
 
 
 def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
@@ -439,6 +462,11 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
     expects(bool((new_ids >= 0).all()),
             "ivf_pq.extend: new_indices must be non-negative (negative "
             "ids are the padding sentinel)")
+    # the host rescore indexes `raw` BY global id — custom ids would
+    # misalign it (the ivf_bq.extend contract)
+    expects(index.raw is None or new_indices is None,
+            "ivf_pq.extend: custom new_indices are only supported on "
+            "keep_raw=False indexes (raw rescore rows are id-indexed)")
 
     labels = kmeans_balanced.predict(x, index.centers, res=res)
     residuals_rot = jnp.matmul(x - index.centers[labels],
@@ -479,7 +507,10 @@ def extend(index: Index, new_vectors, new_indices=None, res=None) -> Index:
                  metric=index.metric, pq_bits=index.pq_bits,
                  size=n_old + n_new,
                  codebook_kind=index.codebook_kind,
-                 code_norms=norms_fn(codes_b, index.pq_centers, idx))
+                 code_norms=norms_fn(codes_b, index.pq_centers, idx),
+                 raw=(np.concatenate(
+                     [index.raw, np.asarray(jax.device_get(x))])
+                     if index.raw is not None else None))
 
 
 @jax.jit
@@ -754,6 +785,35 @@ def search(index: Index, queries, k: int,
     kind = _metric_kind(index.metric)
     per_cluster = index.codebook_kind == CodebookGen.PER_CLUSTER
 
+    # exact re-ranking (SearchParams.rescore_factor): the device phase
+    # returns kk = factor·k estimator candidates; the epilogue re-ranks
+    # them against the host raw corpus (ivf_bq.finish_search — shared
+    # so the exact-rescore semantics stay identical across families)
+    expects(params.rescore_factor >= 0,
+            "ivf_pq.search: rescore_factor must be >= 0")
+    rescoring = params.rescore_factor > 0 and index.raw is not None
+    kk = max(params.rescore_factor, 1) * k
+    # sqrt/output conventions move to the epilogue when it is not the
+    # legacy slice (finish_search applies them itself)
+    dev_sqrt = sqrt if (kk == k and not rescoring) else False
+
+    def _epilogue(d, i):
+        if kk == k and not rescoring:
+            return _postprocess(d, index.metric), i
+        from raft_tpu.neighbors.ivf_bq import finish_search
+        return finish_search(d, i, index.raw, q, k, metric=index.metric,
+                             rescore=rescoring)
+
+    # candidate bins: when rescoring widens kk, the per-list 4·k auto
+    # rule (pallas_ivf_scan._Layout) would blow the merge width
+    # (n_probes·4·kk-wide selects, ~0.5 GB candidate blocks at the
+    # bench point) — switch to the ivf_bq global-pool rule: a
+    # 32×-oversampled pool spread over the probed lists, floor 128
+    bins = params.scan_bins
+    if bins == 0 and kk > k:
+        max_list = index.codes.shape[1]
+        bins = min(max(128, (32 * kk) // max(n_probes, 1)), max_list)
+
     def _norms(idx_):
         if idx_.code_norms is None:
             fn = (_code_norms_per_cluster if per_cluster else _code_norms)
@@ -807,9 +867,9 @@ def search(index: Index, queries, k: int,
         return _ivf_scan.fused_reconstruct_list_search(
             q, index.centers, index.centers_rot,
             index.rotation_matrix, index.decoded,
-            index.decoded_norms, index.lists_indices, k=k,
-            n_probes=n_probes, cap=cap, bins=params.scan_bins,
-            sqrt=sqrt)
+            index.decoded_norms, index.lists_indices, k=kk,
+            n_probes=n_probes, cap=cap, bins=bins,
+            sqrt=dev_sqrt)
 
     def _recon_probe():
         """Probe-major reconstruct scan — small per-probe programs,
@@ -819,7 +879,7 @@ def search(index: Index, queries, k: int,
             q, index.centers, index.centers_rot,
             index.rotation_matrix, index.decoded,
             index.decoded_norms, index.lists_indices,
-            k, n_probes, sqrt, kind=kind)
+            kk, n_probes, dev_sqrt, kind=kind)
 
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
@@ -852,9 +912,9 @@ def search(index: Index, queries, k: int,
                 return _fused_code_search(
                     q, index.centers, index.centers_rot,
                     index.rotation_matrix, index.pq_centers, index.codes,
-                    code_norms, index.lists_indices, k=k,
-                    n_probes=n_probes, cap=cap, bins=params.scan_bins,
-                    sqrt=sqrt, kind=kind, lut_dtype=params.lut_dtype,
+                    code_norms, index.lists_indices, k=kk,
+                    n_probes=n_probes, cap=cap, bins=bins,
+                    sqrt=dev_sqrt, kind=kind, lut_dtype=params.lut_dtype,
                     internal_dtype=params.internal_distance_dtype,
                     per_cluster=per_cluster,
                     gather=_ivf_scan.gather_mode())
@@ -870,16 +930,16 @@ def search(index: Index, queries, k: int,
             tiers.append(("reconstruct_probe_major", _recon_probe))
             # key covers every program-shaping static (see
             # ivf_flat.search)
-            shape_key = (f"ivf_pq[{q.shape[0]}x{index.dim},k={k},"
+            shape_key = (f"ivf_pq[{q.shape[0]}x{index.dim},k={kk},"
                          f"p={n_probes},cap={cap},L={index.n_lists},"
                          f"pq={index.pq_dim}x{index.pq_bits}b,"
-                         f"{kind},sqrt={sqrt},b={params.scan_bins},"
+                         f"{kind},sqrt={dev_sqrt},b={bins},"
                          f"lut={jnp.dtype(params.lut_dtype).name},"
                          f"idt={jnp.dtype(params.internal_distance_dtype).name},"
                          f"pc={per_cluster},"
                          f"g={_ivf_scan.gather_mode()}]")
             d, i = run_tiers(shape_key, tiers)
-        return _postprocess(d, index.metric), i
+        return _epilogue(d, i)
     if scan_mode == "reconstruct":
         with trace.range("ivf_pq::search(reconstruct)"):
             nq = q.shape[0]
@@ -889,13 +949,11 @@ def search(index: Index, queries, k: int,
                              or (params.scan_order == "auto"
                                  and list_order_auto(nq, n_probes,
                                                      index.n_lists))))
-            if use_list:
-                return _recon_list()
-            d, i = _recon_probe()
-        return _postprocess(d, index.metric), i
+            d, i = _recon_list() if use_list else _recon_probe()
+        return _epilogue(d, i)
     with trace.range("ivf_pq::search(lut)"):
         d, i = _search_impl(q, index.centers, index.centers_rot,
                             index.rotation_matrix, index.pq_centers,
-                            index.codes, index.lists_indices, k, n_probes,
-                            sqrt, kind=kind, per_cluster=per_cluster)
-    return _postprocess(d, index.metric), i
+                            index.codes, index.lists_indices, kk, n_probes,
+                            dev_sqrt, kind=kind, per_cluster=per_cluster)
+    return _epilogue(d, i)

@@ -434,22 +434,38 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
                     per_cluster: bool):
     """One IVF list per grid cell, scored straight from its u8 codes.
 
-    Decode is one-hot × codebook on the MXU, **lanes-major over list
-    rows**: per subquantizer, ``oh = (iota == codes_s)`` is a
-    (n_codes, ML) mask and ``books_sᵀ @ oh`` a (pq_len, ML) decode strip
-    — the wide axis (ML) rides the lanes, so the narrow pq_len only pads
-    sublanes. The strips concatenate into the transient decode tile
-    dec_t (rot_dim, ML) that lives and dies in VMEM (the reference's
-    smem-LUT property, ivf_pq_search.cuh:593), and ONE K=rot_dim matmul
-    scores all probing queries against it.
+    Decode is ONE one-hot × codebook matmul on the MXU, lanes-major
+    over list rows: the codes arrive pre-transposed (pq_dim, ML), one
+    vectorized compare builds the stacked one-hot
+    ``oh[(s, c), m] = (codes_t[s, m] == c)`` of shape (pq_dim·C, ML),
+    and the BLOCK-DIAGONAL codebook matrix ``B`` (rot_dim, pq_dim·C) —
+    built once outside the kernel, ``B[s·pl:(s+1)·pl, s·C:(s+1)·C] =
+    books[s]ᵀ`` — decodes every subspace in a single K = pq_dim·C
+    matmul: ``dec_t = B @ oh`` (rot_dim, ML). Each dec row still
+    selects exactly ONE codeword entry (the off-block zeros contribute
+    nothing), so values are bit-identical to a per-subspace gather; the
+    formulation trades the old pq_dim-unrolled strip loop (a Mosaic
+    program that GREW with pq_dim — the r3 compile-hazard class, and
+    ~3% MXU utilization at M = pq_len) for one fully-utilized matmul.
+    The decode tile lives and dies in VMEM (the reference's smem-LUT
+    property, ivf_pq_search.cuh:593) and ONE K = rot_dim matmul scores
+    all probing queries against it.
+
+    PER_CLUSTER: the cell's single codebook (C, pl) is shared across
+    subspaces, so block-diagonal stacking would need a per-list B.
+    Instead the one-hot stacks on the LANE axis — ``oh2`` (C,
+    pq_dim·ML) from the flattened codes — and ``bookᵀ @ oh2`` decodes
+    all subspaces at once into (pl, pq_dim·ML) ≡ p-major rows
+    (pl·pq_dim, ML); the probing queries arrive pre-permuted to the
+    matching p-major column order (``_PER_CLUSTER_PERM``), so scoring
+    needs no in-kernel transpose.
     """
-    q = qsub_ref[0]                                      # (cap, rot_dim)
+    q = qsub_ref[0]                                  # (cap, rot_dim)
     # codes arrive as i8 bitcast of the u8 store (1 B/code of HBM
-    # traffic); recover 0..255 with a mask after widening
-    codes = codes_ref[0].astype(jnp.int32) & 0xFF        # (ML, pq_dim)
-    ml = codes.shape[0]
+    # traffic), pre-transposed; recover 0..255 with a mask
+    codes_t = codes_ref[0].astype(jnp.int32) & 0xFF  # (pq_dim, ML)
+    ml = codes_t.shape[1]
     cap = q.shape[0]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (n_codes, ml), 0)
     # bf16 LUT = single MXU pass (the reference's fp16-LUT speed tier);
     # f32 LUT = HIGHEST-precision passes (its fp32 accuracy tier);
     # fp8 LUT (float8_e4m3fn) = books arrive fp8-quantized — half the
@@ -459,24 +475,32 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
     operand = jnp.float32 if f32_lut else jnp.bfloat16
     prec = jax.lax.Precision.HIGHEST if f32_lut else None
 
-    strips = []
-    for s in range(pq_dim):
-        oh = (iota == codes[:, s][None, :]).astype(operand)  # (C, ML)
-        # PER_CLUSTER: one codebook for this grid cell's list, shared
-        # across subspaces (the block is (1, C, pl)); PER_SUBSPACE: the
-        # s-th book of the global (pq_dim, C, pl) table
-        book_s = books_ref[0] if per_cluster else books_ref[s]
-        strips.append(jax.lax.dot_general(
-            book_s.astype(operand), oh,
-            (((0,), (0,)), ((), ())), precision=prec,
-            preferred_element_type=jnp.float32))         # (pq_len, ML)
-    dec_t = jnp.concatenate(strips, axis=0)              # (rot_dim, ML)
+    if per_cluster:
+        codes_flat = codes_t.reshape(1, pq_dim * ml)
+        iota = jax.lax.broadcasted_iota(
+            jnp.int32, (n_codes, pq_dim * ml), 0)
+        oh2 = (iota == codes_flat).astype(operand)   # (C, pq_dim·ML)
+        book = books_ref[0]                          # (C, pl)
+        dec_pm = jax.lax.dot_general(
+            book.astype(operand), oh2, (((0,), (0,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32)      # (pl, pq_dim·ML)
+        dec_t = dec_pm.reshape(pq_len * pq_dim, ml)  # p-major rows
+    else:
+        iota = jax.lax.broadcasted_iota(
+            jnp.int32, (pq_dim, n_codes, ml), 1)
+        oh = (iota == codes_t[:, None, :]).astype(operand)
+        oh2 = oh.reshape(pq_dim * n_codes, ml)
+        dec_t = jax.lax.dot_general(
+            books_ref[...].astype(operand), oh2, (((1,), (0,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32)      # (rot_dim, ML)
 
     ip = jax.lax.dot_general(
         q.astype(operand), dec_t.astype(operand),
         (((1,), (0,)), ((), ())), precision=prec,
-        preferred_element_type=jnp.float32)              # (cap, ML)
-    ids = ids_ref[0, 0]                                  # (ML,)
+        preferred_element_type=jnp.float32)          # (cap, ML)
+    ids = ids_ref[0, 0]                              # (ML,)
     ids_b = jnp.broadcast_to(ids[None, :], (cap, ml))
     if metric == "ip":
         d = jnp.where(ids_b >= 0, -ip, jnp.inf)
@@ -500,28 +524,34 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
 @functools.partial(jax.jit, static_argnames=("bins", "metric", "out_dtype",
                                              "lut_dtype", "interpret",
                                              "split", "per_cluster"))
-def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
+def _pq_scan_call(qsub, codes_t, norms, ids, books, bins: int,
                   interpret: bool, metric: str, lut_dtype,
                   out_dtype=jnp.float32, split: int = 1,
                   per_cluster: bool = False):
     """``split`` > 1: codes/norms/ids carry ``split`` sub-lists per
     original list (leading dim n_lists·split); the query blocks stay
     per-ORIGINAL-list and are shared across a list's sub-cells via the
-    index map — no duplicated HBM. ``per_cluster``: books are
-    (n_lists, C, pl) — each cell fetches its own list's codebook."""
+    index map — no duplicated HBM. ``codes_t`` arrives pre-transposed
+    (n_cells, pq_dim, sub_ml) u8. ``books``: PER_SUBSPACE — the
+    block-diagonal decode matrix (rot_dim, pq_dim·C), one shared block
+    fetched once; PER_CLUSTER — (n_lists, C, pl), each cell fetches its
+    own list's codebook (and ``qsub`` arrives p-major permuted, see
+    ``_pq_scan_kernel``)."""
     n_lists, cap, rot_dim = qsub.shape
-    n_cells, max_list = codes.shape[:2]
-    pq_dim = codes.shape[2]
-    n_codes, pq_len = books.shape[1], books.shape[2]
+    n_cells, pq_dim, max_list = codes_t.shape
+    if per_cluster:
+        n_codes, pq_len = books.shape[1], books.shape[2]
+        books_spec = pl.BlockSpec((1, n_codes, pq_len),
+                                  lambda g: (g // split, 0, 0))
+    else:
+        n_codes = books.shape[1] // pq_dim
+        pq_len = rot_dim // pq_dim
+        books_spec = pl.BlockSpec((rot_dim, pq_dim * n_codes),
+                                  lambda g: (0, 0))
     kern = functools.partial(
         _pq_scan_kernel, bins=bins, metric=metric, pq_dim=pq_dim,
         pq_len=pq_len, n_codes=n_codes,
         lut_dtype=jnp.dtype(lut_dtype), per_cluster=per_cluster)
-    books_spec = (pl.BlockSpec((1, n_codes, pq_len),
-                               lambda g: (g // split, 0, 0))
-                  if per_cluster else
-                  pl.BlockSpec((pq_dim, n_codes, pq_len),
-                               lambda g: (0, 0, 0)))
     # norms/ids carry a singleton middle axis (see _list_scan_call): the
     # 2-D (1, max_list) block put 1 in a Mosaic-constrained slot and
     # failed to lower on real TPU (r3 measurement)
@@ -532,7 +562,7 @@ def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
         grid=(n_cells,),
         in_specs=[pl.BlockSpec((1, cap, rot_dim),
                                lambda g: (g // split, 0, 0)),
-                  pl.BlockSpec((1, max_list, pq_dim), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((1, pq_dim, max_list), lambda g: (g, 0, 0)),
                   pl.BlockSpec((1, 1, max_list), lambda g: (g, 0, 0)),
                   pl.BlockSpec((1, 1, max_list), lambda g: (g, 0, 0)),
                   books_spec],
@@ -543,14 +573,16 @@ def _pq_scan_call(qsub, codes, norms, ids, books, bins: int,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT),
         cost_estimate=pl.CostEstimate(
-            flops=2 * n_cells * max_list * cap * rot_dim
-            + 2 * n_cells * max_list * n_codes * rot_dim,
+            # dec = B @ oh (K = pq_dim·C dense — the one-hot formulation
+            # pays C× the gather FLOPs to stay on the MXU) + the score
+            flops=2 * n_cells * max_list * rot_dim * pq_dim * n_codes
+            + 2 * n_cells * max_list * cap * rot_dim,
             bytes_accessed=(n_cells * max_list * pq_dim
                             + 4 * n_lists * cap * rot_dim
                             + 8 * n_cells * cap * bins),
             transcendentals=0),
         interpret=interpret,
-    )(qsub, jax.lax.bitcast_convert_type(codes, jnp.int8), norms3, ids3,
+    )(qsub, jax.lax.bitcast_convert_type(codes_t, jnp.int8), norms3, ids3,
       books)
     return cd, ci
 
@@ -606,25 +638,38 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
         # per-list probing queries, residual form: (n_lists, cap, rot_dim)
         qsub = qg - centers_rot[:, None, :]
 
-    # VMEM bound: per grid cell the one-hot (n_codes, sub_ml), decode
-    # tile (rot_dim, sub_ml) and score block (cap, sub_ml) all scale with
-    # the list length — split oversized lists into `split` sub-lists
-    # (extra grid cells sharing the list's probing queries) so skewed or
-    # low-n_lists indexes still compile (the old chunked path's
-    # decode-tile budget, per-row form).
-    if jnp.dtype(lut_dtype) == jnp.dtype(jnp.float8_e4m3fn):
-        # the fp8 tier quantizes the codebook STORAGE (kernel input);
-        # compute runs bf16. Callers must pass ``code_norms`` computed
-        # over the fp8-quantized books (ivf_pq.search caches that table)
-        # so the L2 epilogue stays self-consistent
-        pq_centers = pq_centers.astype(jnp.float8_e4m3fn)
-
     rot_dim = pq_dim * pq_len
-    # VMEM budget counts the COMPUTE operand width: the one-hot/decode
-    # strips run f32 (f32 LUT) or bf16 (bf16 AND fp8 LUT — fp8 shrinks
-    # only the shared books block, not the per-row transients)
-    op_item = 4 if jnp.dtype(lut_dtype) == jnp.dtype(jnp.float32) else 2
-    per_row = (n_codes * op_item + rot_dim * 4 + lay.capp * 4
+    fp8 = jnp.dtype(lut_dtype) == jnp.dtype(jnp.float8_e4m3fn)
+    f32_lut = jnp.dtype(lut_dtype) == jnp.dtype(jnp.float32)
+    operand = jnp.float32 if f32_lut else jnp.bfloat16
+    if per_cluster:
+        # per-list books ride full precision except the fp8 tier
+        # (storage quantization; compute upcasts to bf16 in-kernel)
+        books_in = (pq_centers.astype(jnp.float8_e4m3fn) if fp8
+                    else pq_centers)
+    else:
+        # PER_SUBSPACE: build the block-diagonal decode matrix ONCE —
+        # B[s·pl:(s+1)·pl, s·C:(s+1)·C] = books[s]ᵀ. Every dec row
+        # still selects exactly one codeword (off-block zeros), so the
+        # kernel's single K = pq_dim·C matmul is value-identical to
+        # per-subspace strips; stored in the compute operand dtype
+        # (fp8 for the fp8 tier — half the block's VMEM/HBM)
+        B = jnp.zeros((rot_dim, pq_dim * n_codes), jnp.float32)
+        for s in range(pq_dim):
+            B = jax.lax.dynamic_update_slice(
+                B, pq_centers[s].T, (s * pq_len, s * n_codes))
+        # fp8 tier: codebook STORAGE quantizes (callers pass code_norms
+        # computed over the fp8 books — ivf_pq.search caches that
+        # table — so the L2 epilogue stays self-consistent)
+        books_in = B.astype(jnp.float8_e4m3fn if fp8 else operand)
+
+    # VMEM bound: per grid cell the stacked one-hot (pq_dim·C, sub_ml),
+    # decode tile (rot_dim, sub_ml) and score block (cap, sub_ml) all
+    # scale with the list length — split oversized lists into `split`
+    # sub-lists (extra grid cells sharing the list's probing queries)
+    # so skewed or low-n_lists indexes still compile.
+    op_item = 4 if f32_lut else 2
+    per_row = (pq_dim * n_codes * op_item + rot_dim * 4 + lay.capp * 4
                + pq_dim * 4)
     row_budget = max(lay.bins, (_VMEM_LIMIT // 3) // per_row)
     split = -(-lay.mlp // _round_up(row_budget, lay.bins))
@@ -639,8 +684,20 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
     def as_sub(a):
         return a.reshape(n_lists * split, sub_ml, *a.shape[2:])
 
-    cd, ci = _pq_scan_call(qsub, as_sub(codes), as_sub(code_norms),
-                           as_sub(lists_indices), pq_centers, lay.bins,
+    if per_cluster:
+        # p-major column permutation matching the kernel's PER_CLUSTER
+        # decode-row order (see _pq_scan_kernel): column p·pq_dim + s
+        # reads the query's s·pl + p coordinate. Applied AFTER the ip
+        # correction below is computed from the unpermuted blocks.
+        perm = (jnp.arange(rot_dim) % pq_dim) * pq_len \
+            + jnp.arange(rot_dim) // pq_dim
+        qsub_k = qsub[..., perm]
+    else:
+        qsub_k = qsub
+
+    codes_t = jnp.swapaxes(as_sub(codes), 1, 2)   # (cells, pq_dim, sub_ml)
+    cd, ci = _pq_scan_call(qsub_k, codes_t, as_sub(code_norms),
+                           as_sub(lists_indices), books_in, lay.bins,
                            pallas_interpret(), metric=metric,
                            lut_dtype=lut_dtype,
                            out_dtype=internal_distance_dtype, split=split,

@@ -731,12 +731,18 @@ def distributed_ivf_bq_build(
         lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
         safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
         r = (x_loc - c[lbl]) @ rt.T
+        # int32 payload (see ivf_bq.build): bit words must not ride as
+        # f32 bitcasts — NaN-pattern canonicalization hazard
         payload = jnp.concatenate(
-            [lax.bitcast_convert_type(_pack_bits(r), jnp.float32),
-             jnp.sum(r * r, axis=1)[:, None],
-             jnp.mean(jnp.abs(r), axis=1)[:, None]], axis=1)
+            [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
+             lax.bitcast_convert_type(
+                 jnp.sum(r * r, axis=1)[:, None], jnp.int32),
+             lax.bitcast_convert_type(
+                 jnp.mean(jnp.abs(r), axis=1)[:, None], jnp.int32)],
+            axis=1)
         data, idx, _, _ = _bucketize_static(payload, lbl, safe_ids,
-                                            n_lists, ml)
+                                            n_lists, ml,
+                                            compute_norms=False)
         return data[None], idx[None]
 
     enc = jax.jit(jax.shard_map(
@@ -752,7 +758,10 @@ def distributed_ivf_bq_build(
     return DistributedIvfBq(
         centers=centers, centers_rot=centers @ rot.T,
         rotation_matrix=rot, parts_bits=bits,
-        parts_norms2=payload[..., w], parts_scales=payload[..., w + 1],
+        parts_norms2=lax.bitcast_convert_type(payload[..., w],
+                                              jnp.float32),
+        parts_scales=lax.bitcast_convert_type(payload[..., w + 1],
+                                              jnp.float32),
         parts_indices=pidx, metric=params.metric, size=n, mesh=mesh,
         axis=axis, raw=raw)
 

@@ -305,6 +305,80 @@ class TestIvfPq:
         r = recall(np.asarray(i2), iref)
         assert r > 0.95, f"refined ivf_pq recall {r}"
 
+    def test_rescore_in_search(self, dataset):
+        """SearchParams.rescore_factor: the refine step fused into
+        search (VERDICT r3 #4) — ≥0.95 recall at the refined gate's
+        operating point, never below the estimator."""
+        x, q = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_bits=8, pq_dim=8,
+                                    kmeans_n_iters=10, keep_raw=True)
+        index = ivf_pq.build(x, params)
+        assert index.raw is not None and index.raw.shape == x.shape
+        d0, i0 = ivf_pq.search(index, q, 10,
+                               ivf_pq.SearchParams(n_probes=16))
+        d8, i8 = ivf_pq.search(
+            index, q, 10,
+            ivf_pq.SearchParams(n_probes=16, rescore_factor=4))
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        _, iref = nn.kneighbors(q)
+        r0 = recall(np.asarray(i0), iref)
+        r8 = recall(np.asarray(i8), iref)
+        assert r8 > 0.95, f"rescored ivf_pq recall {r8}"
+        assert r8 >= r0 - 1e-9
+        # rescored distances are EXACT squared L2 of the returned ids
+        xs = np.asarray(x)
+        qs = np.asarray(q)
+        ex = np.sum((xs[np.asarray(i8[0])] - qs[0]) ** 2, axis=1)
+        np.testing.assert_allclose(np.asarray(d8[0]), ex, rtol=1e-4)
+
+    def test_rescore_sqrt_metric(self, dataset):
+        """Rescored distances honor BOTH Sqrt metrics (the epilogue is
+        finish_search, whose sqrt gate must cover L2SqrtUnexpanded)."""
+        from raft_tpu.distance.distance_types import DistanceType
+        x, q = dataset
+        index = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=32, pq_bits=8, pq_dim=8, kmeans_n_iters=10,
+            keep_raw=True, metric=DistanceType.L2SqrtUnexpanded))
+        d, i = ivf_pq.search(
+            index, q, 5,
+            ivf_pq.SearchParams(n_probes=16, rescore_factor=4))
+        xs, qs = np.asarray(x), np.asarray(q)
+        ex = np.sqrt(np.sum((xs[np.asarray(i[0])] - qs[0]) ** 2, axis=1))
+        np.testing.assert_allclose(np.asarray(d[0]), ex, rtol=1e-4)
+
+    def test_rescore_without_raw_is_estimator(self, dataset):
+        """factor > 0 on a keep_raw=False index shapes the device
+        phase but returns estimator values (the ivf_bq contract)."""
+        x, q = dataset
+        index = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=32, pq_bits=8, pq_dim=8, kmeans_n_iters=10))
+        assert index.raw is None
+        d, i = ivf_pq.search(
+            index, q, 10,
+            ivf_pq.SearchParams(n_probes=16, rescore_factor=4))
+        assert i.shape == (q.shape[0], 10)
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        _, iref = nn.kneighbors(q)
+        assert recall(np.asarray(i), iref) > 0.6
+
+    def test_rescore_keep_raw_extend(self, dataset):
+        x, q = dataset
+        index = ivf_pq.build(x[:3000], ivf_pq.IndexParams(
+            n_lists=32, pq_bits=8, pq_dim=8, kmeans_n_iters=10,
+            keep_raw=True))
+        index = ivf_pq.extend(index, x[3000:])
+        assert index.raw.shape == x.shape
+        d, i = ivf_pq.search(
+            index, q, 10,
+            ivf_pq.SearchParams(n_probes=16, rescore_factor=4))
+        nn = NearestNeighbors(n_neighbors=10).fit(x)
+        _, iref = nn.kneighbors(q)
+        assert recall(np.asarray(i), iref) > 0.9
+        # custom ids would misalign the id-indexed raw corpus
+        with pytest.raises(Exception):
+            ivf_pq.extend(index, x[:10],
+                          new_indices=np.arange(100, 110))
+
     def test_list_order_matches_probe_order(self, dataset):
         # same PQ approximation either way; near-ties may flip under the
         # two paths' different bf16 rounding order, so gate on overlap
@@ -633,6 +707,64 @@ class TestSerialize:
         d1, i1 = ivf_pq.search(idx, q, 5, sp)
         d2, i2 = ivf_pq.search(idx2, q, 5, sp)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_ivf_pq_raw_roundtrip(self, tmp_path):
+        """keep_raw indexes serialize the rescore corpus; include_raw=
+        False checkpoints the compact index only (ADVICE r3 #3 —
+        applies to the BQ saver too, same knob)."""
+        import numpy as np
+        import jax
+        from raft_tpu.neighbors import ivf_pq, serialize
+        key = jax.random.key(7)
+        db = jax.random.normal(key, (800, 32))
+        q = jax.random.normal(jax.random.fold_in(key, 1), (10, 32))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(
+            n_lists=8, kmeans_n_iters=4, keep_raw=True))
+        path = str(tmp_path / "pq_raw.npz")
+        serialize.save_ivf_pq(idx, path)
+        idx2 = serialize.load_ivf_pq(path)
+        assert idx2.raw is not None
+        np.testing.assert_array_equal(idx2.raw, idx.raw)
+        sp = ivf_pq.SearchParams(n_probes=4, rescore_factor=4)
+        d1, i1 = ivf_pq.search(idx, q, 5, sp)
+        d2, i2 = ivf_pq.search(idx2, q, 5, sp)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        lean = str(tmp_path / "pq_lean.npz")
+        serialize.save_ivf_pq(idx, lean, include_raw=False)
+        idx3 = serialize.load_ivf_pq(lean)
+        assert idx3.raw is None
+        import os
+        assert os.path.getsize(lean) < os.path.getsize(path)
+
+    def test_ivf_bq_include_raw_false(self, tmp_path):
+        import jax
+        from raft_tpu.neighbors import ivf_bq, serialize
+        key = jax.random.key(8)
+        db = jax.random.normal(key, (600, 32))
+        idx = ivf_bq.build(db, ivf_bq.IndexParams(n_lists=8,
+                                                  kmeans_n_iters=4))
+        assert idx.raw is not None  # keep_raw defaults True for bq
+        lean = str(tmp_path / "bq_lean.npz")
+        serialize.save_ivf_bq(idx, lean, include_raw=False)
+        idx2 = serialize.load_ivf_bq(lean)
+        assert idx2.raw is None
+        # estimator-only search still serves
+        d, i = ivf_bq.search(idx2, db[:5], 3,
+                             ivf_bq.SearchParams(n_probes=4))
+        assert i.shape == (5, 3)
+
+    def test_bq_scan_bins_validated(self):
+        import pytest
+        import jax
+        from raft_tpu.core.error import LogicError
+        from raft_tpu.neighbors import ivf_bq
+        key = jax.random.key(9)
+        db = jax.random.normal(key, (400, 16))
+        idx = ivf_bq.build(db, ivf_bq.IndexParams(n_lists=4,
+                                                  kmeans_n_iters=2))
+        with pytest.raises(LogicError):
+            ivf_bq.search(idx, db[:4], 3,
+                          ivf_bq.SearchParams(n_probes=2, scan_bins=-3))
 
     def test_wrong_format_rejected(self, tmp_path):
         import pytest
