@@ -32,6 +32,7 @@
 // loadable against the real plugin on TPU hosts.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -142,15 +143,110 @@ Buffer* find_buf(int64_t id) {
 
 }  // namespace
 
+// Parse a flat create-options spec into PJRT_NamedValues. Grammar:
+// entries split on ';', each "name=T:value" with T one of s (string),
+// i (int64), f (float), b (bool 0/1). Real plugins (libtpu, the axon
+// tunnel plugin) require options at PJRT_Client_Create — e.g. axon's
+// topology/session_id/remote_compile (its registration contract);
+// the flat spec keeps the ctypes ABI a single string. String storage
+// must outlive the call: the caller keeps `storage` alive.
+bool parse_create_options(const std::string& spec,
+                          std::vector<std::string>* storage,
+                          std::vector<PJRT_NamedValue>* out,
+                          std::string* bad) {
+  size_t pos = 0;
+  // two passes so `storage` never reallocates while NamedValues point
+  // into it: collect pieces first, then build the value structs
+  struct Piece { std::string name; char ty; std::string val; };
+  std::vector<Piece> pieces;
+  while (pos < spec.size()) {
+    size_t end = spec.find(';', pos);
+    if (end == std::string::npos) end = spec.size();
+    std::string entry = spec.substr(pos, end - pos);
+    pos = end + 1;
+    if (entry.empty()) continue;
+    size_t eq = entry.find('=');
+    if (eq == std::string::npos || eq + 2 >= entry.size() ||
+        entry[eq + 2] != ':') {
+      *bad = "bad option entry (want name=T:value): " + entry;
+      return false;
+    }
+    pieces.push_back({entry.substr(0, eq), entry[eq + 1],
+                      entry.substr(eq + 3)});
+  }
+  storage->reserve(storage->size() + 2 * pieces.size());
+  for (const auto& p : pieces) {
+    storage->push_back(p.name);
+    const std::string& name_ref = storage->back();
+    PJRT_NamedValue nv;
+    std::memset(&nv, 0, sizeof nv);
+    nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv.name = name_ref.c_str();
+    nv.name_size = name_ref.size();
+    nv.value_size = 1;
+    switch (p.ty) {
+      case 's': {
+        storage->push_back(p.val);
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = storage->back().c_str();
+        nv.value_size = storage->back().size();
+        break;
+      }
+      case 'i': {
+        char* endp = nullptr;
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = std::strtoll(p.val.c_str(), &endp, 10);
+        if (p.val.empty() || *endp != '\0') {
+          *bad = "bad int option value in: " + p.name + "=" + p.val;
+          return false;
+        }
+        break;
+      }
+      case 'f': {
+        char* endp = nullptr;
+        nv.type = PJRT_NamedValue_kFloat;
+        nv.float_value = std::strtof(p.val.c_str(), &endp);
+        if (p.val.empty() || *endp != '\0') {
+          *bad = "bad float option value in: " + p.name + "=" + p.val;
+          return false;
+        }
+        break;
+      }
+      case 'b':
+        nv.type = PJRT_NamedValue_kBool;
+        nv.bool_value = p.val != "0" && p.val != "false";
+        break;
+      default:
+        *bad = std::string("bad option type '") + p.ty +
+               "' (want s|i|f|b) in: " + p.name;
+        return false;
+    }
+    out->push_back(nv);
+  }
+  return true;
+}
+
 extern "C" {
 
-int rtp_abi_version() { return 1; }
+int rtp_abi_version() { return 2; }
+
+int64_t rtp_resources_create_opts(const char* plugin_path,
+                                  const char* options_spec, char* err,
+                                  int errlen);
 
 // Create: dlopen the plugin, GetPjrtApi, Plugin_Initialize,
-// Client_Create, enumerate addressable devices. Returns id > 0, or 0
-// with *err filled.
+// Client_Create (no options), enumerate addressable devices. Returns
+// id > 0, or 0 with *err filled.
 int64_t rtp_resources_create(const char* plugin_path, char* err,
                              int errlen) {
+  return rtp_resources_create_opts(plugin_path, "", err, errlen);
+}
+
+// As rtp_resources_create, with client create-options (see
+// parse_create_options for the spec grammar).
+int64_t rtp_resources_create_opts(const char* plugin_path,
+                                  const char* options_spec, char* err,
+                                  int errlen) {
   Resources r;
   r.dl = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
   if (!r.dl) {
@@ -181,9 +277,22 @@ int64_t rtp_resources_create(const char* plugin_path, char* err,
       return 0;
     }
   }
+  std::vector<std::string> opt_storage;
+  std::vector<PJRT_NamedValue> opts;
+  {
+    std::string bad;
+    if (!parse_create_options(options_spec ? options_spec : "",
+                              &opt_storage, &opts, &bad)) {
+      set_err(err, errlen, "create options: " + bad);
+      dlclose(r.dl);
+      return 0;
+    }
+  }
   PJRT_Client_Create_Args cc;
   std::memset(&cc, 0, sizeof cc);
   cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  cc.create_options = opts.empty() ? nullptr : opts.data();
+  cc.num_options = opts.size();
   if (take_error(r.api, r.api->PJRT_Client_Create(&cc), &msg)) {
     set_err(err, errlen, "Client_Create: " + msg);
     dlclose(r.dl);

@@ -28,7 +28,7 @@ from raft_tpu.core.error import expects
 
 _LIB_NAME = "libraft_tpu_pjrt.so"
 _MOCK_NAME = "libraft_tpu_mockpjrt.so"
-_ABI = 1
+_ABI = 2
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
@@ -66,6 +66,9 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rtp_resources_create.restype = i64
     lib.rtp_resources_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                          ctypes.c_int]
+    lib.rtp_resources_create_opts.restype = i64
+    lib.rtp_resources_create_opts.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.rtp_resources_destroy.argtypes = [i64]
     lib.rtp_platform_name.restype = ctypes.c_int
     lib.rtp_platform_name.argtypes = [i64, ctypes.c_char_p, ctypes.c_int]
@@ -209,18 +212,45 @@ class NativeMdarray:
             pass
 
 
+def encode_create_options(options: dict) -> str:
+    """Encode client create-options for the C layer's flat spec
+    (``name=T:value`` entries joined by ';'; T ∈ s|i|f|b from the
+    Python type). Real plugins require options — e.g. the axon tunnel
+    plugin's topology/session_id, libtpu's occupancy knobs — mirroring
+    jax's ``register_plugin(options=...)``."""
+    parts = []
+    for name, v in options.items():
+        expects(";" not in str(name) and "=" not in str(name),
+                "create option name %r has reserved chars", name)
+        if isinstance(v, bool):
+            parts.append(f"{name}=b:{int(v)}")
+        elif isinstance(v, int):
+            parts.append(f"{name}=i:{v}")
+        elif isinstance(v, float):
+            parts.append(f"{name}=f:{v}")
+        else:
+            s = str(v)
+            expects(";" not in s,
+                    "create option %s value has reserved ';'", name)
+            parts.append(f"{name}=s:{s}")
+    return ";".join(parts)
+
+
 class NativeResources:
     """The C++ handle_t analogue: owns a PJRT client + device list
-    created from ``plugin_path`` through the stable C ABI."""
+    created from ``plugin_path`` through the stable C ABI.
+    ``options``: PJRT client create-options (NamedValues), as jax's
+    ``register_plugin(options=...)``."""
 
-    def __init__(self, plugin_path: str):
+    def __init__(self, plugin_path: str, options: Optional[dict] = None):
         lib = load()
         expects(lib is not None, "PJRT native layer unavailable "
                 "(library not built; see cpp/build.sh)")
         self._lib = lib
         err = ctypes.create_string_buffer(512)
-        self._id = lib.rtp_resources_create(plugin_path.encode(), err,
-                                            len(err))
+        spec = encode_create_options(options or {})
+        self._id = lib.rtp_resources_create_opts(
+            plugin_path.encode(), spec.encode(), err, len(err))
         expects(self._id > 0, "NativeResources: %s",
                 err.value.decode(errors="replace"))
 

@@ -37,6 +37,28 @@ class TestNativeResources:
         with pytest.raises(Exception, match="dlopen"):
             pjrt_native.NativeResources("/nonexistent/libnope.so")
 
+    def test_create_options_pass_through(self):
+        """Client create-options (PJRT_NamedValues — required by real
+        plugins like the axon tunnel .so) flow through the C ABI; the
+        mock plugin accepts-and-ignores them."""
+        opts = {"topology": "v5e:1x1x1", "n_slices": 1,
+                "remote_compile": True, "timeout_frac": 1.5}
+        with pjrt_native.NativeResources(
+                pjrt_native.mock_plugin_path(), options=opts) as r:
+            assert r.device_count() == 2
+
+    def test_option_name_reserved_chars_rejected(self):
+        from raft_tpu.core.error import LogicError
+        with pytest.raises(LogicError):
+            pjrt_native.NativeResources(
+                pjrt_native.mock_plugin_path(),
+                options={"bad;name": 1})
+
+    def test_encode_create_options(self):
+        spec = pjrt_native.encode_create_options(
+            {"a": 1, "b": "x", "c": True, "d": 2.5})
+        assert spec == "a=i:1;b=s:x;c=b:1;d=f:2.5"
+
     def test_context_manager_closes(self):
         with pjrt_native.NativeResources(
                 pjrt_native.mock_plugin_path()) as r:

@@ -63,7 +63,8 @@ echo "== 1. fused IVF-Flat operating-point A/B (brute baseline + sweep)"
 python tools/profile_ivf_fused.py 2>&1 | tee "$OUT/ivf_fused_ab.log"
 
 probe 2
-echo "== 2. IVF-PQ scan modes (in-kernel decode vs reconstruct) + fp8 LUT"
+echo "== 2. IVF-PQ scan modes (block-diag decode vs reconstruct), fp8"
+echo "==    LUT, rescored headline point, 4-bit tier"
 python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_modes.log"
 import time, jax
 import jax.numpy as jnp
@@ -77,10 +78,13 @@ n, d, nq, k = 500_000, 128, 1000, 32
 db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
 q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
 t0 = time.perf_counter()
-idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024))
+idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, keep_raw=True))
 _sync(idx.codes)
 print("build", round(time.perf_counter() - t0, 1), "s", flush=True)
 cases = [("codes bf16", dict(scan_mode="codes", lut_dtype=jnp.bfloat16)),
+         ("codes bf16 rescore8", dict(scan_mode="codes",
+                                      lut_dtype=jnp.bfloat16,
+                                      rescore_factor=8)),
          ("codes fp8",  dict(scan_mode="codes",
                              lut_dtype=jnp.float8_e4m3fn)),
          ("reconstruct", dict(scan_mode="reconstruct"))]
@@ -91,6 +95,23 @@ for name, kw in cases:
     t = _time(lambda sp=sp: ivf_pq.search(idx, q, k, sp))
     print(f"ivf_pq {name}: {t*1000:.1f} ms -> {nq/t:.0f} QPS "
           f"recall@{k}={rec:.4f}", flush=True)
+# 4-bit tier (16x smaller decode K on the block-diag formulation)
+t0 = time.perf_counter()
+idx4 = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, pq_bits=4,
+                                           pq_dim=64, keep_raw=True))
+_sync(idx4.codes)
+print("pq4 build", round(time.perf_counter() - t0, 1), "s", flush=True)
+for name, kw in [("pq4 codes", dict(scan_mode="codes")),
+                 ("pq4 codes rescore8", dict(scan_mode="codes",
+                                             rescore_factor=8))]:
+    sp = ivf_pq.SearchParams(n_probes=64, **kw)
+    dd, ii = ivf_pq.search(idx4, q, k, sp)
+    rec = _ivf_recall(ii, db, q, k)
+    t = _time(lambda sp=sp: ivf_pq.search(idx4, q, k, sp))
+    print(f"ivf_pq {name}: {t*1000:.1f} ms -> {nq/t:.0f} QPS "
+          f"recall@{k}={rec:.4f}", flush=True)
+from raft_tpu.ops.compile_budget import snapshot
+print("ladders:", snapshot(), flush=True)
 EOF
 
 probe 3
@@ -109,5 +130,11 @@ BENCH_BIG=1 python bench_suite.py \
 probe 5
 echo "== 5. headline bench"
 python bench.py 2>&1 | tee "$OUT/headline.log"
+
+probe 6
+echo "== 6. C++ PJRT layer vs the REAL plugin (create client /"
+echo "==    round-trip buffer / ready-event sync — VERDICT r3 #8)"
+bash cpp/build.sh 2>&1 | tee "$OUT/pjrt_build.log" | tail -2
+python tools/pjrt_real_smoke.py 2>&1 | tee "$OUT/pjrt_real_smoke.log"
 
 echo "== done; update BASELINE.md + PERF_GATES + ivf_pq auto default from $OUT"
