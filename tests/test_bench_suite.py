@@ -11,8 +11,16 @@ class TestPerfGates:
         rows = [{"metric": "pairwise_L2Expanded_8192x8192x256_ms",
                  "value": 10.0},
                 {"metric": "pairwise_L1_8192x8192x256_ms", "value": 50.0},
+                {"metric": "bfknn_fused_500kx128_q1000_k32_qps",
+                 "value": 90_000.0},
                 {"metric": "ivf_flat_search_500kx128_q1000_k32_p64_qps",
-                 "value": 50_000.0}]
+                 "value": 50_000.0, "recall": 0.93},
+                {"metric": "ivf_pq_search_500kx128_q1000_k32_p64_qps",
+                 "value": 50_000.0, "recall": 0.92},
+                {"metric": "ivf_pq4_search_500kx128_q1000_k32_p64_qps",
+                 "value": 50_000.0, "recall": 0.90},
+                {"metric": "ivf_bq_search_500kx128_q1000_k32_p64_qps",
+                 "value": 50_000.0, "recall": 0.70}]
         for r in rows:
             if r["metric"] in over:
                 r["value"] = over[r["metric"]]
@@ -44,3 +52,34 @@ class TestPerfGates:
         assert any(f["kind"] == "missing" for f in fails)
         # case-filtered runs don't charge unselected gates
         assert bench_suite.check_gates(rows, require_all=False) == []
+
+    def test_recall_gate_trips(self):
+        import bench_suite
+        metric = "ivf_pq_search_500kx128_q1000_k32_p64_qps"
+        rows = self._rows(**{})
+        for r in rows:
+            if r["metric"] == metric:
+                r["recall"] = 0.51
+        fails = bench_suite.check_gates(rows)
+        assert [f["kind"] for f in fails] == ["recall"]
+        assert fails[0]["metric"] == metric
+
+    def test_recall_gate_never_passes_by_not_running(self):
+        """A recall-gated row that didn't run (case errored, or its
+        recall field vanished) is a failure under require_all."""
+        import bench_suite
+        metric = "ivf_pq_search_500kx128_q1000_k32_p64_qps"
+        rows = [r for r in self._rows() if r["metric"] != metric]
+        fails = bench_suite.check_gates(rows, require_all=True)
+        assert any(f["kind"] == "missing" and f["metric"] == metric
+                   for f in fails)
+        # case-filtered runs don't charge unselected recall gates
+        assert bench_suite.check_gates(rows, require_all=False) == []
+        # a row missing only its recall field is also charged
+        rows2 = self._rows()
+        for r in rows2:
+            if r["metric"] == metric:
+                del r["recall"]
+        fails2 = bench_suite.check_gates(rows2, require_all=True)
+        assert any(f["kind"] == "missing" and f["metric"] == metric
+                   for f in fails2)
