@@ -223,6 +223,7 @@ def child_main():
         nlists = 1024 if on_accel else 128
         for fam, case in (("ivf_flat", bench_suite.bench_ivf_flat),
                           ("ivf_pq", bench_suite.bench_ivf_pq),
+                          ("ivf_pq4", bench_suite.bench_ivf_pq4),
                           ("ivf_bq", bench_suite.bench_ivf_bq)):
             # one try per family: an ivf_flat failure (e.g. OOM) must
             # not rob the artifact of rows that would succeed
@@ -240,6 +241,9 @@ def child_main():
                     out[f"{fam}_device_marginal_qps"] = \
                         r["device_marginal_qps"]
                 out[f"{fam}_recall"] = r.get("recall")
+                if "recall_estimator" in r:  # pq: rescored headline +
+                    out[f"{fam}_recall_estimator"] = \
+                        r["recall_estimator"]  # the unrescored figure
                 out[f"{fam}_build_s"] = r.get("build_s")
             except Exception as e:  # must not void the headline
                 out[f"{fam}_error"] = repr(e)[:200]

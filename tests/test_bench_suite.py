@@ -45,11 +45,15 @@ class TestPerfGates:
         assert fails and fails[0]["kind"] == "floor"
 
     def test_missing_metric_is_a_failure(self):
-        """A gate must never pass by not running (require_all mode)."""
+        """A PERF gate must never pass by not running (require_all
+        mode) — drop a speed-gate-only row so this exercises the
+        PERF_GATES missing branch, not the recall one."""
         import bench_suite
-        rows = self._rows()[:-1]  # drop the gated ivf row
+        metric = "bfknn_fused_500kx128_q1000_k32_qps"
+        rows = [r for r in self._rows() if r["metric"] != metric]
         fails = bench_suite.check_gates(rows, require_all=True)
-        assert any(f["kind"] == "missing" for f in fails)
+        assert any(f["kind"] == "missing" and f["metric"] == metric
+                   for f in fails)
         # case-filtered runs don't charge unselected gates
         assert bench_suite.check_gates(rows, require_all=False) == []
 
