@@ -27,12 +27,23 @@ recall = np.mean([len(set(a) & set(b)) / 10
 print(f"IVF-Flat recall@10 (32/256 probes): {recall:.3f}")
 
 # IVF-PQ: 8x compressed codes; search scans the codes directly on TPU
-pq = ivf_pq.build(X, ivf_pq.IndexParams(n_lists=256, pq_dim=32))
+pq = ivf_pq.build(X, ivf_pq.IndexParams(n_lists=256, pq_dim=32,
+                                        keep_raw=True))
 d, i = ivf_pq.search(pq, Q, k=10, params=ivf_pq.SearchParams(n_probes=32))
 recall = np.mean([len(set(a) & set(b)) / 10
                   for a, b in zip(np.asarray(i), np.asarray(it))])
 print(f"IVF-PQ recall@10: {recall:.3f} "
       f"(codes {pq.codes.nbytes >> 20} MiB vs raw {X.nbytes >> 20} MiB)")
+
+# exact rescoring (the refine step fused into search): re-rank 8·k
+# estimator candidates against the host-kept raw vectors — recall
+# recovers to the probe ceiling, returned distances are exact
+d, i = ivf_pq.search(pq, Q, k=10,
+                     params=ivf_pq.SearchParams(n_probes=32,
+                                                rescore_factor=8))
+recall = np.mean([len(set(a) & set(b)) / 10
+                  for a, b in zip(np.asarray(i), np.asarray(it))])
+print(f"IVF-PQ recall@10 (rescored): {recall:.3f}")
 
 # IVF-BQ: 1 bit/dim sign codes (no codebook training; ~32x smaller
 # than raw) + exact host rescoring of the estimator's top candidates
