@@ -182,6 +182,59 @@ def _cached_cap(index, nq: int, n_probes: int) -> int:
     from raft_tpu.ops.dispatch import pallas_enabled
     return index.cap_cache[(nq, n_probes, pallas_enabled())]
 
+def _ann_dataset(n, d, nq, seed=5):
+    """Semi-hard clustered ANN bench distribution: a gaussian mixture
+    with unit-scale centers AND unit cluster noise (~125 rows/cluster),
+    queries drawn from the same mixture.
+
+    Why not plain gaussian noise: IVF recall on UNIFORM high-dim
+    random data is ceiling-limited by the partition itself — measured
+    2026-08-01, the exact-fine-phase probe ceiling at the bench probe
+    ratio (1/16 of lists) is ~0.35–0.5 on uniform 100k–10M×128, and
+    even probing 25% of 1024 lists at 10M×128 caps at 0.893. No IVF —
+    the reference's included — can beat its partition's ceiling, which
+    is why the reference's ANN evidence uses clustered corpora
+    (SIFT-class) too. This mixture measures 0.9731 flat ceiling at
+    16/256 probes on 100k×128 (center scale 1.0; scale 2.0 is
+    trivially separable at 1.000, scale 0.7 drops to 0.77): recall
+    curves are meaningful, not saturated."""
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.key(seed)
+    nc = max(64, min(8192, n // 125))
+    centers = jax.random.normal(jax.random.fold_in(key, 1), (nc, d))
+
+    @jax.jit
+    def mix(c, lab_c, key_c):
+        # fused gather+noise+add: one materialized chunk
+        return c[lab_c] + jax.random.normal(key_c,
+                                            (lab_c.shape[0], c.shape[1]))
+
+    # chunked so peak transient memory stays ~2× the dataset (the
+    # 10M-row call sites would otherwise hold gather+noise+sum at once)
+    step = max(1, min(n, 1 << 20))
+    lab = jax.random.randint(jax.random.fold_in(key, 2), (n,), 0, nc)
+    parts = [mix(centers, lab[s:s + step],
+                 jax.random.fold_in(key, 100 + s // step))
+             for s in range(0, n, step)]
+    x = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    del parts
+    qlab = jax.random.randint(jax.random.fold_in(key, 4), (nq,), 0, nc)
+    q = mix(centers, qlab, jax.random.fold_in(key, 5))
+    return x, q
+
+
+def _chained_batches(q, key, reps):
+    """Timing-only chained query batches: jittered copies of the
+    measured queries so the chain stays in-distribution (the pinned
+    probe_cap came from ``q``; far-out-of-distribution batches would
+    shed probes)."""
+    import jax
+    nq, d = q.shape
+    return q[None] + 0.1 * jax.random.normal(
+        jax.random.fold_in(key, 9), (reps, nq, d))
+
+
 def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
                    label=None, storage_dtype="float32"):
     # cpp/bench/neighbors/knn/ivf_flat_*.cu — SEARCH scope (+BUILD:
@@ -192,8 +245,7 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
     from raft_tpu.neighbors import ivf_flat
     key = jax.random.key(4)
     d, nq, k = 128, 1000, 32
-    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
-    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    db, q = _ann_dataset(n, d, nq)
     # kmeans_n_iters=10 vs the parity default 20: measured downstream-
     # recall-neutral for IVF-Flat (BASELINE.md 2026-08-01 A/B) and ~2×
     # build; the row reports its own recall so the trade is visible
@@ -214,7 +266,7 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=64,
     # chained marginal: pin the measured cap so nothing syncs in-jit
     spp = dataclasses.replace(sp, probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
-    qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
+    qb = _chained_batches(q, key, reps)
 
     def run1(qq, centers, data, norms, idsarr, sizes):
         idx2 = ivf_flat.Index(
@@ -244,8 +296,7 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
     from raft_tpu.neighbors import ivf_pq
     key = jax.random.key(5)
     d, nq, k = 128, 1000, 32
-    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
-    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    db, q = _ann_dataset(n, d, nq)
     # 10 EM iters: ~0.3% recall cost on random data (the bench
     # distribution; ~1% on clustered — BASELINE.md A/B), recall rides
     # in the row. keep_raw + rescore_factor: the headline row reports
@@ -274,7 +325,7 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=64,
     t = _time(lambda: ivf_pq.search(index, q, k, sp), reps=3)
     spp = dataclasses.replace(sp, probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
-    qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
+    qb = _chained_batches(q, key, reps)
 
     # the warm search populated decoded/decoded_norms iff it took the
     # reconstruct path; ride them as operands so the chained trace does
@@ -343,8 +394,7 @@ def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=64,
     from raft_tpu.neighbors import ivf_bq
     key = jax.random.key(12)
     d, nq, k = 128, 1000, 32
-    db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
-    q = jax.random.normal(jax.random.fold_in(key, 2), (nq, d))
+    db, q = _ann_dataset(n, d, nq)
     t_build0 = time.perf_counter()
     index = ivf_bq.build(db, ivf_bq.IndexParams(n_lists=nlists,
                                                 kmeans_n_iters=10))
@@ -362,7 +412,7 @@ def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=64,
                                  rescore_factor=sp.rescore_factor,
                                  probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
-    qb = jax.random.normal(jax.random.fold_in(key, 9), (reps, nq, d))
+    qb = _chained_batches(q, key, reps)
 
     def run1(qq, centers, centers_rot, rot, bits, norms2, scales, ids):
         import dataclasses

@@ -8,8 +8,19 @@ No import-time side effects — initializing a backend before
 example calls :func:`ensure_backend` at the right point itself;
 ``examples/03_distributed.py`` skips it entirely for launcher-driven
 multi-process runs.
+
+Importing this module also makes ``raft_tpu`` importable from a
+source checkout (``python examples/xx.py`` puts examples/ on
+``sys.path``, not the repo root) — installed environments are
+unaffected.
 """
 import os
+import sys
+
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if not any(os.path.isdir(os.path.join(p, "raft_tpu"))
+           for p in sys.path if p):
+    sys.path.insert(0, _repo_root)
 
 
 def ensure_backend(min_devices: int = 1) -> str:
