@@ -1,0 +1,67 @@
+"""BQ bit-payload roundtrip assertion (ADVICE r3 #2 follow-through):
+on the CURRENT platform, build a small ivf_bq index and verify the
+packed sign words that come OUT of the bucketize scatter are exactly
+the words a direct host-side re-encode produces — i.e. the int32
+payload path (pack → concat → scatter → slice → bitcast) is
+bit-exact on this backend. Runs in seconds; tpu_measure.sh stage 0
+includes it so the first healthy window certifies the path on real
+TPU hardware.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+# CPU pre-flight knob (the sitecustomize force-selects the tunneled
+# platform; env JAX_PLATFORMS can't override it, the config API can)
+if os.environ.get("CHECK_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["CHECK_PLATFORM"])
+
+
+def main() -> None:
+    from raft_tpu.neighbors import ivf_bq
+
+    print(f"[bq-roundtrip] platform: {jax.devices()[0].platform}",
+          flush=True)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4000, 64), np.float32)
+    idx = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=8,
+                                             kmeans_n_iters=4))
+
+    # host re-encode: the index's own centers/rotation, numpy math
+    c = np.asarray(idx.centers)
+    rot = np.asarray(idx.rotation_matrix)
+    lists_idx = np.asarray(idx.lists_indices)
+    bits = np.asarray(idx.bits)
+    norms2 = np.asarray(idx.norms2)
+    scales = np.asarray(idx.scales)
+    n_lists, ml, w = bits.shape
+    d = x.shape[1]
+    checked = 0
+    for l in range(n_lists):
+        for s in range(ml):
+            gid = lists_idx[l, s]
+            if gid < 0:
+                continue
+            r = (x[gid] - c[l]) @ rot.T
+            want_bits = (r > 0).astype(np.uint32)
+            want_words = np.zeros(w, np.uint32)
+            for j in range(d):
+                want_words[j // 32] |= want_bits[j] << (j % 32)
+            assert np.array_equal(bits[l, s], want_words), (l, s)
+            assert np.isclose(norms2[l, s], float(r @ r), rtol=1e-4), \
+                (l, s, norms2[l, s], float(r @ r))
+            assert np.isclose(scales[l, s], float(np.abs(r).mean()),
+                              rtol=1e-4), (l, s)
+            checked += 1
+    assert checked == 4000, checked
+    print(f"[bq-roundtrip] {checked} rows bit-exact through "
+          "pack/scatter/bitcast: PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,11 @@ probe() {
 
 probe start
 
+echo "== 0a. BQ bit-payload roundtrip on the REAL backend (ADVICE r3 #2"
+echo "==     follow-through: certify pack/scatter/bitcast bit-exactness"
+echo "==     on TPU hardware — seconds, zero compile risk)"
+python tools/bq_roundtrip_check.py 2>&1 | tee "$OUT/bq_roundtrip.log"
+
 echo "== 0. compile bisect ladder (names the program that kills the"
 echo "==    remote compiler, if any). QPS-FIRST ORDER: the full-rung"
 echo "==    chained marginals ARE the headline IVF numbers, so the two"
