@@ -1,0 +1,188 @@
+"""100M×128 IVF-BQ: BUILD and SEARCH the 1-bit tier at the full
+north-star scale on one host — the memory-tier story as real arrays,
+not arithmetic: ~3.2 GB of codes+stats for a 51.2 GB corpus, plus an
+estimator + exact-rescore recall datapoint at the coverage-curve
+operating point (tools/north_star_100m_curve.py: ceiling@10 = 0.998
+at 64/8192 probes).
+
+Single-device, host-resident corpus; the encode runs in row chunks
+(labels → rotated residual → sign-pack per 2M rows) so peak memory
+stays ~corpus + a few GB. The device phase of the search is the same
+XLA formulation the library serves with on CPU.
+
+Run: python tools/north_star_100m_bq.py [N_ROWS] [N_LISTS]
+Output: tools/measure_out/north_star_100m_bq.json
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+
+def log(msg):
+    print(f"[100m-bq] {msg}", flush=True)
+
+
+def main(n_rows=100_000_000, n_lists=8192):
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.distance.distance_types import DistanceType
+    from raft_tpu.neighbors import ivf_bq
+    from raft_tpu.neighbors.ivf_bq import _pack_bits
+    from raft_tpu.neighbors.ivf_flat import _bucketize_static
+    from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+    from raft_tpu.util.host_sample import sample_rows
+
+    d, nq, k = 128, 100, 10
+    w = d // 32
+    out = {"n_rows": n_rows, "dim": d, "n_lists": n_lists, "k": k}
+    key = jax.random.key(0)
+    nc = max(64, min(8192, n_rows // 125))
+    centers_mix = jax.random.normal(jax.random.fold_in(key, 1), (nc, d))
+
+    @jax.jit
+    def mix(c, lab_c, key_c):
+        return c[lab_c] + jax.random.normal(
+            key_c, (lab_c.shape[0], c.shape[1]))
+
+    t0 = time.perf_counter()
+    x = np.empty((n_rows, d), np.float32)
+    step = 1 << 21
+    n_chunks = -(-n_rows // step)
+    for i, s in enumerate(range(0, n_rows, step)):
+        e = min(s + step, n_rows)
+        lab_c = jax.random.randint(
+            jax.random.fold_in(key, 1000 + i), (e - s,), 0, nc)
+        x[s:e] = np.asarray(mix(centers_mix, lab_c,
+                                jax.random.fold_in(key, 2000 + i)))
+    q = mix(centers_mix,
+            jax.random.randint(jax.random.fold_in(key, 4), (nq,), 0, nc),
+            jax.random.fold_in(key, 5))
+    jax.block_until_ready(q)
+    log(f"data gen {time.perf_counter()-t0:.0f}s "
+        f"({x.nbytes/1e9:.1f} GB host-resident)")
+
+    # exact GT (chunked)
+    t0 = time.perf_counter()
+    best_d = np.full((nq, k), np.inf, np.float32)
+    best_i = np.full((nq, k), -1, np.int64)
+    qq = np.asarray(jnp.sum(q * q, axis=1))
+
+    @jax.jit
+    def chunk_topk(xc, qm):
+        dd = (jnp.sum(xc * xc, 1)[None, :] - 2.0 * qm @ xc.T)
+        nd, ni = jax.lax.top_k(-dd, k)
+        return -nd, ni
+
+    for s in range(0, n_rows, step):
+        e = min(s + step, n_rows)
+        cd, ci = chunk_topk(jnp.asarray(x[s:e]), q)
+        cd = np.asarray(cd) + qq[:, None]
+        ci = np.asarray(ci) + s
+        alld = np.concatenate([best_d, cd], axis=1)
+        alli = np.concatenate([best_i, ci], axis=1)
+        sel = np.argsort(alld, axis=1)[:, :k]
+        best_d = np.take_along_axis(alld, sel, axis=1)
+        best_i = np.take_along_axis(alli, sel, axis=1)
+    log(f"exact GT {time.perf_counter()-t0:.0f}s")
+
+    # coarse centers (same budget as the curve run)
+    t0 = time.perf_counter()
+    n_train = min(1_000_000, 125 * n_lists)
+    trainset = jnp.asarray(x[sample_rows(n_rows, n_train, 0)])
+    centers = kmeans_balanced.build_hierarchical(trainset, n_lists, 10)
+    jax.block_until_ready(centers)
+    log(f"coarse train {time.perf_counter()-t0:.0f}s")
+
+    rot = make_rotation_matrix(d, d, force_random=True)
+
+    @jax.jit
+    def encode_chunk(xc, c, rt):
+        lab = kmeans_balanced.predict(xc, c)
+        r = (xc - c[lab]) @ rt.T
+        payload = jnp.concatenate(
+            [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
+             lax.bitcast_convert_type(
+                 jnp.sum(r * r, axis=1)[:, None], jnp.int32),
+             lax.bitcast_convert_type(
+                 jnp.mean(jnp.abs(r), axis=1)[:, None], jnp.int32)],
+            axis=1)
+        return lab, payload
+
+    t0 = time.perf_counter()
+    labels = np.empty((n_rows,), np.int32)
+    payload = np.empty((n_rows, w + 2), np.int32)
+    for i, s in enumerate(range(0, n_rows, step)):
+        e = min(s + step, n_rows)
+        lab_c, pay_c = encode_chunk(jnp.asarray(x[s:e]), centers, rot)
+        labels[s:e] = np.asarray(lab_c)
+        payload[s:e] = np.asarray(pay_c)
+        if i % 10 == 0:
+            log(f"encode chunk {i+1}/{n_chunks}")
+    log(f"encode {time.perf_counter()-t0:.0f}s "
+        f"(payload {payload.nbytes/1e9:.2f} GB)")
+
+    t0 = time.perf_counter()
+    counts = np.bincount(labels, minlength=n_lists)
+    max_list = int(-(-counts.max() // 8) * 8)
+    bucketed, idx, _, _ = _bucketize_static(
+        jnp.asarray(payload), jnp.asarray(labels),
+        jnp.arange(n_rows, dtype=jnp.int32), n_lists, max_list,
+        compute_norms=False)
+    jax.block_until_ready(bucketed)
+    bits = lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32)
+    norms2 = lax.bitcast_convert_type(bucketed[:, :, w], jnp.float32)
+    scales = lax.bitcast_convert_type(bucketed[:, :, w + 1], jnp.float32)
+    index = ivf_bq.Index(
+        centers=centers, centers_rot=centers @ rot.T,
+        rotation_matrix=rot, bits=bits, norms2=norms2, scales=scales,
+        lists_indices=idx, list_sizes=jnp.asarray(counts, jnp.int32),
+        metric=DistanceType.L2Expanded, size=n_rows, raw=x)
+    del bucketed, payload
+    code_gb = (bits.size * 4 + norms2.size * 4 + scales.size * 4
+               + idx.size * 4) / 1e9
+    out["build_bucketize_s"] = round(time.perf_counter() - t0, 1)
+    out["max_list"] = max_list
+    out["codes_stats_gb"] = round(code_gb, 2)
+    log(f"bucketize {out['build_bucketize_s']}s — index codes+stats "
+        f"{code_gb:.2f} GB (padded max_list {max_list}) for "
+        f"{x.nbytes/1e9:.1f} GB of raw vectors")
+
+    def recall(ids):
+        got = np.asarray(ids)[:, :k]
+        return float(np.mean([len(set(got[r]) & set(best_i[r])) / k
+                              for r in range(nq)]))
+
+    for factor, tag in ((0, "estimator"), (16, "rescored"),
+                        (25, "rescored_f25")):  # kk=250 ≤ the 256
+        # select-kernel ceiling — the widest exact-merge pool
+        t0 = time.perf_counter()
+        bd, bi = ivf_bq.search(
+            index, q, k, ivf_bq.SearchParams(n_probes=64,
+                                             rescore_factor=factor))
+        rec = recall(bi)
+        out[f"recall_{tag}"] = rec
+        out[f"search_{tag}_s"] = round(time.perf_counter() - t0, 1)
+        log(f"search p=64 {tag}: recall@{k}={rec:.4f} "
+            f"({out[f'search_{tag}_s']}s cold)")
+
+    os.makedirs("tools/measure_out", exist_ok=True)
+    with open("tools/measure_out/north_star_100m_bq.json", "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"RESULT {json.dumps(out)}")
+
+
+if __name__ == "__main__":
+    a = sys.argv[1:]
+    main(int(a[0]) if a else 100_000_000,
+         int(a[1]) if len(a) > 1 else 8192)
