@@ -56,6 +56,7 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
 from raft_tpu.core import trace
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
@@ -177,7 +178,12 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         # PQ rotation
         from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
         rot = make_rotation_matrix(d, d, force_random=True)
-        r = (x - centers[labels]) @ rot.T
+        # full-precision rotation: the sign code IS the payload, and
+        # TPU default-precision (single-pass bf16) matmul flips signs
+        # of near-zero rotated components vs host f32 math — observed
+        # on hardware 2026-08-02 (bq_roundtrip_check stage 0a)
+        r = jnp.matmul(x - centers[labels], rot.T,
+                       precision=matmul_precision())
         norms2 = jnp.sum(r * r, axis=1)
         scales = jnp.mean(jnp.abs(r), axis=1)
         words = _pack_bits(r)
@@ -198,7 +204,9 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         w = words.shape[1]
         bits = lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32)
         raw = np.asarray(jax.device_get(x)) if params.keep_raw else None
-    return Index(centers=centers, centers_rot=centers @ rot.T,
+    return Index(centers=centers,
+                 centers_rot=jnp.matmul(centers, rot.T,
+                                        precision=matmul_precision()),
                  rotation_matrix=rot, bits=bits,
                  norms2=lax.bitcast_convert_type(bucketed[:, :, w],
                                                  jnp.float32),
@@ -240,7 +248,6 @@ def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
         qg = q_rot[jnp.clip(qm, 0, nq - 1)]           # (chunk, cap, d)
         pm1 = _unpack_pm1(bw, dim)                    # (chunk, ML, d) ±1
         if kind == "ip":
-            from raft_tpu.core.precision import matmul_precision
             ip = jnp.einsum("gcd,gld->gcl", qg.astype(jnp.bfloat16),
                             pm1, preferred_element_type=jnp.float32)
             # q·c_l dominates the estimator: full precision, like the
@@ -315,7 +322,10 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
     old_ids = index.lists_indices.reshape(-1)[valid]
 
     new_labels = kmeans_balanced.predict(x, index.centers, res=res)
-    r = (x - index.centers[new_labels]) @ index.rotation_matrix.T
+    # full precision like build(): sign stability (see build comment)
+    r = jnp.matmul(x - index.centers[new_labels],
+                   index.rotation_matrix.T,
+                   precision=matmul_precision())
     new_payload = jnp.concatenate(
         [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
          lax.bitcast_convert_type(

@@ -730,7 +730,10 @@ def distributed_ivf_bq_build(
     def encode_local(x_loc, lbl_loc, ids_loc, c, rt):
         lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
         safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
-        r = (x_loc - c[lbl]) @ rt.T
+        # full-precision rotation, like ivf_bq.build: default-precision
+        # TPU matmul flips signs of near-zero rotated components
+        r = jnp.matmul(x_loc - c[lbl], rt.T,
+                       precision=matmul_precision())
         # int32 payload (see ivf_bq.build): bit words must not ride as
         # f32 bitcasts — NaN-pattern canonicalization hazard
         payload = jnp.concatenate(

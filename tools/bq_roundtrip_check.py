@@ -41,26 +41,46 @@ def main() -> None:
     scales = np.asarray(idx.scales)
     n_lists, ml, w = bits.shape
     d = x.shape[1]
+    # A device bit may legitimately differ from the host re-encode only
+    # where the rotated component is within FP rounding of zero (the
+    # device matmul runs at matmul_precision(), not numpy's exact f32
+    # evaluation order). Everywhere else a mismatch means the payload
+    # path corrupted bits. Borderline threshold, relative to the row's
+    # mean |r| (the scale the sign code quantizes against): 1e-4 for
+    # the near-f32 tiers; 1e-2 when RAFT_TPU_MATMUL_PRECISION=default
+    # (single-pass bf16, ~4e-3 relative matmul error).
+    import jax.lax as jlax
+    from raft_tpu.core.precision import matmul_precision
+    rel_tol = (1e-2 if matmul_precision() == jlax.Precision.DEFAULT
+               else 1e-4)
     checked = 0
+    borderline_bits = 0
     for l in range(n_lists):
         for s in range(ml):
             gid = lists_idx[l, s]
             if gid < 0:
                 continue
             r = (x[gid] - c[l]) @ rot.T
-            want_bits = (r > 0).astype(np.uint32)
-            want_words = np.zeros(w, np.uint32)
-            for j in range(d):
-                want_words[j // 32] |= want_bits[j] << (j % 32)
-            assert np.array_equal(bits[l, s], want_words), (l, s)
+            scale = float(np.abs(r).mean())
+            # absolute floor so a degenerate row (r ~ 0 → scale ~ 0)
+            # can't excuse every bit: components with any real
+            # magnitude stay firm
+            firm = np.abs(r) > rel_tol * scale + 1e-12
+            j = np.arange(d)
+            got = (bits[l, s, j // 32] >> (j % 32)) & 1
+            want = (r > 0).astype(np.uint32)
+            bad = (got != want) & firm
+            assert not bad.any(), \
+                (l, s, np.nonzero(bad)[0], r[bad])
+            borderline_bits += int(((got != want) & ~firm).sum())
             assert np.isclose(norms2[l, s], float(r @ r), rtol=1e-4), \
                 (l, s, norms2[l, s], float(r @ r))
-            assert np.isclose(scales[l, s], float(np.abs(r).mean()),
-                              rtol=1e-4), (l, s)
+            assert np.isclose(scales[l, s], scale, rtol=1e-4), (l, s)
             checked += 1
     assert checked == 4000, checked
     print(f"[bq-roundtrip] {checked} rows bit-exact through "
-          "pack/scatter/bitcast: PASS", flush=True)
+          f"pack/scatter/bitcast ({borderline_bits} FP-boundary bits "
+          "excused): PASS", flush=True)
 
 
 if __name__ == "__main__":

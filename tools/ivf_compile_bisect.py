@@ -88,26 +88,45 @@ def step(name, fn):
 CHAIN = 4
 
 
-def run_chained(tag, search_fn):
-    """Shared tail of both families: compile the CHAIN-long chained
+def run_chained(tag, search_fn, index):
+    """Shared tail of all families: compile the CHAIN-long chained
     search (the measurement program), then report its best-of-3
     marginal in-jit ms — the protocol must stay identical across
-    families for the QPS numbers to be comparable."""
+    families for the QPS numbers to be comparable.
+
+    The index rides through the outer jit as ARGUMENTS: a closed-over
+    jax.Array becomes a trace-time constant serialized into the HLO as
+    a literal, and at the full rung (500k×128 lists_data ≈ 256 MB)
+    that overflows the remote-compile relay's request-body limit
+    (HTTP 413, observed 2026-08-02). Works because every bisect
+    call site pins params.probe_cap, so search() never host-syncs an
+    index array."""
     qs = jax.random.normal(jax.random.fold_in(key, 3), (CHAIN, NQ, D))
+    cls = type(index)
+    arrs = {k: v for k, v in vars(index).items()
+            if isinstance(v, jax.Array)}
+    aux = {k: v for k, v in vars(index).items() if k not in arrs}
+
+    def rebuild(a):
+        obj = object.__new__(cls)
+        obj.__dict__.update(aux)
+        obj.__dict__.update(a)
+        return obj
 
     @jax.jit
-    def chained(qb):
+    def chained(qb, a):
+        idx_t = rebuild(a)
         acc = jnp.zeros((), jnp.float32)
         for i in range(CHAIN):
-            dd, ii = search_fn(qb[i])
+            dd, ii = search_fn(idx_t, qb[i])
             acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
         return acc
 
-    step(f"{tag}chained", lambda: chained(qs))
+    step(f"{tag}chained", lambda: chained(qs, arrs))
     best = np.inf
     for _ in range(3):
         t0 = time.perf_counter()
-        np.asarray(jax.device_get(chained(qs)))
+        np.asarray(jax.device_get(chained(qs, arrs)))
         best = min(best, (time.perf_counter() - t0) / CHAIN)
     print(f"[bisect] {tag}chained marginal: {best*1e3:.2f} ms -> "
           f"{NQ/best:.0f} QPS", flush=True)
@@ -147,7 +166,7 @@ if FAMILY == "pq":
         n_probes=NPROBES, probe_cap=cap,
         scan_mode="codes" if use_pallas else "reconstruct")
     step("pq fused", lambda: ivf_pq.search(idx, q, K, sp))
-    run_chained("pq ", lambda qb: ivf_pq.search(idx, qb, K, sp))
+    run_chained("pq ", lambda ix, qb: ivf_pq.search(ix, qb, K, sp), idx)
     tier_report()
     raise SystemExit(0)
 elif FAMILY == "bq":
@@ -180,7 +199,7 @@ elif FAMILY == "bq":
 
     sp = ivf_bq.SearchParams(n_probes=NPROBES, probe_cap=cap)
     step("bq fused", lambda: ivf_bq.search(idx, q, K, sp))
-    run_chained("bq ", lambda qb: ivf_bq.search(idx, qb, K, sp))
+    run_chained("bq ", lambda ix, qb: ivf_bq.search(ix, qb, K, sp), idx)
     tier_report()
     raise SystemExit(0)
 elif FAMILY != "flat":
@@ -228,5 +247,5 @@ else:
 
 sp = ivf_flat.SearchParams(n_probes=NPROBES, probe_cap=cap)
 step("fused", lambda: ivf_flat.search(idx, q, K, sp))
-run_chained("", lambda qb: ivf_flat.search(idx, qb, K, sp))
+run_chained("", lambda ix, qb: ivf_flat.search(ix, qb, K, sp), idx)
 tier_report()

@@ -28,6 +28,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
+from raft_tpu.core.precision import matmul_precision  # noqa: E402
 
 
 def log(msg):
@@ -117,7 +118,13 @@ def main(n_rows=100_000_000, n_lists=8192):
         # the CPU-rehearsal tool.)
         cc = jnp.sum(c * c, axis=1)
         lab = jnp.argmin(cc[None, :] - 2.0 * (xc @ c.T), axis=1)
-        r = (xc - c[lab]) @ rt.T
+        # full-precision rotation like ivf_bq.build (sign stability
+        # near zero); labels can still differ from the library path
+        # near Voronoi boundaries (inline argmin vs fused-L2-NN
+        # predict) — this driver is the CPU-rehearsal tool, not a
+        # bit-identity oracle
+        r = jnp.matmul(xc - c[lab], rt.T,
+                       precision=matmul_precision())
         payload = jnp.concatenate(
             [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
              lax.bitcast_convert_type(
@@ -152,7 +159,9 @@ def main(n_rows=100_000_000, n_lists=8192):
     norms2 = lax.bitcast_convert_type(bucketed[:, :, w], jnp.float32)
     scales = lax.bitcast_convert_type(bucketed[:, :, w + 1], jnp.float32)
     index = ivf_bq.Index(
-        centers=centers, centers_rot=centers @ rot.T,
+        centers=centers,
+        centers_rot=jnp.matmul(centers, rot.T,
+                               precision=matmul_precision()),
         rotation_matrix=rot, bits=bits, norms2=norms2, scales=scales,
         lists_indices=idx, list_sizes=jnp.asarray(counts, jnp.int32),
         metric=DistanceType.L2Expanded, size=n_rows, raw=x)
