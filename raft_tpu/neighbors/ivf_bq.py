@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -89,6 +90,15 @@ class SearchParams:
     # 32·rescore_factor·k, floor 128/list — see search()); exact scan
     # when ≥ max_list
     scan_bins: int = 0
+    # where the exact re-rank runs: "auto" copies the raw corpus to
+    # device HBM once (cached on the index) when it fits
+    # RAFT_TPU_RESCORE_DEVICE_MB (default 4096) and fuses the rescore
+    # into the search dispatch — the host epilogue costs two
+    # device↔host round-trips, ~300 ms/1000-query batch through the
+    # axon tunnel (stage-2 measurement 2026-08-02); "never" keeps the
+    # host path (the 100M tier, where raw exceeds HBM); "always"
+    # forces the device copy regardless of size
+    rescore_on_device: str = "auto"
 
 
 @dataclass
@@ -105,6 +115,9 @@ class Index:
     size: int
     raw: Optional[np.ndarray] = None   # (n, dim) f32 host copy
     cap_cache: dict = dataclasses.field(default_factory=dict)
+    # lazy device copy of `raw` for the fused rescore tier
+    # (SearchParams.rescore_on_device); never serialized
+    raw_dev: Optional[jax.Array] = None
 
     @property
     def n_lists(self) -> int:
@@ -391,9 +404,62 @@ def _resolve(index: Index, queries, params: SearchParams,
                          use_pallas=use_pallas)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "kind"))
+def _exact_rescore_device(raw_dev, q, ids, *, k: int, kind: str):
+    """Exact re-rank of the kk estimator survivors on DEVICE: gather by
+    global id + f32 scores + top-k, one fused dispatch. Value-identical
+    to the host epilogue (same scores, same ordering rule) but with no
+    device↔host round-trip, so the whole search stays jittable."""
+    cand = raw_dev[jnp.maximum(ids, 0)]                 # (nq, kk, d)
+    qf = q.astype(jnp.float32)
+    if kind == "ip":
+        ex = -jnp.einsum("qkd,qd->qk", cand, qf,
+                         precision=matmul_precision(),
+                         preferred_element_type=jnp.float32)
+    else:
+        diff = cand - qf[:, None, :]
+        ex = jnp.sum(diff * diff, axis=2)
+    ex = jnp.where(ids >= 0, ex, jnp.inf)
+    nd, sel = lax.top_k(-ex, k)
+    return -nd, jnp.take_along_axis(ids, sel, axis=1)
+
+
+_RAW_DEV_LOCK = threading.Lock()
+
+
+def resolve_raw_device(index, mode: str) -> Optional[jax.Array]:
+    """Device copy of ``index.raw`` per the ``rescore_on_device``
+    policy ("auto" | "always" | "never"), cached on the index. None
+    means: use the host epilogue. "never" also RELEASES a cached copy
+    (the reclaim path after an "always" experiment); "auto" falls back
+    to host if the device copy fails to materialize (e.g. HBM already
+    full) rather than failing the search."""
+    expects(mode in ("auto", "always", "never"),
+            "rescore_on_device: want auto|always|never, got %r", mode)
+    if mode == "never" or index.raw is None:
+        index.raw_dev = None
+        return None
+    if mode == "auto":
+        import os
+        budget_mb = int(os.environ.get("RAFT_TPU_RESCORE_DEVICE_MB",
+                                       "4096"))
+        if index.raw.nbytes > budget_mb << 20:
+            return None
+    with _RAW_DEV_LOCK:
+        if (index.raw_dev is None
+                or index.raw_dev.shape != index.raw.shape):
+            try:
+                index.raw_dev = jnp.asarray(index.raw)
+            except Exception:
+                if mode == "always":
+                    raise
+                return None    # auto: HBM full → host epilogue
+        return index.raw_dev
+
+
 def finish_search(d_est, ids, raw, q, k: int,
                   metric: DistanceType = DistanceType.L2Expanded,
-                  rescore: bool = False
+                  rescore: bool = False, raw_dev=None
                   ) -> Tuple[jax.Array, jax.Array]:
     """Shared epilogue of the single-chip and distributed searches:
     either slice the estimator top-k, or exactly re-rank the kk
@@ -412,6 +478,14 @@ def finish_search(d_est, ids, raw, q, k: int,
         if sqrt:
             d_est = jnp.sqrt(jnp.maximum(d_est, 0.0))
         return _postprocess(d_est, metric), ids
+    if raw_dev is not None:
+        ex, i_out = _exact_rescore_device(raw_dev, q, ids,
+                                          k=k, kind=kind)
+        i_out = jnp.where(jnp.isfinite(ex), i_out, -1)
+        d_out = jnp.where(jnp.isfinite(ex), ex, jnp.inf)
+        if sqrt:
+            d_out = jnp.sqrt(jnp.maximum(d_out, 0.0))
+        return _postprocess(d_out, metric), i_out
     ids_h = np.asarray(jax.device_get(ids))
     qh = np.asarray(jax.device_get(q))
     cand = raw[np.maximum(ids_h, 0)]                    # (nq, kk, d)
@@ -465,6 +539,9 @@ def search(index: Index, queries, k: int,
     expects(params.rescore_factor >= 0,
             "ivf_bq.search: rescore_factor must be >= 0, got %d",
             params.rescore_factor)
+    expects(params.rescore_on_device in ("auto", "always", "never"),
+            "ivf_bq.search: rescore_on_device: want auto|always|never,"
+            " got %r", params.rescore_on_device)
     rescore = params.rescore_factor > 0 and index.raw is not None
     # rescore_factor shapes the DEVICE phase (candidate count) whether
     # or not raw vectors exist — so an estimator-only index (or a bench
@@ -538,5 +615,8 @@ def search(index: Index, queries, k: int,
                      f"p={n_probes},cap={cap},L={index.n_lists},"
                      f"bins={bins},{kind},g={gather_mode()}]")
         d_est, ids = run_tiers(shape_key, tiers)
+        raw_dev = (resolve_raw_device(index, params.rescore_on_device)
+                   if rescore else None)
         return finish_search(d_est, ids, index.raw, q, k,
-                             metric=index.metric, rescore=rescore)
+                             metric=index.metric, rescore=rescore,
+                             raw_dev=raw_dev)

@@ -115,6 +115,10 @@ class SearchParams:
     scan_bins: int = 0
     # see ivf_flat.SearchParams.probe_cap / _ivf_scan.resolve_cap
     probe_cap: int = 0
+    # "auto" | "always" | "never" — see ivf_bq.SearchParams: the exact
+    # re-rank runs fused on device when the raw corpus fits the HBM
+    # budget, else on host
+    rescore_on_device: str = "auto"
 
 
 @dataclass
@@ -156,6 +160,9 @@ class Index:
     # _ivf_scan.resolve_cap (not index identity; not serialized)
     cap_cache: dict = dataclasses_field(default_factory=dict, repr=False,
                                         compare=False)
+    # lazy device copy of `raw` for the fused rescore tier
+    # (SearchParams.rescore_on_device); never serialized
+    raw_dev: Optional[jax.Array] = None
 
     @property
     def n_lists(self) -> int:
@@ -791,6 +798,9 @@ def search(index: Index, queries, k: int,
     # so the exact-rescore semantics stay identical across families)
     expects(params.rescore_factor >= 0,
             "ivf_pq.search: rescore_factor must be >= 0")
+    expects(params.rescore_on_device in ("auto", "always", "never"),
+            "ivf_pq.search: rescore_on_device: want auto|always|never,"
+            " got %r", params.rescore_on_device)
     rescoring = params.rescore_factor > 0 and index.raw is not None
     kk = max(params.rescore_factor, 1) * k
     # sqrt/output conventions move to the epilogue when it is not the
@@ -800,9 +810,12 @@ def search(index: Index, queries, k: int,
     def _epilogue(d, i):
         if kk == k and not rescoring:
             return _postprocess(d, index.metric), i
-        from raft_tpu.neighbors.ivf_bq import finish_search
+        from raft_tpu.neighbors.ivf_bq import (finish_search,
+                                               resolve_raw_device)
+        raw_dev = (resolve_raw_device(index, params.rescore_on_device)
+                   if rescoring else None)
         return finish_search(d, i, index.raw, q, k, metric=index.metric,
-                             rescore=rescoring)
+                             rescore=rescoring, raw_dev=raw_dev)
 
     # candidate bins: when rescoring widens kk, the per-list 4·k auto
     # rule (pallas_ivf_scan._Layout) would blow the merge width

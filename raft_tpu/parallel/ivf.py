@@ -684,6 +684,10 @@ class DistributedIvfBq:
     mesh: jax.sharding.Mesh
     axis: str
     raw: "object" = None      # host numpy (n, dim) f32 or None
+    # lazy device copy of `raw` (ivf_bq.resolve_raw_device contract);
+    # replicated over the mesh by the rescore gather — the "auto" HBM
+    # budget is the guard at multi-chip scale
+    raw_dev: "object" = None
 
     @property
     def n_lists(self) -> int:
@@ -819,6 +823,10 @@ def distributed_ivf_bq_search_parts(
                           rep(dindex.rotation_matrix), dindex.parts_bits,
                           dindex.parts_norms2, dindex.parts_scales,
                           dindex.parts_indices, rep(q))
-    from raft_tpu.neighbors.ivf_bq import finish_search
+    from raft_tpu.neighbors.ivf_bq import (finish_search,
+                                           resolve_raw_device)
+    raw_dev = (resolve_raw_device(dindex, params.rescore_on_device)
+               if rescore else None)
     return finish_search(d_est, ids, dindex.raw, q, k,
-                         metric=dindex.metric, rescore=rescore)
+                         metric=dindex.metric, rescore=rescore,
+                         raw_dev=raw_dev)

@@ -331,6 +331,48 @@ class TestIvfPq:
         ex = np.sum((xs[np.asarray(i8[0])] - qs[0]) ** 2, axis=1)
         np.testing.assert_allclose(np.asarray(d8[0]), ex, rtol=1e-4)
 
+    def test_rescore_device_matches_host(self, dataset):
+        """rescore_on_device="always" (fused device re-rank) returns
+        the same neighbors and distances as the host epilogue — the
+        two tiers are value-identical by construction."""
+        x, q = dataset
+        for family, build_params in (
+                (ivf_pq, ivf_pq.IndexParams(n_lists=32, pq_bits=8,
+                                            pq_dim=8, kmeans_n_iters=10,
+                                            keep_raw=True)),
+                (ivf_bq, ivf_bq.IndexParams(n_lists=32,
+                                            kmeans_n_iters=10))):
+            index = family.build(x, build_params)
+            sp_host = family.SearchParams(n_probes=16, rescore_factor=4,
+                                          rescore_on_device="never")
+            sp_dev = family.SearchParams(n_probes=16, rescore_factor=4,
+                                         rescore_on_device="always")
+            dh, ih = family.search(index, q, 10, sp_host)
+            assert index.raw_dev is None  # "never" must not copy
+            dd, id_ = family.search(index, q, 10, sp_dev)
+            assert index.raw_dev is not None
+            # distances are value-identical; id ORDER may differ where
+            # two candidates tie at f32 resolution (top_k vs argsort
+            # tie-breaking), so compare per-row id sets
+            np.testing.assert_allclose(np.asarray(dh), np.asarray(dd),
+                                       rtol=1e-5, atol=1e-5)
+            ih_n, id_n = np.asarray(ih), np.asarray(id_)
+            for r in range(ih_n.shape[0]):
+                assert set(ih_n[r]) == set(id_n[r]), r
+            # "never" on the same params object releases the cache
+            family.search(index, q, 10, sp_host)
+            assert index.raw_dev is None
+
+    def test_rescore_on_device_validation(self, dataset):
+        x, q = dataset
+        index = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=16,
+                                                   kmeans_n_iters=4))
+        with pytest.raises(Exception, match="rescore_on_device"):
+            ivf_bq.search(index, q, 5,
+                          ivf_bq.SearchParams(n_probes=4,
+                                              rescore_factor=4,
+                                              rescore_on_device="bogus"))
+
     def test_rescore_sqrt_metric(self, dataset):
         """Rescored distances honor BOTH Sqrt metrics (the epilogue is
         finish_search, whose sqrt gate must cover L2SqrtUnexpanded)."""

@@ -55,22 +55,41 @@ gt = np.asarray(jax.device_get(gt_i))
 jax.block_until_ready(gt_d)
 
 
-def chained(fn):
-    """Marginal in-jit ms per call: CHAIN calls chained in one jit."""
+def chained(fn, *captures):
+    """Marginal in-jit ms per call: CHAIN calls chained in one jit.
+
+    Big operands must ride as ``captures`` (forwarded to ``fn`` after
+    the query batch), NOT closures: a closed-over jax.Array serializes
+    into the HLO as a literal, and 256 MB of db/index overflows the
+    remote-compile relay's request-body limit (HTTP 413)."""
     @jax.jit
-    def run(qb):
+    def run(qb, *cap):
         acc = jnp.zeros((), jnp.float32)
         for i in range(CHAIN):
-            dd, ii = fn(qb[i])
+            dd, ii = fn(qb[i], *cap)
             acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
         return acc
-    jax.block_until_ready(run(qs))  # compile + warm
+    jax.block_until_ready(run(qs, *captures))  # compile + warm
     best = np.inf
     for _ in range(3):
         t0 = time.perf_counter()
-        jax.block_until_ready(run(qs))
+        jax.block_until_ready(run(qs, *captures))
         best = min(best, (time.perf_counter() - t0) / CHAIN)
     return best * 1e3
+
+
+# ivf_flat.Index is not a pytree: split it into its device arrays (jit
+# arguments) + aux fields, and rebuild inside the trace
+_IDX_ARRS = {k_: v for k_, v in vars(idx).items()
+             if isinstance(v, jax.Array)}
+_IDX_AUX = {k_: v for k_, v in vars(idx).items() if k_ not in _IDX_ARRS}
+
+
+def _rebuild_idx(a):
+    obj = object.__new__(type(idx))
+    obj.__dict__.update(_IDX_AUX)
+    obj.__dict__.update(a)
+    return obj
 
 
 def recall_of(ii):
@@ -79,8 +98,8 @@ def recall_of(ii):
     return hits / (nq * k)
 
 
-ms = chained(lambda qb: brute_force.brute_force_knn(
-    db, qb, k, mode="fused"))
+ms = chained(lambda qb, dbb: brute_force.brute_force_knn(
+    dbb, qb, k, mode="fused"), db)
 print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS",
       flush=True)
 
@@ -91,7 +110,8 @@ def run_point(cap, bins, idt):
         scan_bins=bins, internal_distance_dtype=idt)
     dd, ii = ivf_flat.search(idx, q0, k, sp)
     rec = recall_of(ii)
-    ms = chained(lambda qb, sp=sp: ivf_flat.search(idx, qb, k, sp))
+    ms = chained(lambda qb, a, sp=sp: ivf_flat.search(
+        _rebuild_idx(a), qb, k, sp), _IDX_ARRS)
     tag = "bf16" if idt == jnp.bfloat16 else "f32"
     qps = nq / ms * 1000
     print(f"cap={cap:3d} bins={bins:3d} idt={tag}: "
