@@ -75,8 +75,12 @@ def _invert_probes(probes, n_lists: int, cap: int):
                                  flat_list, num_segments=n_lists)
     # composite key (list, probe rank); n_lists·n_probes stays well under
     # int32 (≤ n_lists² ≤ 2^34 only for n_lists > 2^17-class indexes —
-    # far beyond the list counts this layout targets)
-    order = jnp.argsort(flat_list * n_probes + p_rank, stable=True)
+    # far beyond the list counts this layout targets). Unstable sort:
+    # equal keys are same-(list, rank) pairs from different queries,
+    # and the drop policy only cares about rank classes — which query
+    # within a rank class yields at overflow is arbitrary either way
+    # (XLA's sort network is still deterministic for a given shape)
+    order = jnp.argsort(flat_list * n_probes + p_rank, stable=False)
     sl = flat_list[order]
     starts = jnp.cumsum(jnp.concatenate([jnp.zeros(1, jnp.int32),
                                          counts]))[:-1]
@@ -313,6 +317,26 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     probes = coarse_probes(queries, centers, n_probes, kind=kind,
                            use_pallas=use_pallas)
     cap = probe_cap(probes, n_lists)
+    if pc == 0:
+        # ceiling on the AUTO-measured width (drop-free -1 mode stays
+        # unbounded): clustered query skew can double the drop-free cap
+        # (512 observed at the 500k bench point, 2026-08-02), and a big
+        # cap is wrong on BOTH axes — the list-major scan's work grows
+        # ∝ cap (the overflow it sheds is the least-promising probe
+        # ranks), and the Mosaic kernels' compile time explodes past
+        # ~256 (two 300 s-budget parks burned a scarce TPU window).
+        # Overridable per call via params.probe_cap, per process via
+        # the env.
+        import os
+        cap_max = int(os.environ.get("RAFT_TPU_AUTO_CAP_MAX", "256"))
+        if cap_max > 0:
+            # round the ceiling DOWN to the cap bucketing grid — a
+            # non-power-of-two env value must not round up past the
+            # compile-explosion threshold it exists to guard
+            floor = 8
+            while floor * 2 <= cap_max:
+                floor *= 2
+            cap = min(cap, floor)
     if pc == 0 and cache is not None:
         cache[key] = cap
     return cap

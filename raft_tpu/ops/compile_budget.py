@@ -165,6 +165,23 @@ def run_tiers(name: str, tiers: Sequence[Tuple[str, Callable]],
             "%s: tier %s exceeded the %.0f s compile budget; compile "
             "PARKED (never killed — see compile_budget docstring), "
             "falling back to the next tier", name, tname, b)
+        # sibling skip: a parked compile indicates backend-family
+        # pathology at this shape, and its same-family siblings are
+        # near-identical programs — poison them too rather than burn
+        # another full budget each (measured 2026-08-02: BQ cap=512
+        # parked BOTH Pallas rungs back-to-back, 600 s of a scarce TPU
+        # window). A sibling that should be tried anyway can be
+        # reordered to the front (e.g. RAFT_TPU_IVF_LC=1).
+        family = tname.split("_", 1)[0]
+        for sib, _ in tiers[i + 1:len(tiers) - 1]:
+            if sib.split("_", 1)[0] == family:
+                sibkey = (name, sib)
+                with _LOCK:
+                    if sibkey not in _OK and sibkey not in _POISONED:
+                        _POISONED[sibkey] = time.time()
+                        logger.warn("%s: tier %s skipped (same-family "
+                                    "sibling of the parked %s)",
+                                    name, sib, tname)
     # every tier poisoned/failed and the last raised nothing? only
     # reachable when the last tier was skipped as poisoned — run it
     # anyway (a poisoned final tier may have un-poisoned since, and

@@ -59,6 +59,43 @@ class TestRunTiers:
             time.sleep(0.01)
         assert cb.tier_state("lad", "slow") == "ok"
 
+    def test_park_poisons_same_family_siblings(self):
+        """A parked pallas_* tier also poisons its pallas_* siblings
+        (one budget burned, not one per rung); the cross-family tail
+        still serves, and the LAST tier is never sibling-poisoned."""
+        release = threading.Event()
+
+        def slow():
+            release.wait(10.0)
+            return "slow"
+
+        sib_ran = []
+        out = cb.run_tiers(
+            "fam", [("pallas_lcauto", slow),
+                    ("pallas_lc1", lambda: sib_ran.append(1) or "sib"),
+                    ("xla_decode", lambda: 42)],
+            budget=0.2)
+        assert out == 42
+        assert sib_ran == []
+        # assert BEFORE release: late completion un-poisons the parked
+        # tier (by design), which would race these checks
+        assert cb.tier_state("fam", "pallas_lcauto") == "poisoned"
+        assert cb.tier_state("fam", "pallas_lc1") == "poisoned"
+        assert cb.tier_state("fam", "xla_decode") == "ok"
+        release.set()
+
+    def test_park_skips_only_same_family(self):
+        release = threading.Event()
+        out = cb.run_tiers(
+            "fam2", [("pallas_lcauto", lambda: release.wait(10.0)),
+                     ("xla_inverted", lambda: "x"),
+                     ("probe_major", lambda: "last")],
+            budget=0.2)
+        assert out == "x"
+        assert cb.tier_state("fam2", "xla_inverted") == "ok"
+        assert cb.tier_state("fam2", "probe_major") == "untried"
+        release.set()
+
     def test_poisoned_tier_skipped_next_call(self):
         calls = []
 

@@ -1,0 +1,182 @@
+"""Per-piece chained-marginal timing of the fused IVF-Flat search.
+
+The round-4 window showed search time nearly FLAT across a 10x size
+difference (small rung 13.9-16.7 ms vs full rung 14.7 ms chained) —
+a fixed cost dominates, not the scan. This tool times each piece of
+``fused_list_search`` as its own chained marginal (8 calls in one jit,
+best-of-3) so the fixed cost gets a name: coarse top-k, probe
+inversion (argsort), query gather, Pallas/XLA scan, candidate merge.
+
+Run: PYTHONPATH=.:/root/.axon_site python tools/profile_ivf_pieces.py
+Env: PROFILE_PLATFORM=cpu for harness smoke; PROFILE_N/NQ/NLISTS/
+NPROBES/CHAIN as profile_ivf_fused.
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if os.environ.get("PROFILE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["PROFILE_PLATFORM"])
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
+print(jax.devices(), flush=True)
+
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import _ivf_scan as S
+from raft_tpu.ops.dispatch import pallas_enabled, pallas_interpret
+
+key = jax.random.key(0)
+n = int(os.environ.get("PROFILE_N", 500_000))
+d, nq = 128, int(os.environ.get("PROFILE_NQ", 1000))
+k = 32
+nlists = int(os.environ.get("PROFILE_NLISTS", 1024))
+nprobes = int(os.environ.get("PROFILE_NPROBES", 64))
+CHAIN = int(os.environ.get("PROFILE_CHAIN", 8))
+db = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+qs = jax.random.normal(jax.random.fold_in(key, 2), (CHAIN, nq, d))
+q0 = qs[0]
+jax.block_until_ready((db, qs))
+
+idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=nlists,
+                                              kmeans_n_iters=10))
+jax.block_until_ready(idx.lists_data)
+max_list = idx.lists_data.shape[1]
+use_pallas = pallas_enabled()
+
+probes0 = S.coarse_probes(q0, idx.centers, nprobes,
+                          use_pallas=use_pallas)
+cap = S.probe_cap(probes0, nlists)
+print(f"n={n} nlists={nlists} nprobes={nprobes} cap={cap} "
+      f"max_list={max_list} pallas={use_pallas}", flush=True)
+
+
+def marginal(tag, fn, *captures):
+    """Chained marginal of one piece; captures ride as jit args."""
+    @jax.jit
+    def run(qb, *cap_):
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(CHAIN):
+            out = fn(qb[i], *cap_)
+            leaf = jax.tree.leaves(out)[0]
+            acc += leaf.reshape(-1)[0].astype(jnp.float32)
+        return acc
+    jax.block_until_ready(run(qs, *captures))
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(qs, *captures))
+        best = min(best, (time.perf_counter() - t0) / CHAIN)
+    print(f"{tag:24s}: {best*1e3:7.2f} ms/call", flush=True)
+    return best
+
+
+# 1. coarse GEMM + top-k probes
+marginal("coarse_probes",
+         lambda qb, c: S.coarse_probes(qb, c, nprobes,
+                                       use_pallas=use_pallas),
+         idx.centers)
+
+# 2. probe inversion (argsort + scatter), on fixed probes per link so
+#    the chain varies data without re-running coarse
+probes_c = jnp.stack([
+    S.coarse_probes(qs[i], idx.centers, nprobes, use_pallas=use_pallas)
+    for i in range(CHAIN)])
+jax.block_until_ready(probes_c)
+
+
+def inv_piece(qb, pc):
+    # qb unused; thread chain variety through pc rows instead
+    del qb
+    return S._invert_probes(pc[0], nlists, cap)
+
+
+@jax.jit
+def run_inv(pc):
+    acc = jnp.zeros((), jnp.float32)
+    for i in range(CHAIN):
+        qmap, inv_pos = S._invert_probes(pc[i], nlists, cap)
+        acc += qmap.reshape(-1)[0].astype(jnp.float32)
+        acc += inv_pos.reshape(-1)[0].astype(jnp.float32)
+    return acc
+
+
+jax.block_until_ready(run_inv(probes_c))
+best = np.inf
+for _ in range(3):
+    t0 = time.perf_counter()
+    jax.block_until_ready(run_inv(probes_c))
+    best = min(best, (time.perf_counter() - t0) / CHAIN)
+print(f"{'invert_probes':24s}: {best*1e3:7.2f} ms/call", flush=True)
+
+# 3. query gather through the inverted table
+qmap0, inv_pos0 = jax.jit(
+    lambda p: S._invert_probes(p, nlists, cap))(probes0)
+jax.block_until_ready((qmap0, inv_pos0))
+marginal("gather_query_rows",
+         lambda qb, qm: S.gather_query_rows(qb, qm), qmap0)
+
+# 4. the scan kernel alone at the fused-path layout
+if use_pallas:
+    from raft_tpu.ops.pallas_ivf_scan import (_Layout, _list_scan_call,
+                                              _pick_lc, lc_mode)
+    lay = _Layout(probes0, nlists, max_list, cap, 0, k)
+    data_p = lay.pad_lists(idx.lists_data, max_list)
+    norms_p = lay.pad_lists(idx.lists_norms, max_list)
+    ids_p = lay.pad_lists(idx.lists_indices, max_list, fill=-1)
+    jax.block_until_ready((data_p, norms_p, ids_p))
+    lc = _pick_lc(nlists, lay.mlp, lay.capp, d, data_p.dtype.itemsize,
+                  override=lc_mode())
+    print(f"scan layout: bins={lay.bins} lc={lc} mlp={lay.mlp} "
+          f"capp={lay.capp}", flush=True)
+    qsub_p0 = jax.jit(lambda qq, qm: S.gather_query_rows(qq, qm))(
+        q0, lay.padded_qmap())
+    jax.block_until_ready(qsub_p0)
+
+    def scan_piece(qb, dp, np_, ip):
+        qsub = S.gather_query_rows(qb, lay.padded_qmap())
+        return _list_scan_call(qsub, dp, np_, ip, lay.bins, lc, 1.0,
+                               pallas_interpret())
+    marginal("gather+pallas_scan", scan_piece, data_p, norms_p, ids_p)
+
+    cd0, ci0 = jax.jit(
+        lambda qsub, dp, np_, ip: _list_scan_call(
+            qsub, dp, np_, ip, lay.bins, lc, 1.0, pallas_interpret()))(
+        qsub_p0, data_p, norms_p, ids_p)
+    jax.block_until_ready((cd0, ci0))
+
+    # 5. the merge alone (candidates fixed; probes vary per link)
+    @jax.jit
+    def run_merge(pc, cd, ci):
+        acc = jnp.zeros((), jnp.float32)
+        for i in range(CHAIN):
+            qmap_i, inv_i = S._invert_probes(pc[i], nlists, cap)
+            dd, ii = lay.merge(cd, ci, pc[i], k, False)
+            acc += dd[0, 0] + ii[0, 0].astype(jnp.float32)
+        return acc
+    jax.block_until_ready(run_merge(probes_c, cd0, ci0))
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run_merge(probes_c, cd0, ci0))
+        best = min(best, (time.perf_counter() - t0) / CHAIN)
+    print(f"{'invert+merge':24s}: {best*1e3:7.2f} ms/call", flush=True)
+
+# 6. the whole fused search, for the total line
+sp = ivf_flat.SearchParams(n_probes=nprobes, probe_cap=cap)
+arrs = {k_: v for k_, v in vars(idx).items()
+        if isinstance(v, jax.Array)}
+aux = {k_: v for k_, v in vars(idx).items() if k_ not in arrs}
+
+
+def rebuild(a):
+    obj = object.__new__(type(idx))
+    obj.__dict__.update(aux)
+    obj.__dict__.update(a)
+    return obj
+
+
+marginal("fused_search_total",
+         lambda qb, a: ivf_flat.search(rebuild(a), qb, k, sp), arrs)

@@ -1,0 +1,79 @@
+#!/usr/bin/env bash
+# Round-4 follow-up measurements: stages that failed or predate fixes
+# in the main campaign run (tools/tpu_measure.sh), re-run against the
+# updated tree. Same rules: no `timeout` on TPU clients, probe between
+# stages, bank incrementally.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
+OUT=tools/measure_out
+mkdir -p "$OUT"
+
+probe() {
+  bash tools/tunnel_probe.sh 120 || {
+    echo "tunnel not healthy before stage $1; stopping"; exit 1; }
+}
+
+probe f1
+echo "== f1. fused IVF-Flat operating-point A/B (fixed: big operands"
+echo "==     as jit args — the closure form 413'd the relay)"
+python tools/profile_ivf_fused.py 2>&1 | tee "$OUT/ivf_fused_ab2.log"
+
+probe f2
+echo "== f2. PQ rescored headline with the DEVICE rescore tier"
+python - <<'EOF' 2>&1 | tee "$OUT/ivf_pq_device_rescore.log"
+import time, jax
+import jax.numpy as jnp
+import numpy as np
+from raft_tpu.core.compile_cache import enable as _enable_cache
+_enable_cache()
+from bench_suite import _sync, _time, _ivf_recall, _ann_dataset
+from raft_tpu.neighbors import ivf_pq, ivf_bq
+key = jax.random.key(0)
+n, d, nq, k = 500_000, 128, 1000, 32
+db, q = _ann_dataset(n, d, nq)
+t0 = time.perf_counter()
+idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, keep_raw=True))
+_sync(idx.codes)
+print("pq build", round(time.perf_counter() - t0, 1), "s", flush=True)
+for name, kw in [("estimator", dict(rescore_factor=0)),
+                 ("rescore8 device", dict(rescore_factor=8,
+                                          rescore_on_device="always")),
+                 ("rescore8 host", dict(rescore_factor=8,
+                                        rescore_on_device="never"))]:
+    sp = ivf_pq.SearchParams(n_probes=64, scan_mode="codes",
+                             lut_dtype=jnp.bfloat16, **kw)
+    dd, ii = ivf_pq.search(idx, q, k, sp)
+    rec = _ivf_recall(ii, db, q, k)
+    t = _time(lambda sp=sp: ivf_pq.search(idx, q, k, sp), reps=3)
+    print(f"ivf_pq {name}: {t*1000:.1f} ms -> {nq/t:.0f} QPS "
+          f"recall@{k}={rec:.4f}", flush=True)
+t0 = time.perf_counter()
+bidx = ivf_bq.build(db, ivf_bq.IndexParams(n_lists=1024))
+_sync(bidx.bits)
+print("bq build", round(time.perf_counter() - t0, 1), "s", flush=True)
+for name, kw in [("rescore8 device", dict(rescore_factor=8,
+                                          rescore_on_device="always")),
+                 ("rescore8 host", dict(rescore_factor=8,
+                                        rescore_on_device="never"))]:
+    sp = ivf_bq.SearchParams(n_probes=64, **kw)
+    dd, ii = ivf_bq.search(bidx, q, k, sp)
+    rec = _ivf_recall(ii, db, q, k)
+    t = _time(lambda sp=sp: ivf_bq.search(bidx, q, k, sp), reps=3)
+    print(f"ivf_bq {name}: {t*1000:.1f} ms -> {nq/t:.0f} QPS "
+          f"recall@{k}={rec:.4f}", flush=True)
+from raft_tpu.ops.compile_budget import snapshot
+print("ladders:", snapshot(), flush=True)
+EOF
+
+probe f2b
+echo "== f2b. per-piece chained marginals (name the fixed cost that"
+echo "==      keeps IVF-Flat at 0.55x brute)"
+python tools/profile_ivf_pieces.py 2>&1 | tee "$OUT/ivf_pieces.log"
+
+probe f3
+echo "== f3. flat grid-per-list (lc=1) full rung, for the tier record"
+RUNG=full RAFT_TPU_IVF_LC=1 python tools/ivf_compile_bisect.py 2>&1 \
+  | tee "$OUT/bisect_full_lc1_retry.log"
+
+echo "== follow-up done"
