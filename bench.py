@@ -232,9 +232,9 @@ def child_main():
                 case(rows, n=n_ivf, nlists=nlists)
                 r = rows[0]
                 out[f"{fam}_qps"] = r["value"]
-                # bq reports its DEVICE-phase marginal (the host rescore
-                # is excluded); keep the distinct key so family marginals
-                # are never compared as if they measured the same work
+                # all families chain the full serving path now (the
+                # exact re-rank runs on device); the device_marginal
+                # branch covers artifacts from pre-rescore-tier rows
                 if "marginal_qps" in r:
                     out[f"{fam}_marginal_qps"] = r["marginal_qps"]
                 elif "device_marginal_qps" in r:
