@@ -593,7 +593,11 @@ _CASES = [bench_pairwise_distance, bench_fused_l2_nn, bench_select_k,
           bench_fused_wide, bench_ivf_10m]
 
 
-def run_all(cases=None):
+def run_all(cases=None, stream=False):
+    """Run the selected cases. With ``stream``, print each case's rows
+    the moment the case completes (flushed) — a measurement window that
+    dies mid-suite still banks every finished case (round-4 lesson: the
+    tunnel has died mid-campaign in three consecutive rounds)."""
     import jax
     if "BENCH_PLATFORM" in os.environ:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
@@ -612,10 +616,14 @@ def run_all(cases=None):
             raise SystemExit(f"bench_suite: unknown case(s) {bad}; "
                              f"available: {sorted(known)}")
     for case in selected:
+        done = len(results)
         try:
             case(results)
         except Exception as e:  # a failing case must not kill the table
             results.append({"metric": case.__name__, "error": repr(e)})
+        if stream:
+            for r in results[done:]:
+                print(json.dumps(r), flush=True)
     return results
 
 
@@ -702,9 +710,7 @@ if __name__ == "__main__":
     gate = "--gate" in args
     if gate:
         args = [a for a in args if a != "--gate"]
-    results = run_all(args or None)
-    for r in results:
-        print(json.dumps(r))
+    results = run_all(args or None, stream=True)
     if gate:
         fails = check_gates(results, require_all=not args)
         for f in fails:
