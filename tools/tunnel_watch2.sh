@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Second-wave watcher: wait for any in-flight campaign client to die on
+# its own (NEVER killed — SIGTERM mid-remote-compile is the documented
+# wedge trigger), then probe on a cadence and launch the remaining
+# stages (tools/tpu_measure_remaining.sh) at the first healthy window.
+# One launch only (marker-guarded).
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/measure_out
+mkdir -p "$OUT"
+MARKER="$OUT/remaining_launched"
+LOG="$OUT/tunnel_watch2.log"
+
+say() { echo "$(date '+%m-%d %H:%M:%S') $*" >>"$LOG"; }
+
+say "watcher2 started (pid $$)"
+while :; do
+  if [ -f "$MARKER" ]; then
+    say "remaining campaign already launched; exiting"
+    exit 0
+  fi
+  # don't probe while a campaign client is still parked mid-compile:
+  # its eventual completion IS the resume path, and stacking clients
+  # on a busy serial compile queue helps nothing
+  if pgrep -f "bench_suite.py --gate" >/dev/null 2>&1; then
+    say "suite client still alive; waiting for it to resolve"
+    sleep 180
+    continue
+  fi
+  if ! (exec 3<>/dev/tcp/127.0.0.1/8093) 2>/dev/null; then
+    say "relay port 8093 down"
+    sleep 300
+    continue
+  fi
+  exec 3>&- 2>/dev/null || true
+  rm -f "$OUT/tunnel_probe.rc" "$OUT/tunnel_probe.pid"
+  if bash tools/tunnel_probe.sh 180 >>"$LOG" 2>&1; then
+    say "probe healthy — launching remaining stages"
+    date > "$MARKER"
+    nohup bash tools/tpu_measure_remaining.sh \
+      >>"$OUT/campaign_remaining.log" 2>&1 &
+    say "campaign pid $!"
+    exit 0
+  fi
+  say "probe not healthy yet"
+  sleep 240
+done
