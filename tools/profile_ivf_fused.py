@@ -104,7 +104,14 @@ print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS",
       flush=True)
 
 
-def run_point(cap, bins, idt):
+def run_point(cap, bins, idt, gather="rows"):
+    # the gather mode is resolved per call (gather_mode() inside
+    # ivf_flat.search reads the env outside jit), so flipping the env
+    # between points A/Bs the scalar-core row gather against the MXU
+    # one-hot gather — the query-gather cost depends only on
+    # (n_lists, cap, d), the exact signature of the ~13 ms fixed cost
+    # that kept the small and full rungs equally slow (BASELINE.md)
+    os.environ["RAFT_TPU_GATHER"] = gather
     sp = ivf_flat.SearchParams(
         n_probes=nprobes, scan_order="list", probe_cap=cap,
         scan_bins=bins, internal_distance_dtype=idt)
@@ -114,21 +121,26 @@ def run_point(cap, bins, idt):
         _rebuild_idx(a), qb, k, sp), _IDX_ARRS)
     tag = "bf16" if idt == jnp.bfloat16 else "f32"
     qps = nq / ms * 1000
-    print(f"cap={cap:3d} bins={bins:3d} idt={tag}: "
+    print(f"cap={cap:3d} bins={bins:3d} idt={tag} gather={gather:6s}: "
           f"{ms:6.2f} ms -> {qps:7.0f} QPS  "
           f"recall@{k}={rec:.4f}", flush=True)
     return qps, rec
 
 
 # bf16-first sweep (roofline: candidate-block traffic halves), then one
-# f32 check at the bf16 winner — 7 chained compiles instead of 12; each
-# cold chained compile costs minutes through the remote-compile tunnel
+# f32 check at the bf16 winner — each cold chained compile costs
+# minutes through the remote-compile tunnel, so the grid stays small
 best = None
 for cap in (128, 256, 64):
     for bins in (64, 128):
         qps, rec = run_point(cap, bins, jnp.bfloat16)
         if rec >= 0.95 and (best is None or qps > best[0]):
             best = (qps, cap, bins)
+# gather A/B at the serving default (cap=256) and a shed point: if the
+# one-hot MXU gather wins, it becomes the TPU default
+for cap in (256, 128):
+    run_point(cap, 64, jnp.bfloat16, gather="onehot")
+os.environ.pop("RAFT_TPU_GATHER", None)
 if best is not None:
     print(f"best bf16 point: cap={best[1]} bins={best[2]} "
           f"({best[0]:.0f} QPS); f32 check:", flush=True)
