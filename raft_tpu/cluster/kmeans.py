@@ -29,7 +29,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
-from raft_tpu.util.host_sample import sample_rows
+from raft_tpu.util.host_sample import sample_rows, take_rows
 
 
 def _weighted_update(x, labels, weights, n_clusters: int):
@@ -131,7 +131,7 @@ def sample_centroids(x, n_clusters: int, seed: int = 0, res=None) -> jax.Array:
     x = as_array(x)
     # host-side draw (util.host_sample): a traced choice(replace=False)
     # is an n-wide sort compile on TPU
-    return x[sample_rows(x.shape[0], n_clusters, seed)]
+    return take_rows(x, sample_rows(x.shape[0], n_clusters, seed))
 
 
 def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,

@@ -24,7 +24,7 @@ from jax import lax
 
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
-from raft_tpu.util.host_sample import sample_rows
+from raft_tpu.util.host_sample import sample_rows, take_rows
 
 
 def _nn(x, centers, kernel_precision=None):
@@ -87,7 +87,7 @@ def balanced_kmeans(x, n_clusters: int, n_iters: int = 20,
     x = as_array(x).astype(jnp.float32)
     # init indices sampled HOST-side (util.host_sample rationale: a
     # traced choice(replace=False) is an n-wide sort compile)
-    centers0 = x[sample_rows(x.shape[0], n_clusters, seed)]
+    centers0 = take_rows(x, sample_rows(x.shape[0], n_clusters, seed))
     return _em(x, centers0, n_clusters, n_iters, balance_threshold,
                kernel_precision=kernel_precision)
 
@@ -108,7 +108,7 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
     # host-side draw for the same no-giant-sort-compile reason as in
     # balanced_kmeans
     if n > max_train_points:
-        xt = x[sample_rows(n, max_train_points, seed)]
+        xt = take_rows(x, sample_rows(n, max_train_points, seed))
     else:
         xt = x
     nt = xt.shape[0]

@@ -61,7 +61,7 @@ from raft_tpu.core.precision import matmul_precision
 from raft_tpu.core import trace
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
-from raft_tpu.util.host_sample import sample_rows
+from raft_tpu.util.host_sample import sample_rows, take_rows
 
 
 @dataclass
@@ -176,7 +176,8 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
     with trace.range("ivf_bq::build(%d, %d)", n, params.n_lists):
         n_train = max(params.n_lists,
                       int(n * params.kmeans_trainset_fraction))
-        trainset = x[sample_rows(n, n_train, 0)] if n_train < n else x
+        trainset = (take_rows(x, sample_rows(n, n_train, 0))
+                    if n_train < n else x)
         centers = kmeans_balanced.build_hierarchical(
             trainset, params.n_lists, params.kmeans_n_iters,
             kernel_precision=params.kmeans_kernel_precision, res=res)

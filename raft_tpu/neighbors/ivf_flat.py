@@ -36,7 +36,7 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.pairwise import _l2_expanded
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core.precision import matmul_precision
-from raft_tpu.util.host_sample import sample_rows
+from raft_tpu.util.host_sample import sample_rows, take_rows
 
 
 @dataclass
@@ -151,6 +151,8 @@ def _bucketize_static(x, labels, row_ids, n_lists: int, max_list: int,
     if counts is None:
         counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
                                      num_segments=n_lists)
+    if row_ids is None:  # default ids 0..n-1, built in-trace (None is a
+        row_ids = jnp.arange(n, dtype=jnp.int32)  # static arg structure)
     order = jnp.argsort(labels, stable=True)
     sorted_labels = labels[order]
     # position of each row within its list
@@ -179,17 +181,23 @@ def _bucketize(x, labels, n_lists: int, round_to: int = 8,
     sync); sharded builds pre-agree a width and call the static core.
     ``row_ids`` defaults to 0..n-1 (fresh builds); extends pass the
     combined global ids."""
-    n = x.shape[0]
-    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
-                                 num_segments=n_lists)
-    max_list = int(jax.device_get(jnp.max(counts)))
+    counts, mx = _counts_and_max(labels, n_lists)
+    max_list = int(jax.device_get(mx))
     max_list = max(round_to, (max_list + round_to - 1) // round_to * round_to)
-    if row_ids is None:
-        row_ids = jnp.arange(n, dtype=jnp.int32)
     data, idx, norms, counts = _bucketize_static(
         x, labels, row_ids, n_lists, max_list, counts=counts,
         compute_norms=compute_norms)
     return data, idx, norms, counts
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists",))
+def _counts_and_max(labels, n_lists: int):
+    """Per-list counts + their max as ONE program (the max is the one
+    host sync of the bucketing path; eager this was 4+ tiny remote
+    compiles on the tunneled platform)."""
+    counts = jax.ops.segment_sum(
+        jnp.ones(labels.shape, jnp.int32), labels, num_segments=n_lists)
+    return counts, jnp.max(counts)
 
 
 _SIM_METRICS = (DistanceType.InnerProduct, DistanceType.CosineExpanded)
@@ -240,7 +248,7 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         # host-side (util.host_sample): a traced choice(replace=False)
         # is an n-wide sort compile on TPU
         if n_train < n:
-            trainset = x[sample_rows(n, n_train, 0)]
+            trainset = take_rows(x, sample_rows(n, n_train, 0))
         else:
             trainset = x
         centers = kmeans_balanced.build_hierarchical(

@@ -46,7 +46,7 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.neighbors.ivf_flat import _bucketize
 from raft_tpu.core.precision import matmul_precision
-from raft_tpu.util.host_sample import sample_rows
+from raft_tpu.util.host_sample import sample_rows, take_rows
 
 
 class CodebookGen(enum.IntEnum):
@@ -187,6 +187,21 @@ class Index:
         return self.rotation_matrix.shape[0]
 
 
+@functools.partial(jax.jit, static_argnames=("dim", "rot_dim"))
+def _rotation_qr(seed_arr, dim: int, rot_dim: int):
+    """jit core of :func:`make_rotation_matrix` — one program instead of
+    an eager op per step (every eager op is its own remote compile on
+    the tunneled TPU platform; cold-build time is compile-count-bound)."""
+    g = jax.random.normal(jax.random.wrap_key_data(seed_arr),
+                          (max(rot_dim, dim), dim), dtype=jnp.float32)
+    q, _ = jnp.linalg.qr(g.T @ g + 1e-4 * jnp.eye(dim))
+    full = q.T  # (dim, dim) orthogonal
+    if rot_dim <= dim:
+        return full[:rot_dim]
+    pad = jnp.zeros((rot_dim - dim, dim), jnp.float32)
+    return jnp.concatenate([full, pad], axis=0)
+
+
 def make_rotation_matrix(dim: int, rot_dim: int, force_random: bool = False,
                          seed: int = 7) -> jax.Array:
     """Random orthogonal (rot_dim, dim) via QR of a gaussian (reference
@@ -194,14 +209,20 @@ def make_rotation_matrix(dim: int, rot_dim: int, force_random: bool = False,
     allowed — but the reference always rotates when padding is needed."""
     if rot_dim == dim and not force_random:
         return jnp.eye(dim, dtype=jnp.float32)
-    g = jax.random.normal(jax.random.key(seed), (max(rot_dim, dim), dim),
-                          dtype=jnp.float32)
-    q, _ = jnp.linalg.qr(g.T @ g + 1e-4 * jnp.eye(dim))
-    full = q.T  # (dim, dim) orthogonal
-    if rot_dim <= dim:
-        return full[:rot_dim]
-    pad = jnp.zeros((rot_dim - dim, dim), jnp.float32)
-    return jnp.concatenate([full, pad], axis=0)
+    key_data = jax.random.key_data(jax.random.key(seed))
+    return _rotation_qr(key_data, dim, rot_dim)
+
+
+@jax.jit
+def _prep_rotated(x, centers, labels, rot):
+    """Rotation + residual phase as ONE program: centers_rot, residuals,
+    residuals_rot (reference ivf_pq_build.cuh:908 does the same three
+    GEMM/gather steps; eagerly they are 4+ separate remote compiles)."""
+    centers_rot = jnp.matmul(centers, rot.T, precision=matmul_precision())
+    residuals = x - centers[labels]
+    residuals_rot = jnp.matmul(residuals, rot.T,
+                               precision=matmul_precision())
+    return centers_rot, residuals_rot
 
 
 def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
@@ -371,7 +392,7 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     if n_train < n:
         # host-side draw (util.host_sample): a traced
         # choice(replace=False) is an n-wide sort compile on TPU
-        trainset = x[sample_rows(n, n_train, seed)]
+        trainset = take_rows(x, sample_rows(n, n_train, seed))
     else:
         trainset = x
     centers = kmeans_balanced.build_hierarchical(
@@ -381,11 +402,7 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
 
     rot = make_rotation_matrix(dim, rot_dim, params.force_random_rotation,
                                seed=seed + 1)
-    centers_rot = jnp.matmul(centers, rot.T, precision=matmul_precision())
-
-    residuals = x - centers[labels]
-    residuals_rot = jnp.matmul(residuals, rot.T,
-                               precision=matmul_precision())
+    centers_rot, residuals_rot = _prep_rotated(x, centers, labels, rot)
 
     if params.codebook_kind == CodebookGen.PER_CLUSTER:
         # one codebook per coarse cluster (reference train_per_cluster):
@@ -425,7 +442,7 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
 
     n_cb_train = min(n, 1 << 16)
     if n_cb_train < n:
-        cb_trainset = residuals_rot[sample_rows(n, n_cb_train, seed + 3)]
+        cb_trainset = take_rows(residuals_rot, sample_rows(n, n_cb_train, seed + 3))
     else:
         cb_trainset = residuals_rot
     pq_centers = _train_codebooks_per_subspace(
@@ -435,10 +452,12 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
 
     codes = _encode(residuals_rot, pq_centers)  # (n, pq_dim) u8
 
-    # bucket codes by list using the same static padded layout as IVF-Flat
-    data_f = codes.astype(jnp.float32)
-    bucketed, idx, _, counts = _bucketize(data_f, labels, params.n_lists)
-    codes_b = bucketed.astype(jnp.uint8)
+    # bucket codes by list using the same static padded layout as
+    # IVF-Flat — directly as uint8 (integer payload: no norms pass, no
+    # f32 round-trip casts; same contract as the ivf_bq int32 payloads)
+    bucketed, idx, _, counts = _bucketize(codes, labels, params.n_lists,
+                                          compute_norms=False)
+    codes_b = bucketed
 
     # the bf16 reconstruction cache is decoded lazily at first
     # reconstruct-mode search — codes/LUT-mode users and serialized
