@@ -46,12 +46,12 @@ def _round_cap(want: int, nq: int) -> int:
 
 def probe_cap(probes, n_lists: int) -> int:
     """Smallest safe static width for the inverted table: the max number
-    of queries probing any one list, bucketed by ``_round_cap``."""
-    counts = jax.ops.segment_sum(
-        jnp.ones(probes.size, jnp.int32), probes.reshape(-1),
-        num_segments=n_lists)
-    m = int(jax.device_get(jnp.max(counts)))
-    return _round_cap(m, probes.shape[0])
+    of queries probing any one list, bucketed by ``_round_cap``. The
+    count+max runs as one program (``_counts_and_max``) — the measure
+    path is a cold-compile site on the tunneled platform."""
+    from raft_tpu.neighbors.ivf_flat import _counts_and_max
+    _, m = _counts_and_max(probes.reshape(-1), n_lists)
+    return _round_cap(int(jax.device_get(m)), probes.shape[0])
 
 
 def _invert_probes(probes, n_lists: int, cap: int):

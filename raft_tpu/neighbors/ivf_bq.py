@@ -192,42 +192,59 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
         # PQ rotation
         from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
         rot = make_rotation_matrix(d, d, force_random=True)
-        # full-precision rotation: the sign code IS the payload, and
-        # TPU default-precision (single-pass bf16) matmul flips signs
-        # of near-zero rotated components vs host f32 math — observed
-        # on hardware 2026-08-02 (bq_roundtrip_check stage 0a)
-        r = jnp.matmul(x - centers[labels], rot.T,
-                       precision=matmul_precision())
-        norms2 = jnp.sum(r * r, axis=1)
-        scales = jnp.mean(jnp.abs(r), axis=1)
-        words = _pack_bits(r)
-        # bucketize one combined INT32 payload (word bit-patterns +
-        # bitcast norm/scale columns): int32 has no canonicalization
-        # hazard, unlike f32 whose NaN-patterned bitcasts XLA may
-        # rewrite in concatenate/gather/scatter (ADVICE r3 #2); the
-        # squared-norm pass over the payload is skipped outright
+        payload, centers_rot = _encode_payload(x, centers, labels, rot)
         from raft_tpu.neighbors.ivf_flat import _bucketize
-        payload = jnp.concatenate(
-            [lax.bitcast_convert_type(words, jnp.int32),
-             lax.bitcast_convert_type(norms2[:, None], jnp.int32),
-             lax.bitcast_convert_type(scales[:, None], jnp.int32)],
-            axis=1)
         bucketed, idx, _, counts = _bucketize(payload, labels,
                                               params.n_lists,
                                               compute_norms=False)
-        w = words.shape[1]
-        bits = lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32)
+        w = payload.shape[1] - 2
+        bits, norms2, scales = _split_payload(bucketed, w)
         raw = np.asarray(jax.device_get(x)) if params.keep_raw else None
-    return Index(centers=centers,
-                 centers_rot=jnp.matmul(centers, rot.T,
-                                        precision=matmul_precision()),
-                 rotation_matrix=rot, bits=bits,
-                 norms2=lax.bitcast_convert_type(bucketed[:, :, w],
-                                                 jnp.float32),
-                 scales=lax.bitcast_convert_type(bucketed[:, :, w + 1],
-                                                 jnp.float32),
+    return Index(centers=centers, centers_rot=centers_rot,
+                 rotation_matrix=rot, bits=bits, norms2=norms2,
+                 scales=scales,
                  lists_indices=idx, list_sizes=counts,
                  metric=params.metric, size=n, raw=raw)
+
+
+@jax.jit
+def _encode_payload(x, centers, labels, rot):
+    """Residual rotation + sign-pack + payload assembly as ONE program
+    (eagerly this phase was ~20 op-by-op remote compiles; cold build is
+    compile-count-bound through the tunnel).
+
+    Full-precision rotation: the sign code IS the payload, and TPU
+    default-precision (single-pass bf16) matmul flips signs of
+    near-zero rotated components vs host f32 math — observed on
+    hardware 2026-08-02 (bq_roundtrip_check stage 0a).
+
+    The payload is one combined INT32 block (word bit-patterns +
+    bitcast norm/scale columns): int32 has no canonicalization hazard,
+    unlike f32 whose NaN-patterned bitcasts XLA may rewrite in
+    concatenate/gather/scatter (ADVICE r3 #2); the squared-norm pass
+    over the payload is skipped outright (compute_norms=False)."""
+    r = jnp.matmul(x - centers[labels], rot.T,
+                   precision=matmul_precision())
+    norms2 = jnp.sum(r * r, axis=1)
+    scales = jnp.mean(jnp.abs(r), axis=1)
+    words = _pack_bits(r)
+    payload = jnp.concatenate(
+        [lax.bitcast_convert_type(words, jnp.int32),
+         lax.bitcast_convert_type(norms2[:, None], jnp.int32),
+         lax.bitcast_convert_type(scales[:, None], jnp.int32)],
+        axis=1)
+    centers_rot = jnp.matmul(centers, rot.T,
+                             precision=matmul_precision())
+    return payload, centers_rot
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _split_payload(bucketed, w: int):
+    """Bucketed int32 payload → (bits u32, norms2 f32, scales f32)."""
+    bits = lax.bitcast_convert_type(bucketed[:, :, :w], jnp.uint32)
+    norms2 = lax.bitcast_convert_type(bucketed[:, :, w], jnp.float32)
+    scales = lax.bitcast_convert_type(bucketed[:, :, w + 1], jnp.float32)
+    return bits, norms2, scales
 
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
