@@ -127,6 +127,15 @@ def run_point(cap, bins, idt, gather="rows"):
     return qps, rec
 
 
+# PROFILE_GRID=small: one serving point + its gather A/B — for probes
+# sweeps (the ≥0.90-recall operating point hunt) where the full grid
+# would burn the window on cold chained compiles
+if os.environ.get("PROFILE_GRID") == "small":
+    qps, rec = run_point(256, 64, jnp.bfloat16)
+    run_point(256, 64, jnp.bfloat16, gather="onehot")
+    os.environ.pop("RAFT_TPU_GATHER", None)
+    raise SystemExit(0)
+
 # bf16-first sweep (roofline: candidate-block traffic halves), then one
 # f32 check at the bf16 winner — each cold chained compile costs
 # minutes through the remote-compile tunnel, so the grid stays small

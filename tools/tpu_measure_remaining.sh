@@ -33,6 +33,14 @@ echo "[$(stamp)] == f1. fused IVF-Flat operating-point A/B (gather modes, caps)"
 python tools/profile_ivf_fused.py 2>&1 | tee "$OUT/ivf_fused_ab2.log"
 cp -f "$OUT/ivf_fused_ab2.log" docs/measurements/ 2>/dev/null || true
 
+probe f1b
+echo "[$(stamp)] == f1b. probes sweep for the >=0.90-recall flat headline"
+for NP in 96 128; do
+  PROFILE_GRID=small PROFILE_NPROBES=$NP python tools/profile_ivf_fused.py \
+    2>&1 | tee "$OUT/ivf_fused_p$NP.log"
+  cp -f "$OUT/ivf_fused_p$NP.log" docs/measurements/ 2>/dev/null || true
+done
+
 probe s4b
 echo "[$(stamp)] == s4b. reference-scale shapes (2M/10M x 128, 10k x 8192)"
 BENCH_BIG=1 python bench_suite.py \
