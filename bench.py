@@ -175,6 +175,11 @@ def child_main():
         "value": round(qps, 1),
         "unit": "queries/s",
         "vs_baseline": round(BASELINE_PROXY_MS / ms, 3),
+        # measurement timestamp embedded AT WRITE TIME so a later
+        # degraded run can prove a banked green line is same-round
+        # (file mtime is useless provenance: it becomes checkout time
+        # after a fresh clone — ADVICE r4 #1)
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     if platform not in ("tpu", "axon"):
         out["degraded_platform"] = platform
@@ -284,12 +289,34 @@ def _run_child(extra_env, timeout_s):
     return None, f"{rc_note}: " + " | ".join(tail)
 
 
-def _last_green_tpu():
+def _last_green_tpu(path=None):
     """The most recent non-degraded TPU headline banked by the
-    measurement campaign (docs/measurements/headline.log), with the
-    file's mtime as provenance — or None."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "docs", "measurements", "headline.log")
+    measurement campaign (docs/measurements/headline.log).
+
+    Returns ``(entry, same_round)``: ``same_round`` is True only when
+    the entry carries an embedded ``measured_at`` (written by
+    child_main at measurement time) that postdates the ROUND-START
+    MARKER (tools/measure_out/round_start.iso, written by the round's
+    builder session / measurement campaign). Without a marker, a
+    tight BENCH_GREEN_MAX_AGE_H age cap (default 4 h — rounds have
+    measured 2.5-4 h) is the fallback; either way a 24 h hard cap
+    applies (a stale marker from an abandoned round must not promote
+    day-old numbers). Entries without an embedded timestamp cannot be
+    proven same-round (mtime is checkout time after a clone) and are
+    reported stale (ADVICE r4 #1). Returns ``(None, False)`` when no
+    green entry exists."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    if path is None:
+        path = os.path.join(here, "docs", "measurements", "headline.log")
+    round_start = None
+    try:
+        with open(os.path.join(here, "tools", "measure_out",
+                               "round_start.iso")) as f:
+            round_start = time.mktime(time.strptime(
+                f.read().strip(), "%Y-%m-%dT%H:%M:%S"))
+    except (OSError, ValueError):
+        pass
+    max_age_s = float(os.environ.get("BENCH_GREEN_MAX_AGE_H", 4)) * 3600
     try:
         with open(path) as f:
             lines = f.read().strip().splitlines()
@@ -301,13 +328,24 @@ def _last_green_tpu():
             if (isinstance(obj, dict) and "metric" in obj
                     and not obj.get("degraded")
                     and "degraded_platform" not in obj):
-                obj["measured_at"] = time.strftime(
-                    "%Y-%m-%dT%H:%M:%S", time.localtime(
-                        os.path.getmtime(path)))
-                return obj
+                same_round = False
+                ts = obj.get("measured_at")
+                if ts:
+                    try:
+                        t_meas = time.mktime(
+                            time.strptime(ts, "%Y-%m-%dT%H:%M:%S"))
+                        age = time.time() - t_meas
+                        if round_start is not None:
+                            same_round = (t_meas >= round_start
+                                          and 0 <= age < 24 * 3600)
+                        else:
+                            same_round = 0 <= age < max_age_s
+                    except ValueError:
+                        pass
+                return obj, same_round
     except OSError:
         pass
-    return None
+    return None, False
 
 
 def _relay_listening() -> bool:
@@ -371,15 +409,17 @@ def parent_main():
         errors.append(f"tpu[{attempt}]: {err}")
         print(f"# bench attempt {attempt} failed: {err}", file=sys.stderr)
 
-    # degraded path: measure on CPU at a reduced shape so the round still
-    # has a perf artifact (flagged via the metric name + degraded key).
-    # If a GREEN TPU run was banked earlier the same round
-    # (docs/measurements/headline.log — written by the measurement
-    # campaign the moment a healthy window produces one), attach it
-    # under its own clearly-labeled key: the tunnel has died mid-round
-    # in every round so far, and a wedged service at driver-bench time
-    # must not erase evidence measured hours earlier.
-    banked = _last_green_tpu()
+    # TPU is unreachable at driver-bench time. If a GREEN TPU headline
+    # was banked earlier THE SAME ROUND (docs/measurements/headline.log,
+    # written by the measurement campaign the moment a healthy window
+    # produces one, with the timestamp embedded at measurement time),
+    # the green row IS the headline: the artifact's contract is "the
+    # framework's measured performance", and a wedged tunnel at
+    # driver-bench time does not change what was measured hours earlier
+    # (VERDICT r4 #5 — four rounds of vs_baseline:0.05 told the wrong
+    # story). Only the provenance keys say the driver-time probe
+    # degraded. A CPU sanity run still executes and rides along.
+    banked, same_round = _last_green_tpu()
     result, err = _run_child(
         {"BENCH_PLATFORM": "cpu",
          "BENCH_N_DB": str(min(N_DB, 100_000)),
@@ -388,8 +428,23 @@ def parent_main():
     if result is not None:
         result["degraded"] = True
         result["errors"] = errors
+        if banked is not None and same_round:
+            out = dict(banked)
+            out["headline_source"] = (
+                "same-round green TPU measurement "
+                "(docs/measurements/headline.log)")
+            out["driver_probe_degraded"] = True
+            out["driver_probe_errors"] = errors
+            out["driver_time_cpu_check"] = {
+                k: result[k] for k in ("metric", "value", "recall")
+                if k in result}
+            print(json.dumps(out), flush=True)
+            return 0
         if banked is not None:
-            result["same_round_green_tpu"] = banked
+            # green evidence exists but cannot be proven same-round
+            # (no embedded timestamp, or older than the round window):
+            # attach honestly under a stale label, never as headline
+            result["prior_green_tpu_stale"] = banked
         print(json.dumps(result), flush=True)
         return 0
     errors.append(f"cpu: {err}")

@@ -34,7 +34,12 @@ def take_rows(x, idx):
     to ~11 tiny op-by-op programs (convert/broadcast/gather/...), and on
     the tunneled TPU platform every program is its own remote-compile
     RPC — cold build time is compile-count-bound (round-4 measurement:
-    the 500k IVF-PQ cold build spent ~350 s of its 357 s compiling)."""
+    the 500k IVF-PQ cold build spent ~350 s of its 357 s compiling).
+
+    Precondition: every ``idx`` entry must be in ``[0, len(x))``. The
+    gather under jit CLAMPS out-of-bounds indices silently (XLA
+    semantics), unlike an eager ``x[idx]`` on some backends — callers
+    that compute indices host-side should validate before calling."""
     return x[idx]
 
 

@@ -8,18 +8,22 @@ class TestPerfGates:
     — the role of the reference's recall thresholds + gbench tracking)."""
 
     def _rows(self, **over):
+        # metric names derive from the suite's operating-point
+        # constants: a moved headline point must move its gates with it
+        import bench_suite as bs
+        fp, ip = bs.FLAT_PROBES, bs.IVF_PROBES
         rows = [{"metric": "pairwise_L2Expanded_8192x8192x256_ms",
                  "value": 10.0},
                 {"metric": "pairwise_L1_8192x8192x256_ms", "value": 50.0},
                 {"metric": "bfknn_fused_500kx128_q1000_k32_qps",
                  "value": 90_000.0},
-                {"metric": "ivf_flat_search_500kx128_q1000_k32_p64_qps",
+                {"metric": f"ivf_flat_search_500kx128_q1000_k32_p{fp}_qps",
                  "value": 50_000.0, "recall": 0.93},
-                {"metric": "ivf_pq_search_500kx128_q1000_k32_p64_qps",
+                {"metric": f"ivf_pq_search_500kx128_q1000_k32_p{ip}_qps",
                  "value": 50_000.0, "recall": 0.92},
-                {"metric": "ivf_pq4_search_500kx128_q1000_k32_p64_qps",
+                {"metric": f"ivf_pq4_search_500kx128_q1000_k32_p{ip}_qps",
                  "value": 50_000.0, "recall": 0.90},
-                {"metric": "ivf_bq_search_500kx128_q1000_k32_p64_qps",
+                {"metric": f"ivf_bq_search_500kx128_q1000_k32_p{ip}_qps",
                  "value": 50_000.0, "recall": 0.70}]
         for r in rows:
             if r["metric"] in over:
@@ -40,8 +44,9 @@ class TestPerfGates:
 
     def test_qps_floor_trip(self):
         import bench_suite
-        fails = bench_suite.check_gates(self._rows(
-            **{"ivf_flat_search_500kx128_q1000_k32_p64_qps": 100.0}))
+        fails = bench_suite.check_gates(self._rows(**{
+            f"ivf_flat_search_500kx128_q1000_k32"
+            f"_p{bench_suite.FLAT_PROBES}_qps": 100.0}))
         assert fails and fails[0]["kind"] == "floor"
 
     def test_missing_metric_is_a_failure(self):
@@ -59,7 +64,8 @@ class TestPerfGates:
 
     def test_recall_gate_trips(self):
         import bench_suite
-        metric = "ivf_pq_search_500kx128_q1000_k32_p64_qps"
+        metric = (f"ivf_pq_search_500kx128_q1000_k32"
+                  f"_p{bench_suite.IVF_PROBES}_qps")
         rows = self._rows(**{})
         for r in rows:
             if r["metric"] == metric:
@@ -72,7 +78,8 @@ class TestPerfGates:
         """A recall-gated row that didn't run (case errored, or its
         recall field vanished) is a failure under require_all."""
         import bench_suite
-        metric = "ivf_pq_search_500kx128_q1000_k32_p64_qps"
+        metric = (f"ivf_pq_search_500kx128_q1000_k32"
+                  f"_p{bench_suite.IVF_PROBES}_qps")
         rows = [r for r in self._rows() if r["metric"] != metric]
         fails = bench_suite.check_gates(rows, require_all=True)
         assert any(f["kind"] == "missing" and f["metric"] == metric
@@ -87,3 +94,77 @@ class TestPerfGates:
         fails2 = bench_suite.check_gates(rows2, require_all=True)
         assert any(f["kind"] == "missing" and f["metric"] == metric
                    for f in fails2)
+
+
+class TestUnknownCase:
+    def test_typod_case_name_refuses_to_run(self):
+        """An unknown case name must never yield a silent empty run —
+        a typo'd --gate invocation exiting green having measured
+        nothing (VERDICT r4 #9)."""
+        import pytest
+        import bench_suite
+        with pytest.raises(SystemExit, match="unknown case"):
+            bench_suite.run_all(["ivf_flatt"])
+
+
+class TestGreenHeadlineLookup:
+    """bench._last_green_tpu: the degraded driver-bench path promotes a
+    banked green TPU headline ONLY when its embedded measurement
+    timestamp proves it same-round (ADVICE r4 #1)."""
+
+    def _write(self, tmp_path, lines):
+        import json
+        p = tmp_path / "headline.log"
+        p.write_text("\n".join(json.dumps(o) for o in lines) + "\n")
+        return str(p)
+
+    def test_fresh_embedded_timestamp_is_same_round(self, tmp_path):
+        import time
+        import bench
+        now = time.strftime("%Y-%m-%dT%H:%M:%S")
+        path = self._write(tmp_path, [
+            {"metric": "m", "value": 1.0, "unit": "qps",
+             "vs_baseline": 2.0, "measured_at": now}])
+        entry, same_round = bench._last_green_tpu(path)
+        assert entry["metric"] == "m" and same_round
+
+    def test_no_embedded_timestamp_is_stale(self, tmp_path):
+        """Entries written before the timestamp-embedding change (or
+        with mtime-only provenance) cannot be proven same-round."""
+        import bench
+        path = self._write(tmp_path, [
+            {"metric": "m", "value": 1.0, "unit": "qps",
+             "vs_baseline": 2.0}])
+        entry, same_round = bench._last_green_tpu(path)
+        assert entry is not None and not same_round
+
+    def test_old_embedded_timestamp_is_stale(self, tmp_path):
+        import time
+        import bench
+        old = time.strftime("%Y-%m-%dT%H:%M:%S",
+                            time.localtime(time.time() - 48 * 3600))
+        path = self._write(tmp_path, [
+            {"metric": "m", "value": 1.0, "unit": "qps",
+             "vs_baseline": 2.0, "measured_at": old}])
+        entry, same_round = bench._last_green_tpu(path)
+        assert entry is not None and not same_round
+
+    def test_degraded_entries_skipped(self, tmp_path):
+        import time
+        import bench
+        now = time.strftime("%Y-%m-%dT%H:%M:%S")
+        path = self._write(tmp_path, [
+            {"metric": "green", "value": 1.0, "unit": "qps",
+             "vs_baseline": 2.0, "measured_at": now},
+            {"metric": "cpu", "value": 0.1, "unit": "qps",
+             "vs_baseline": 0.05, "degraded_platform": "cpu"},
+            {"metric": "deg", "value": 0.1, "unit": "qps",
+             "vs_baseline": 0.05, "degraded": True}])
+        entry, same_round = bench._last_green_tpu(path)
+        assert entry["metric"] == "green" and same_round
+
+    def test_missing_file(self, tmp_path):
+        import bench
+        entry, same_round = bench._last_green_tpu(
+            str(tmp_path / "nope.log"))
+        assert entry is None and not same_round

@@ -98,10 +98,26 @@ def recall_of(ii):
     return hits / (nq * k)
 
 
-ms = chained(lambda qb, dbb: brute_force.brute_force_knn(
-    dbb, qb, k, mode="fused"), db)
-print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS",
-      flush=True)
+# the chained brute timing is the line-to-beat for the FULL grid; the
+# small/probes-sweep mode skips it (its cold chained compile is exactly
+# the window cost the mode exists to avoid — the exact-scan ground
+# truth above is all recall needs)
+if os.environ.get("PROFILE_GRID") != "small":
+    ms = chained(lambda qb, dbb: brute_force.brute_force_knn(
+        dbb, qb, k, mode="fused"), db)
+    print(f"brute fused chained: {ms:.2f} ms -> {nq/ms*1000:.0f} QPS",
+          flush=True)
+
+# run_point flips RAFT_TPU_GATHER per point; preserve any user-exported
+# value across the sweep instead of clobbering it
+_GATHER_SAVED = os.environ.get("RAFT_TPU_GATHER")
+
+
+def _restore_gather():
+    if _GATHER_SAVED is None:
+        os.environ.pop("RAFT_TPU_GATHER", None)
+    else:
+        os.environ["RAFT_TPU_GATHER"] = _GATHER_SAVED
 
 
 def run_point(cap, bins, idt, gather="rows"):
@@ -133,7 +149,7 @@ def run_point(cap, bins, idt, gather="rows"):
 if os.environ.get("PROFILE_GRID") == "small":
     qps, rec = run_point(256, 64, jnp.bfloat16)
     run_point(256, 64, jnp.bfloat16, gather="onehot")
-    os.environ.pop("RAFT_TPU_GATHER", None)
+    _restore_gather()
     raise SystemExit(0)
 
 # bf16-first sweep (roofline: candidate-block traffic halves), then one
@@ -149,7 +165,6 @@ for cap in (128, 256, 64):
 # one-hot MXU gather wins, it becomes the TPU default
 for cap in (256, 128):
     run_point(cap, 64, jnp.bfloat16, gather="onehot")
-os.environ.pop("RAFT_TPU_GATHER", None)
 if best is not None:
     print(f"best bf16 point: cap={best[1]} bins={best[2]} "
           f"({best[0]:.0f} QPS); f32 check:", flush=True)
@@ -159,3 +174,4 @@ else:
           "probed lists too hard (or smoke-scale shapes); f32 check at "
           "the widest point:", flush=True)
     run_point(256, 128, jnp.float32)
+_restore_gather()  # after the LAST run_point (each one sets the env)
