@@ -44,9 +44,11 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.cluster import kmeans_balanced
-from raft_tpu.neighbors.ivf_flat import _bucketize
+from raft_tpu.neighbors.ivf_flat import (_bucketize, _bucketize_static,
+                                         _counts_and_max)
 from raft_tpu.core.precision import matmul_precision
-from raft_tpu.util.host_sample import sample_rows, take_rows
+from raft_tpu.util.host_sample import (sample_rows, sample_rows_np,
+                                       take_rows)
 
 
 class CodebookGen(enum.IntEnum):
@@ -208,7 +210,10 @@ def make_rotation_matrix(dim: int, rot_dim: int, force_random: bool = False,
     ivf_pq_build.cuh:173). When rot_dim == dim and not forced, identity is
     allowed — but the reference always rotates when padding is needed."""
     if rot_dim == dim and not force_random:
-        return jnp.eye(dim, dtype=jnp.float32)
+        # numpy identity + transfer: jnp.eye eagerly compiles ~5 tiny
+        # programs (iota/add/equal/convert) — one remote-compile RPC
+        # each on the tunneled TPU platform
+        return jnp.asarray(np.eye(dim, dtype=np.float32))
     key_data = jax.random.key_data(jax.random.key(seed))
     return _rotation_qr(key_data, dim, rot_dim)
 
@@ -225,29 +230,130 @@ def _prep_rotated(x, centers, labels, rot):
     return centers_rot, residuals_rot
 
 
+@jax.jit
+def _labels_and_prep(x, centers, rot):
+    """Coarse assignment + rotation/residual phase as ONE program
+    (predict's fused-L2-NN argmin is traceable — folding it in saves
+    its separate remote compile; VERDICT r4 #6 compile-count collapse)."""
+    from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+    labels = fused_l2_nn(x, centers, sqrt=False).key
+    centers_rot, residuals_rot = _prep_rotated(x, centers, labels, rot)
+    return labels, centers_rot, residuals_rot
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "pq_len",
+                                             "n_codes", "n_iters",
+                                             "chunk"))
+def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
+                         pq_dim: int, pq_len: int, n_codes: int,
+                         n_iters: int, chunk: int):
+    """All pq_dim subspace codebooks trained in ONE compiled program —
+    the balanced-EM semantics of the former per-subspace
+    balanced_kmeans loop (assignment + masked mean + small-cluster
+    reseed from the globally worst-cost points, reference
+    train_per_subset ivf_pq_build.cuh:464 + adjust_centers :436),
+    batched over the subspace axis and row-chunked so the (S, B, C)
+    distance blocks stay bounded.
+
+    Why one program: round-4 measured the 500k PQ cold build at 357 s
+    vs 3.7 s warm — compile-COUNT-bound through the remote-compile
+    tunnel, and the sequential loop's traced init sampler + glue was
+    ~12 of the ~32 programs (VERDICT r4 #6). The earlier revert note
+    ("batched was 25% slower on CPU") predates that measurement: the
+    few-hundred-ms warm difference is noise against ~10-20 s saved
+    per removed compile.
+
+    residuals_rot (n, rot_dim); cb_idx (m_pad,) int32 trainset rows
+    (cyclically padded to a chunk multiple); valid (m_pad,) bool marks
+    real rows; init_idx (pq_dim, n_codes) int32 init positions INTO
+    the trainset. Returns (pq_dim, n_codes, pq_len) codebooks."""
+    m = cb_idx.shape[0]
+    tr = residuals_rot[cb_idx]                          # (m, rot_dim)
+    sub = tr.reshape(m, pq_dim, pq_len).transpose(1, 0, 2)  # (S, m, l)
+    centers0 = jnp.take_along_axis(sub, init_idx[:, :, None], axis=1)
+    vf = valid.astype(jnp.float32)
+    avg = jnp.sum(vf) / n_codes
+    n_chunks = m // chunk
+    xs = (sub.reshape(pq_dim, n_chunks, chunk, pq_len)
+          .transpose(1, 0, 2, 3))                       # (nc, S, B, l)
+    vs = vf.reshape(n_chunks, chunk)
+    base = jnp.arange(m, dtype=jnp.int32).reshape(n_chunks, chunk)
+
+    def one_iter(_, centers):
+        cc = jnp.sum(centers * centers, axis=2)         # (S, C)
+
+        def chunk_step(carry, inp):
+            counts, sums, wd, wi = carry
+            xb, vb, ib = inp                            # (S,B,l),(B,),(B,)
+            ip = jnp.einsum("sbl,scl->sbc", xb, centers,
+                            preferred_element_type=jnp.float32,
+                            precision=matmul_precision())
+            bb = jnp.sum(xb * xb, axis=2)
+            d = bb[:, :, None] + cc[:, None, :] - 2.0 * ip
+            assign = jnp.argmin(d, axis=2)              # (S, B)
+            dmin = jnp.min(d, axis=2)
+            oh = jax.nn.one_hot(assign, n_codes, dtype=jnp.float32)
+            oh = oh * vb[None, :, None]
+            counts = counts + jnp.sum(oh, axis=1)
+            sums = sums + jnp.einsum("sbc,sbl->scl", oh, xb,
+                                     preferred_element_type=jnp.float32,
+                                     precision=matmul_precision())
+            # running top-C worst-cost rows per subspace (reseed pool);
+            # padded rows never qualify
+            dmin = jnp.where(vb[None, :] > 0, dmin, -jnp.inf)
+            cd = jnp.concatenate([wd, dmin], axis=1)
+            cix = jnp.concatenate(
+                [wi, jnp.broadcast_to(ib[None, :], dmin.shape)], axis=1)
+            nwd, sel = lax.top_k(cd, n_codes)
+            nwi = jnp.take_along_axis(cix, sel, axis=1)
+            return (counts, sums, nwd, nwi), None
+
+        init = (jnp.zeros((pq_dim, n_codes), jnp.float32),
+                jnp.zeros((pq_dim, n_codes, pq_len), jnp.float32),
+                jnp.full((pq_dim, n_codes), -jnp.inf, jnp.float32),
+                jnp.zeros((pq_dim, n_codes), jnp.int32))
+        (counts, sums, wd, wi), _ = lax.scan(chunk_step, init,
+                                             (xs, vs, base))
+        newc = sums / jnp.maximum(counts, 1.0)[:, :, None]
+        newc = jnp.where(counts[:, :, None] > 0, newc, centers)
+        small = counts < 0.25 * avg
+        slot = jnp.cumsum(small.astype(jnp.int32), axis=1) - 1
+        seeds = jnp.take_along_axis(sub, wi[:, :, None], axis=1)
+        reseed = jnp.take_along_axis(
+            seeds, jnp.clip(slot, 0, n_codes - 1)[:, :, None], axis=1)
+        return jnp.where(small[:, :, None], reseed, newc)
+
+    return lax.fori_loop(0, n_iters, one_iter, centers0)
+
+
 def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
                                   n_codes: int, n_iters: int, seed: int,
-                                  kernel_precision=None):
+                                  kernel_precision=None, cb_idx=None):
     """Per-subspace k-means over residual subvectors (reference
-    train_per_subset, ivf_pq_build.cuh:464). The Python loop dispatches
-    pq_dim sequential trainers, but each is the balanced trainer whose
-    init/balancing beats a batched plain-EM by ~0.2 recall at equal
-    iterations (measured; the batched variant was tried and reverted).
-    A SECOND batched attempt (2026-08-01) kept the full balanced
-    semantics in one jit (grouped (g, n, C) EM with per-member
-    approx_max_k reseed): recall matched exactly but build got ~25%
-    SLOWER on CPU (44.3 vs 34.9 s at 50k×128/pq_dim=32 — the big
-    materialized blocks lose to fused_l2_nn's tiled scan), and the
-    sequential loop's dispatches pipeline asynchronously anyway, so
-    the loop stays. Don't retry without a TPU measurement showing the
-    dispatch chain actually binds."""
-    sub = residuals_rot.reshape(-1, pq_dim, pq_len)  # (n, pq_dim, pq_len)
-    books = []
-    for s in range(pq_dim):
-        books.append(kmeans_balanced.balanced_kmeans(
-            sub[:, s, :], n_codes, n_iters=n_iters, seed=seed + s,
-            kernel_precision=kernel_precision))
-    return jnp.stack(books)  # (pq_dim, n_codes, pq_len)
+    train_per_subset, ivf_pq_build.cuh:464) — host glue around the
+    single-program grouped trainer (_train_books_grouped).
+
+    ``cb_idx``: optional HOST int array of trainset rows (the caller's
+    subsample); None trains on all rows. ``kernel_precision`` is
+    accepted for signature compatibility; the grouped trainer's
+    einsums always run at matmul_precision (the train phase is a
+    negligible share of build FLOPs)."""
+    del kernel_precision
+    n = residuals_rot.shape[0]
+    if cb_idx is None:
+        cb_idx = np.arange(n, dtype=np.int32)
+    m = int(cb_idx.shape[0])
+    chunk = min(m, 4096)
+    m_pad = -(-m // chunk) * chunk
+    pad_idx = np.asarray(cb_idx, np.int32)[np.arange(m_pad) % m]
+    valid = np.arange(m_pad) < m
+    rng = np.random.default_rng(seed)
+    init_idx = np.stack([
+        rng.choice(m, n_codes, replace=m < n_codes)
+        for _ in range(pq_dim)]).astype(np.int32)
+    return _train_books_grouped(
+        residuals_rot, jnp.asarray(pad_idx), jnp.asarray(valid),
+        jnp.asarray(init_idx), pq_dim, pq_len, n_codes, n_iters, chunk)
 
 
 def _list_chunk(L: int, per_list_elems: int,
@@ -398,11 +504,11 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     centers = kmeans_balanced.build_hierarchical(
         trainset, params.n_lists, params.kmeans_n_iters,
         kernel_precision=params.kmeans_kernel_precision, res=res)
-    labels = kmeans_balanced.predict(x, centers, res=res)
 
     rot = make_rotation_matrix(dim, rot_dim, params.force_random_rotation,
                                seed=seed + 1)
-    centers_rot, residuals_rot = _prep_rotated(x, centers, labels, rot)
+    # coarse assignment + rotation/residuals in ONE program
+    labels, centers_rot, residuals_rot = _labels_and_prep(x, centers, rot)
 
     if params.codebook_kind == CodebookGen.PER_CLUSTER:
         # one codebook per coarse cluster (reference train_per_cluster):
@@ -441,23 +547,26 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
                           if params.keep_raw else None))
 
     n_cb_train = min(n, 1 << 16)
-    if n_cb_train < n:
-        cb_trainset = take_rows(residuals_rot, sample_rows(n, n_cb_train, seed + 3))
-    else:
-        cb_trainset = residuals_rot
+    # the trainset subsample stays HOST indices (padding/init glue runs
+    # host-side; the gather rides inside the grouped trainer program)
+    cb_idx = (sample_rows_np(n, n_cb_train, seed + 3)
+              if n_cb_train < n else None)
     pq_centers = _train_codebooks_per_subspace(
-        cb_trainset, pq_dim, pq_len, n_codes,
+        residuals_rot, pq_dim, pq_len, n_codes,
         params.kmeans_n_iters, seed + 2,
-        kernel_precision=params.kmeans_kernel_precision)
+        kernel_precision=params.kmeans_kernel_precision, cb_idx=cb_idx)
 
     codes = _encode(residuals_rot, pq_centers)  # (n, pq_dim) u8
 
     # bucket codes by list using the same static padded layout as
     # IVF-Flat — directly as uint8 (integer payload: no norms pass, no
-    # f32 round-trip casts; same contract as the ivf_bq int32 payloads)
-    bucketed, idx, _, counts = _bucketize(codes, labels, params.n_lists,
-                                          compute_norms=False)
-    codes_b = bucketed
+    # f32 round-trip casts; same contract as the ivf_bq int32 payloads),
+    # with the code-norms pass fused into the same program
+    counts, mx = _counts_and_max(labels, params.n_lists)
+    max_list = int(jax.device_get(mx))
+    max_list = max(8, -(-max_list // 8) * 8)
+    codes_b, idx, counts, norms = _bucketize_codes(
+        codes, labels, counts, pq_centers, params.n_lists, max_list)
 
     # the bf16 reconstruction cache is decoded lazily at first
     # reconstruct-mode search — codes/LUT-mode users and serialized
@@ -466,7 +575,7 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
                  rotation_matrix=rot, pq_centers=pq_centers, codes=codes_b,
                  lists_indices=idx, list_sizes=counts, metric=params.metric,
                  pq_bits=params.pq_bits, size=n,
-                 code_norms=_code_norms(codes_b, pq_centers, idx),
+                 code_norms=norms,
                  raw=(np.asarray(jax.device_get(x))
                       if params.keep_raw else None))
 

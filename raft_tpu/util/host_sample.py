@@ -43,6 +43,16 @@ def take_rows(x, idx):
     return x[idx]
 
 
+def sample_rows_np(n: int, m: int, seed: int) -> np.ndarray:
+    """Host-side variant of :func:`sample_rows`'s large-``n`` path:
+    ``m`` distinct sorted indices in ``[0, n)`` as a numpy int32 array
+    (same rng stream — ``default_rng(seed).choice``), for callers that
+    keep the indices on host (padding/glue before a jitted gather)."""
+    idx = np.random.default_rng(seed).choice(n, size=m, replace=False)
+    idx.sort()
+    return idx.astype(np.int32)
+
+
 def sample_rows(n: int, m: int, seed: int) -> jnp.ndarray:
     """``m`` distinct indices in ``[0, n)``. Small ``n`` draws the
     traced ``jax.random.choice`` stream (identical to prior versions);
@@ -53,6 +63,8 @@ def sample_rows(n: int, m: int, seed: int) -> jnp.ndarray:
         idx = jax.random.choice(jax.random.key(seed), n, (m,),
                                 replace=False)
         return idx.astype(jnp.int32)
-    idx = np.random.default_rng(seed).choice(n, size=m, replace=False)
-    idx.sort()
-    return jnp.asarray(idx, dtype=jnp.int32)
+    # int32 cast on HOST: jnp.asarray(idx, int32) of an int64 numpy
+    # array compiles a convert_element_type program per shape — on the
+    # tunneled TPU platform that is one remote-compile RPC per call
+    # site for a cast numpy does for free
+    return jnp.asarray(sample_rows_np(n, m, seed))
