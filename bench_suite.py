@@ -619,15 +619,41 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_fused_wide, bench_ivf_10m]
 
 
+def _suite_meta():
+    """Provenance row appended to every table: library version, the
+    active kernel-dispatch mode and the full obs snapshot — BENCH_r*.json
+    becomes self-describing about which code produced its numbers. The
+    row carries no ``value``, so gates and comparisons skip it (schema
+    stays backward-compatible: old tables simply lack the row)."""
+    import jax
+    import raft_tpu
+    from raft_tpu import obs
+    from raft_tpu.ops.dispatch import pallas_enabled
+    return {
+        "metric": "_meta",
+        "raft_tpu_version": raft_tpu.__version__,
+        "backend": jax.default_backend(),
+        "dispatch_pallas": pallas_enabled(),
+        "pallas_mode": os.environ.get("RAFT_TPU_PALLAS", "auto"),
+        "metrics": obs.snapshot(),
+    }
+
+
 def run_all(cases=None, stream=False):
     """Run the selected cases. With ``stream``, print each case's rows
     the moment the case completes (flushed) — a measurement window that
     dies mid-suite still banks every finished case (round-4 lesson: the
-    tunnel has died mid-campaign in three consecutive rounds)."""
+    tunnel has died mid-campaign in three consecutive rounds).
+
+    Every row embeds a ``metrics`` diff (obs snapshot before/after its
+    case): the record says which code path produced the number —
+    dispatch route, scan mode, compile-cache hits — not just the
+    number. A final ``_meta`` row carries version + full snapshot."""
     import jax
     if "BENCH_PLATFORM" in os.environ:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     from raft_tpu.core.compile_cache import enable as _enable_cache
+    from raft_tpu import obs
     _enable_cache()  # cross-process warm kernels (AOT-kernel role)
     results = []
     selected = _CASES if not cases else [
@@ -643,13 +669,20 @@ def run_all(cases=None, stream=False):
                              f"available: {sorted(known)}")
     for case in selected:
         done = len(results)
+        before = obs.snapshot()
         try:
             case(results)
         except Exception as e:  # a failing case must not kill the table
             results.append({"metric": case.__name__, "error": repr(e)})
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        for r in results[done:]:
+            r.setdefault("metrics", diff)
         if stream:
             for r in results[done:]:
                 print(json.dumps(r), flush=True)
+    results.append(_suite_meta())
+    if stream:
+        print(json.dumps(results[-1]), flush=True)
     return results
 
 

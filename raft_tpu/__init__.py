@@ -35,7 +35,7 @@ Handle = DeviceResources
 
 _SUBPACKAGES = (
     "cluster", "comms", "core", "distance", "label", "linalg", "matrix",
-    "neighbors", "ops", "parallel", "random", "solver", "sparse",
+    "neighbors", "obs", "ops", "parallel", "random", "solver", "sparse",
     "spatial", "spectral", "stats", "util",
 )
 

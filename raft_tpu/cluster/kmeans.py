@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
@@ -134,6 +135,7 @@ def sample_centroids(x, n_clusters: int, seed: int = 0, res=None) -> jax.Array:
     return take_rows(x, sample_rows(x.shape[0], n_clusters, seed))
 
 
+@obs.timed("raft.kmeans.fit")
 def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,
         init_centroids=None, res=None
         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -159,6 +161,7 @@ def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,
     n_trials = 1 if (init_centroids is not None
                      or params.init == InitMethod.Array) else max(1, params.n_init)
     best = None
+    inertias = []
     for trial in range(n_trials):
         if trial > 0:
             # re-seed respecting the requested init method
@@ -168,9 +171,22 @@ def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,
                 c0 = _plus_plus(x, w, jax.random.key(params.seed + trial), k)
         centroids, labels, inertia, n_iter = _lloyd(
             x, w, c0, k, params.max_iter, params.tol)
-        if best is None or float(inertia) < float(best[2]):
+        inertias.append(float(inertia))
+        if best is None or inertias[-1] < float(best[2]):
             best = (centroids, labels, inertia, n_iter)
     centroids, _, inertia, n_iter = best
+    # the values are already host-synced (the best-trial comparison
+    # fetched each inertia; n_iter rides the same executed program)
+    obs.counter("raft.kmeans.fit.total").inc()
+    obs.counter("raft.kmeans.fit.rows").inc(n)
+    obs.histogram("raft.kmeans.fit.iterations",
+                  buckets=obs.SIZE_BUCKETS).observe(int(n_iter))
+    obs.gauge("raft.kmeans.fit.inertia").set(float(inertia))
+    if len(inertias) > 1:
+        # multi-restart improvement: first trial vs the kept best —
+        # how much the n_init restarts actually bought
+        obs.gauge("raft.kmeans.fit.inertia_delta").set(
+            inertias[0] - float(inertia))
     return centroids, inertia, n_iter
 
 

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.util.host_sample import sample_rows, take_rows
@@ -103,12 +104,14 @@ def balanced_kmeans(x, n_clusters: int, n_iters: int = 20,
     cluster assignment tolerates ~5e-4 relative distance error, gate
     any default change on downstream index recall)."""
     x = as_array(x).astype(jnp.float32)
+    obs.counter("raft.kmeans_balanced.em_sweeps").inc(n_iters)
     # init indices sampled HOST-side (util.host_sample rationale: a
     # traced choice(replace=False) is an n-wide sort compile); the
     # gather rides inside the EM program (_em_seeded)
-    return _em_seeded(x, sample_rows(x.shape[0], n_clusters, seed),
-                      n_clusters, n_iters, balance_threshold,
-                      kernel_precision=kernel_precision)
+    with obs.timed("raft.kmeans_balanced.train"):
+        return _em_seeded(x, sample_rows(x.shape[0], n_clusters, seed),
+                          n_clusters, n_iters, balance_threshold,
+                          kernel_precision=kernel_precision)
 
 
 def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
@@ -139,8 +142,10 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
     # that — and naive per-mesocluster shapes would trigger one XLA
     # recompile each (SURVEY.md hard part (c)).
     if n_clusters <= 16384:
+        obs.counter("raft.kmeans_balanced.build.total", path="flat").inc()
         return balanced_kmeans(xt, n_clusters, n_iters, seed=seed,
                                kernel_precision=kernel_precision, res=res)
+    obs.counter("raft.kmeans_balanced.build.total", path="two_level").inc()
 
     # two-level path, shape-bucketed so XLA compiles O(log) variants, not
     # O(n_meso): uniform fine allocation (one km for every mesocluster —
@@ -177,5 +182,7 @@ def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
                                        res=res))
     all_centers = jnp.concatenate(centers, axis=0)[:n_clusters]
     # final balancing sweeps over the full center set
-    return _em(xt, all_centers, n_clusters, max(2, n_iters // 4), 0.25,
+    balance_rounds = max(2, n_iters // 4)
+    obs.counter("raft.kmeans_balanced.balancing_rounds").inc(balance_rounds)
+    return _em(xt, all_centers, n_clusters, balance_rounds, 0.25,
                kernel_precision=kernel_precision)

@@ -43,7 +43,23 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu import obs
 from raft_tpu.core.error import expects
+
+
+def _count_collective(op: str, x) -> None:
+    """Collective telemetry: call count + payload bytes per op. The
+    collectives themselves run inside jit, so these increment at TRACE
+    time — once per compiled program, not per execution (XLA has no
+    host callback cheap enough for a per-run counter). That still
+    answers the serving questions: which collectives a program uses and
+    how many wire bytes one execution moves (docs/observability.md)."""
+    obs.counter("raft.comms.collective.calls", op=op).inc()
+    try:
+        nbytes = float(x.size) * x.dtype.itemsize
+    except Exception:
+        return
+    obs.counter("raft.comms.collective.bytes", op=op).inc(nbytes)
 
 
 class Status(enum.IntEnum):
@@ -151,6 +167,7 @@ class Comms:
         raise ValueError(f"unsupported op {op}")
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
+        _count_collective("allreduce", x)
         if self.axis_index_groups is not None:
             return self._group_reduce(x, op)
         if op == ReduceOp.SUM:
@@ -167,6 +184,7 @@ class Comms:
 
     def bcast(self, x, root: int = 0):
         """Every rank receives root's value (root is the in-group rank)."""
+        _count_collective("bcast", x)
         if self.axis_index_groups is None:
             return lax.all_gather(x, self.axis_name)[root]
         return self._group_gather(x)[root]
@@ -179,6 +197,7 @@ class Comms:
         return jnp.where(self.get_rank() == root, red, jnp.zeros_like(red))
 
     def allgather(self, x):
+        _count_collective("allgather", x)
         if self.axis_index_groups is None:
             return lax.all_gather(x, self.axis_name)
         return self._group_gather(x)
@@ -208,6 +227,7 @@ class Comms:
         """Input length must be divisible by group size; rank r receives
         the r-th chunk of the elementwise reduction."""
         expects(op == ReduceOp.SUM, "reducescatter: SUM only (XLA psum_scatter)")
+        _count_collective("reducescatter", x)
         return lax.psum_scatter(x, self.axis_name, tiled=True,
                                 axis_index_groups=self.axis_index_groups)
 
@@ -219,6 +239,7 @@ class Comms:
         """collective_permute around the ring (within each subgroup for a
         split comm) — the merge primitive for sharded top-k (SURVEY.md §5
         long-context slot)."""
+        _count_collective("ring_permute", x)
         if self.axis_index_groups is None:
             n = self.get_size()
             perm = [(i, (i + shift) % n) for i in range(n)]
@@ -232,6 +253,7 @@ class Comms:
     def device_send_recv(self, x, perm: Sequence[Tuple[int, int]]):
         """Explicit (src, dst) permutation (reference device_send/recv
         pairs; XLA requires the full pattern statically)."""
+        _count_collective("device_send_recv", x)
         return lax.ppermute(x, self.axis_name, list(perm))
 
     def group_start(self) -> None:
@@ -262,6 +284,7 @@ class Comms:
                 "multicast_sendrecv: need one dest list per rank")
         rounds = len(dests_table[0])
         expects(rounds > 0, "multicast_sendrecv: empty dest lists")
+        _count_collective("multicast_sendrecv", x)
         expects(all(len(d) == rounds for d in dests_table),
                 "multicast_sendrecv: ragged dest lists (pad with self)")
         outs = []
@@ -282,6 +305,7 @@ class Comms:
         expects(x.shape[0] % n == 0,
                 "alltoall: leading dim %d not divisible by %d ranks",
                 x.shape[0], n)
+        _count_collective("alltoall", x)
         return lax.all_to_all(x.reshape(n, -1, *x.shape[1:]),
                               self.axis_name, 0, 0, tiled=False,
                               axis_index_groups=self.axis_index_groups
@@ -303,6 +327,7 @@ class Comms:
         the group size (pad upstream if not).
         """
         expects(bits == 8, "allreduce_quantized: int8 wire format only")
+        _count_collective("allreduce_quantized", x)
         n = self.get_size()
         shape = x.shape
         flat = x.astype(jnp.float32).reshape(-1)
@@ -384,6 +409,21 @@ class Comms:
         peer heartbeats abort EARLY (the collective will never complete
         without them), and on any abort ``monitor.last_suspects`` names
         the failed participants (SURVEY.md hard part (e))."""
+        t0 = time.monotonic()
+        status = self._sync_stream(*arrays, timeout_s=timeout_s,
+                                   monitor=monitor)
+        # host-side, so these are REAL per-call figures (unlike the
+        # trace-time collective counters): completion-wait latency and
+        # the SUCCESS/ERROR/ABORT outcome mix the failure-recovery
+        # loop is actually seeing
+        obs.counter("raft.comms.sync_stream.status",
+                    status=status.name.lower()).inc()
+        obs.histogram("raft.comms.sync_stream.seconds").observe(
+            time.monotonic() - t0)
+        return status
+
+    def _sync_stream(self, *arrays, timeout_s: Optional[float] = None,
+                     monitor=None) -> Status:
         timeout_s = timeout_s if timeout_s is not None else self.abort_timeout_s
         leaves = [l for l in jax.tree_util.tree_leaves(
             arrays, is_leaf=lambda v: hasattr(v, "is_ready"))

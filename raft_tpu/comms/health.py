@@ -30,8 +30,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from raft_tpu.core.logger import logger as _log
+from raft_tpu import obs
+from raft_tpu.core.logger import get_logger
 from raft_tpu.comms.host_p2p import _coordination_client
+
+_log = get_logger("comms")
 
 # sequence-key fallback: heartbeat keys at multiples of this survive
 # retirement forever, so lagging readers always have a resync point
@@ -107,6 +110,8 @@ class HealthMonitor:
     def beat(self) -> None:
         """Publish one heartbeat (an incremented counter) now."""
         self._seq += 1
+        obs.counter("raft.comms.health.heartbeats",
+                    session=self.session).inc()
         if self._client is not None:
             try:
                 if self._overwrite_ok:
@@ -230,6 +235,7 @@ class HealthMonitor:
         now = time.monotonic()
         started = self._started_at if self._started_at is not None else now
         out = []
+        max_staleness = 0.0
         for r in range(self.size):
             if r == self.rank:
                 continue
@@ -237,10 +243,19 @@ class HealthMonitor:
             # measure from the last advance we observed, or from monitor
             # start (startup grace) if the peer was never seen
             since = prev[1] if prev is not None else started
+            max_staleness = max(max_staleness, now - since)
             if now - since > stale:
                 out.append(r)
         self.last_suspects = out
+        # gauges, not only log lines: a scraper sees suspect counts and
+        # the worst heartbeat staleness without parsing logs
+        obs.gauge("raft.comms.health.suspects",
+                  session=self.session).set(len(out))
+        obs.gauge("raft.comms.health.max_staleness_seconds",
+                  session=self.session).set(max_staleness)
         if out:
+            obs.counter("raft.comms.health.suspect_events",
+                        session=self.session).inc()
             _log.warn("health[%s] rank %d: stale peers %s",
                       self.session, self.rank, out)
         return out

@@ -24,8 +24,49 @@ from __future__ import annotations
 
 import os
 
+from raft_tpu import obs
+
 _enabled = False
 _active_path = None
+_events_hooked = False
+
+
+def _hook_cache_events() -> None:
+    """Mirror jax's compilation-cache monitoring events into the obs
+    registry (hit/miss counters + retrieval-time histogram) — the
+    runtime answer to "did this process actually run warm?". The
+    listener API is jax-internal, so best-effort: on any drift the
+    cache still works, only the counters go dark."""
+    global _events_hooked
+    if _events_hooked:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if "/compilation_cache/" not in event:
+                return
+            try:
+                obs.counter("raft.compile_cache.event",
+                            event=event.rsplit("/", 1)[-1]).inc()
+            except Exception:
+                pass
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if "/compilation_cache/" not in event:
+                return
+            try:
+                obs.histogram("raft.compile_cache.duration_seconds",
+                              event=event.rsplit("/", 1)[-1]
+                              ).observe(duration)
+            except Exception:
+                pass
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _events_hooked = True
+    except Exception:
+        pass
 
 
 def enable(path: str | None = None) -> bool:
@@ -43,6 +84,7 @@ def enable(path: str | None = None) -> bool:
         return True
     env = os.environ.get("RAFT_TPU_COMPILE_CACHE", "")
     if env == "0":
+        obs.counter("raft.compile_cache.enable", result="disabled").inc()
         return False
     import jax
     if path is None and env:
@@ -92,7 +134,12 @@ def enable(path: str | None = None) -> bool:
         import warnings
         warnings.warn(f"raft_tpu compile cache disabled ({e!r}); cold "
                       f"compiles will not be reused across processes")
+        obs.counter("raft.compile_cache.enable", result="error").inc()
+        obs.gauge("raft.compile_cache.active").set(0)
         return False
     _enabled = True
     _active_path = path
+    obs.counter("raft.compile_cache.enable", result="ok").inc()
+    obs.gauge("raft.compile_cache.active").set(1)
+    _hook_cache_events()
     return True

@@ -130,6 +130,51 @@ class Logger:
 logger = Logger()
 
 
+class ChildLogger:
+    """Subsystem logger (``raft_tpu.obs``, ``raft_tpu.comms``, ...)
+    that inherits level/pattern/callback sink from the singleton
+    ``logger`` via stdlib propagation: it owns NO handlers and logs at
+    NOTSET, so records bubble to the ``raft_tpu`` parent where the
+    default/callback handlers and the singleton's level live. The
+    reference's spdlog registry has the same parent/child shape
+    (``spdlog::get(name)`` sharing sinks)."""
+
+    def __init__(self, name: str):
+        full = name if name == "raft_tpu" or name.startswith("raft_tpu.") \
+            else f"raft_tpu.{name}"
+        self.name = full
+        self._logger = logging.getLogger(full)
+        self._logger.setLevel(logging.NOTSET)  # inherit parent's level
+        self._logger.propagate = True
+
+    def should_log_for(self, level: int) -> bool:
+        return logger.should_log_for(level)
+
+    def trace(self, msg, *a): self._log(TRACE, msg, *a)
+    def debug(self, msg, *a): self._log(DEBUG, msg, *a)
+    def info(self, msg, *a): self._log(INFO, msg, *a)
+    def warn(self, msg, *a): self._log(WARN, msg, *a)
+    def error(self, msg, *a): self._log(ERROR, msg, *a)
+    def critical(self, msg, *a): self._log(CRITICAL, msg, *a)
+
+    def _log(self, level: int, msg: str, *a) -> None:
+        if self.should_log_for(level):
+            self._logger.log(_LEVEL_TO_PY[level], msg % a if a else msg)
+
+
+_children: dict = {}
+
+
+def get_logger(name: str) -> ChildLogger:
+    """Child logger for a subsystem: ``get_logger("comms")`` logs as
+    ``raft_tpu.comms`` while level, pattern and any ``set_callback``
+    sink installed on the singleton keep applying (propagation)."""
+    child = _children.get(name)
+    if child is None:
+        child = _children[name] = ChildLogger(name)
+    return child
+
+
 def set_level(level: int) -> None:
     logger.set_level(level)
 

@@ -43,6 +43,12 @@ def range(fmt: str, *args):
 
 
 def push_range(fmt: str, *args) -> None:
+    """Toggle-balance contract (pinned by tests/test_core.py
+    TestTraceToggleBalance): the enable state at PUSH time decides what
+    the matching pop does. disabled→enabled: the None placeholder is
+    popped silently (no annotation was ever entered). enabled→disabled:
+    the entered annotation is always exited (see :func:`pop_range`).
+    Either direction leaves the per-thread stack balanced."""
     if not _enabled:
         # push a placeholder so push/pop pairs stay balanced even if
         # tracing is toggled between them

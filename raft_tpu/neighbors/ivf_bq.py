@@ -58,7 +58,7 @@ from jax import lax
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.precision import matmul_precision
-from raft_tpu.core import trace
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.util.host_sample import sample_rows, take_rows
@@ -173,7 +173,9 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
     if params.metric == DistanceType.CosineExpanded:
         x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True),
                             1e-30)
-    with trace.range("ivf_bq::build(%d, %d)", n, params.n_lists):
+    obs.counter("raft.ivf_bq.build.total").inc()
+    obs.counter("raft.ivf_bq.build.rows").inc(n)
+    with obs.timed("raft.ivf_bq.build"):
         n_train = max(params.n_lists,
                       int(n * params.kmeans_trainset_fraction))
         trainset = (take_rows(x, sample_rows(n, n_train, 0))
@@ -543,6 +545,10 @@ def search(index: Index, queries, k: int,
         return batched_search(
             lambda qb: search(index, qb, k, params, res=res), q)
     from raft_tpu.neighbors.ivf_flat import _metric_kind
+    # per-batch telemetry (the batched path recurses per sub-batch)
+    obs.counter("raft.ivf_bq.search.queries").inc(q.shape[0])
+    obs.histogram("raft.ivf_bq.search.batch_size",
+                  buckets=obs.SIZE_BUCKETS).observe(q.shape[0])
     kind = _metric_kind(index.metric)
     if index.metric == DistanceType.CosineExpanded:
         q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
@@ -594,7 +600,9 @@ def search(index: Index, queries, k: int,
         largest_divisor_at_most(
             index.n_lists,
             max(1, (64 << 20) // max(1, max_list * index.dim * 2))))
-    with trace.range("ivf_bq::search(%d, %d)", q.shape[0], n_probes):
+    obs.histogram("raft.ivf_bq.search.n_probes",
+                  buckets=obs.SIZE_BUCKETS).observe(n_probes)
+    with obs.timed("raft.ivf_bq.search"):
         from raft_tpu.ops.compile_budget import run_tiers
         from raft_tpu.ops.pallas_ivf_scan import lc_mode
 

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.core import trace
+from raft_tpu import obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -236,8 +236,11 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
                               DistanceType.InnerProduct,
                               DistanceType.CosineExpanded),
             "ivf_flat: unsupported metric %s", params.metric)
-    # RAII range like the reference's nvtx scope in build (nvtx.hpp:69)
-    with trace.range("ivf_flat::build(%d, %d)", n, params.n_lists):
+    obs.counter("raft.ivf_flat.build.total").inc()
+    obs.counter("raft.ivf_flat.build.rows").inc(n)
+    # RAII scope like the reference's nvtx range in build (nvtx.hpp:69);
+    # obs.timed also lands the wall time in raft.ivf_flat.build.seconds
+    with obs.timed("raft.ivf_flat.build"):
         if params.metric == DistanceType.CosineExpanded:
             x = x / jnp.maximum(
                 jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
@@ -410,6 +413,13 @@ def search(index: Index, queries, k: int,
         return batched_search(
             lambda qb: search(index, qb, k, pinned, res=res), q)
     n_probes = min(params.n_probes, index.n_lists)
+    # per-batch telemetry (the batched path recurses here per
+    # sub-batch, so queries sum correctly across the split)
+    obs.counter("raft.ivf_flat.search.queries").inc(q.shape[0])
+    obs.histogram("raft.ivf_flat.search.batch_size",
+                  buckets=obs.SIZE_BUCKETS).observe(q.shape[0])
+    obs.histogram("raft.ivf_flat.search.n_probes",
+                  buckets=obs.SIZE_BUCKETS).observe(n_probes)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
     kind = _metric_kind(index.metric)
@@ -426,10 +436,11 @@ def search(index: Index, queries, k: int,
                      or (params.scan_order == "auto"
                          and list_order_auto(nq, n_probes,
                                              index.n_lists))))
-    # RAII range at the public search (the reference's nvtx scope slot);
-    # covers both the list-major and probe-major paths
-    with trace.range("ivf_flat::search(%s)",
-                     "list" if use_list else "probe"):
+    # RAII scope at the public search (the reference's nvtx range slot);
+    # covers both the list-major and probe-major paths — obs.timed opens
+    # the trace range and the order-labeled latency histogram together
+    with obs.timed("raft.ivf_flat.search",
+                   order="list" if use_list else "probe"):
         if use_list:
             from raft_tpu.neighbors import _ivf_scan
             from raft_tpu.ops.compile_budget import run_tiers

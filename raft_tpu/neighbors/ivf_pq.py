@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu.core import trace
+from raft_tpu import obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -473,6 +473,21 @@ def _encode(residuals_rot, pq_centers):
     return jax.vmap(per_subspace, in_axes=(1, 0), out_axes=1)(sub, pq_centers)
 
 
+@functools.partial(jax.jit, static_argnames=("n_lists", "max_list"))
+def _bucketize_codes(codes, labels, counts, pq_centers, n_lists: int,
+                     max_list: int):
+    """Bucket the (n, pq_dim) uint8 codes into the padded list layout
+    AND compute the exact decoded norms in ONE program: the codes ride
+    as their integer payload end-to-end (no f32 round-trip casts — the
+    ivf_bq int32-payload contract) and the ``_code_norms`` pass fuses
+    into the same compile instead of being its own dispatch."""
+    codes_b, idx, _, counts = _bucketize_static(
+        codes, labels, None, n_lists, max_list, counts=counts,
+        compute_norms=False)
+    return codes_b, idx, counts, _code_norms(codes_b, pq_centers, idx)
+
+
+@obs.timed("raft.ivf_pq.build")
 def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
           res=None) -> Index:
     """Build (reference ivf_pq_build.cuh:908): balanced-kmeans coarse
@@ -480,6 +495,8 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     x = as_array(dataset).astype(jnp.float32)
     n, dim = x.shape
     expects(params.n_lists <= n, "ivf_pq.build: n_lists > n_samples")
+    obs.counter("raft.ivf_pq.build.total").inc()
+    obs.counter("raft.ivf_pq.build.rows").inc(n)
     pq_dim = params.pq_dim if params.pq_dim > 0 else max(1, dim // 4)
     rot_dim = ((dim + pq_dim - 1) // pq_dim) * pq_dim
     pq_len = rot_dim // pq_dim
@@ -914,6 +931,13 @@ def search(index: Index, queries, k: int,
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_pq.search: unknown scan_order {params.scan_order!r}")
     n_probes = min(params.n_probes, index.n_lists)
+    # per-batch telemetry (the batched path recurses here per
+    # sub-batch, so queries sum correctly across the split)
+    obs.counter("raft.ivf_pq.search.queries").inc(q.shape[0])
+    obs.histogram("raft.ivf_pq.search.batch_size",
+                  buckets=obs.SIZE_BUCKETS).observe(q.shape[0])
+    obs.histogram("raft.ivf_pq.search.n_probes",
+                  buckets=obs.SIZE_BUCKETS).observe(n_probes)
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
     from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
@@ -1025,9 +1049,10 @@ def search(index: Index, queries, k: int,
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
         from raft_tpu.ops.compile_budget import run_tiers
-        # RAII range (reference nvtx scope in search, ivf_pq_search.cuh:
-        # 1263): exception-safe, unlike a bare push/pop pair
-        with trace.range("ivf_pq::search(codes)"):
+        # RAII scope (reference nvtx range in search, ivf_pq_search.cuh:
+        # 1263), exception-safe; obs.timed opens the trace range AND the
+        # wall-time histogram under one taxonomy name
+        with obs.timed("raft.ivf_pq.search", mode="codes"):
             cap = _ivf_scan.resolve_cap(index.cap_cache, q,
                                         index.centers, params, n_probes,
                                         index.n_lists, kind=kind,
@@ -1082,7 +1107,7 @@ def search(index: Index, queries, k: int,
             d, i = run_tiers(shape_key, tiers)
         return _epilogue(d, i)
     if scan_mode == "reconstruct":
-        with trace.range("ivf_pq::search(reconstruct)"):
+        with obs.timed("raft.ivf_pq.search", mode="reconstruct"):
             nq = q.shape[0]
             from raft_tpu.neighbors.ann_types import list_order_auto
             use_list = (kind == "l2"
@@ -1092,7 +1117,7 @@ def search(index: Index, queries, k: int,
                                                      index.n_lists))))
             d, i = _recon_list() if use_list else _recon_probe()
         return _epilogue(d, i)
-    with trace.range("ivf_pq::search(lut)"):
+    with obs.timed("raft.ivf_pq.search", mode="lut"):
         d, i = _search_impl(q, index.centers, index.centers_rot,
                             index.rotation_matrix, index.pq_centers,
                             index.codes, index.lists_indices, kk, n_probes,

@@ -1,0 +1,67 @@
+"""raft_tpu.obs — metrics + runtime telemetry.
+
+The quantitative observability layer the reference never had (its story
+is NVTX ranges + spdlog — our ``core/trace.py`` / ``core/logger.py``):
+a dependency-free, thread-safe registry of counters, gauges and
+fixed-boundary histograms, wired into every hot path (ops dispatch,
+compile cache, IVF search/build, k-means, comms/health) under one
+``raft.<module>.<op>`` naming taxonomy shared with the xprof trace
+ranges.
+
+Quick use::
+
+    from raft_tpu import obs
+    obs.counter("raft.myapp.requests", route="search").inc()
+    with obs.timed("raft.myapp.handle"):
+        ...
+    print(obs.to_prometheus_text())   # scrape endpoint body
+    state = obs.snapshot()            # JSON-ready dict
+
+``RAFT_TPU_METRICS=0`` no-ops the whole registry. See
+docs/observability.md for the taxonomy, the exporters and how
+``obs.timed`` relates to profiler trace ranges.
+"""
+
+from raft_tpu.obs.registry import (
+    REGISTRY,
+    DEFAULT_BUCKETS,
+    SIZE_BUCKETS,
+    NAME_RE,
+    CardinalityError,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    snapshot,
+    snapshot_diff,
+    to_prometheus_text,
+    reset,
+    set_enabled,
+    enabled,
+)
+from raft_tpu.obs.timing import timed
+
+__all__ = [
+    "REGISTRY",
+    "DEFAULT_BUCKETS",
+    "SIZE_BUCKETS",
+    "NAME_RE",
+    "CardinalityError",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "snapshot_diff",
+    "to_prometheus_text",
+    "reset",
+    "set_enabled",
+    "enabled",
+    "timed",
+]

@@ -1,0 +1,80 @@
+"""``timed`` — one name, two observability planes.
+
+``timed("raft.ivf_pq.search", mode="codes")`` opens a
+``core.trace.range`` named ``raft.ivf_pq.search`` (so the scope shows
+up in xprof/Perfetto exactly where the wall-time went) AND observes the
+elapsed wall seconds into the histogram ``raft.ivf_pq.search.seconds``
+with the given labels. Metrics and profiler annotations therefore share
+ONE ``raft.<module>.<op>`` taxonomy: a histogram spike names the trace
+range to open in the profile, and vice versa.
+
+Usable as a context manager or a decorator::
+
+    with obs.timed("raft.kmeans.fit"):
+        ...
+
+    @obs.timed("raft.ivf_pq.build")
+    def build(...): ...
+
+Wall-clock caveat (docs/observability.md): under JAX async dispatch the
+scope measures host time in the block — enqueue time unless the block
+synchronizes (fetches a value). The instrumented raft_tpu call sites
+all sit at natural sync points (public API boundaries that return
+materialized results or cache a host-side decision), so the histograms
+track end-to-end service time, the quantity a serving dashboard wants.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+from raft_tpu.obs import registry as _registry
+
+
+class timed:
+    """Context manager / decorator timing a scope into
+    ``<name>.seconds`` and a trace range named ``name``."""
+
+    __slots__ = ("name", "labels", "registry", "_t0", "_range")
+
+    def __init__(self, name: str,
+                 registry: Optional[_registry.MetricsRegistry] = None,
+                 **labels):
+        self.name = name
+        self.labels = labels
+        self.registry = registry if registry is not None \
+            else _registry.REGISTRY
+        self._t0 = 0.0
+        self._range = None
+
+    def __enter__(self) -> "timed":
+        # trace ranges stay on even when metrics are off: the xprof
+        # annotation costs nothing without a profiler session and is
+        # gated by trace.enable_tracing independently
+        from raft_tpu.core import trace
+        self._range = trace.range(self.name)
+        self._range.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        rng, self._range = self._range, None
+        try:
+            self.registry.histogram(self.name + ".seconds",
+                                    **self.labels).observe(dt)
+        finally:
+            if rng is not None:
+                rng.__exit__(exc_type, exc, tb)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # fresh instance per call: the decorator form must be
+            # re-entrant (recursion, threads)
+            with timed(self.name, self.registry, **self.labels):
+                return fn(*args, **kwargs)
+        return wrapper

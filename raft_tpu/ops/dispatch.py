@@ -21,6 +21,8 @@ from typing import Optional
 
 import jax
 
+from raft_tpu import obs
+
 
 _MODES = ("auto", "0", "never", "off", "1", "always", "on")
 
@@ -43,17 +45,30 @@ def pallas_available() -> bool:
 
 
 def pallas_enabled(backend: Optional[str] = None) -> bool:
-    """Should a primitive route to its Pallas kernel?"""
+    """Should a primitive route to its Pallas kernel? Every call counts
+    the decision into ``raft.dispatch.route{path=pallas|xla}`` — the
+    telemetry that says which kernel tier actually served traffic
+    (bench records embed the diff, so BENCH_r*.json rows are
+    self-describing about their code path)."""
     mode = _mode()
     if mode in ("0", "never", "off"):
-        return False
-    if mode in ("1", "always", "on"):
-        return pallas_available()
-    backend = backend or jax.default_backend()
-    return backend == "tpu" and pallas_available()
+        use = False
+    elif mode in ("1", "always", "on"):
+        use = pallas_available()
+    else:
+        backend = backend or jax.default_backend()
+        use = backend == "tpu" and pallas_available()
+    obs.counter("raft.dispatch.route",
+                path="pallas" if use else "xla").inc()
+    return use
 
 
 def pallas_interpret(backend: Optional[str] = None) -> bool:
     """Run kernels under the Pallas interpreter (non-TPU backends)."""
     backend = backend or jax.default_backend()
-    return backend != "tpu"
+    interp = backend != "tpu"
+    if interp:
+        # interpret-mode fallback: correct but orders of magnitude
+        # slower than a compiled kernel — worth a counter of its own
+        obs.counter("raft.dispatch.interpret_fallback").inc()
+    return interp

@@ -121,6 +121,107 @@ class TestLogger:
         assert any("should appear" in m for m in captured)
 
 
+class TestTraceToggleBalance:
+    """Pin core.trace push/pop semantics when enable_tracing flips
+    between a push and its pop (ISSUE 1 satellite): annotations entered
+    while tracing was ON are always exited; placeholders pushed while
+    OFF are popped silently — both directions keep the stack balanced."""
+
+    @pytest.fixture
+    def fake_ann(self, monkeypatch):
+        events = []
+
+        class FakeAnn:
+            def __init__(self, name):
+                self.name = name
+
+            def __enter__(self):
+                events.append(("enter", self.name))
+                return self
+
+            def __exit__(self, *exc):
+                events.append(("exit", self.name))
+
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation", FakeAnn)
+        yield events
+        # never leak a toggled-off state into other tests
+        from raft_tpu.core import trace
+        trace.enable_tracing(True)
+
+    def test_enabled_then_disabled_still_exits(self, fake_ann):
+        from raft_tpu.core import trace
+        trace.push_range("outer %d", 1)
+        trace.enable_tracing(False)
+        trace.pop_range()  # entered while ON -> must exit regardless
+        assert fake_ann == [("enter", "outer 1"), ("exit", "outer 1")]
+        assert trace._stack() == []
+
+    def test_disabled_then_enabled_pops_placeholder_silently(self,
+                                                             fake_ann):
+        from raft_tpu.core import trace
+        trace.enable_tracing(False)
+        trace.push_range("ghost")
+        trace.enable_tracing(True)
+        trace.pop_range()  # placeholder: no annotation may be exited
+        assert fake_ann == []
+        assert trace._stack() == []
+        # stack stays balanced for subsequent real ranges
+        trace.push_range("real")
+        trace.pop_range()
+        assert fake_ann == [("enter", "real"), ("exit", "real")]
+
+    def test_interleaved_toggles_keep_lifo_order(self, fake_ann):
+        from raft_tpu.core import trace
+        trace.push_range("a")            # ON -> real
+        trace.enable_tracing(False)
+        trace.push_range("b")            # OFF -> placeholder
+        trace.enable_tracing(True)
+        trace.pop_range()                # pops placeholder b: silent
+        trace.pop_range()                # pops a: exits
+        assert fake_ann == [("enter", "a"), ("exit", "a")]
+        assert trace._stack() == []
+
+    def test_pop_on_empty_stack_is_noop(self, fake_ann):
+        from raft_tpu.core import trace
+        trace.pop_range()
+        assert fake_ann == []
+
+
+class TestChildLogger:
+    def test_child_name_prefixing(self):
+        from raft_tpu.core.logger import get_logger
+        assert get_logger("obs").name == "raft_tpu.obs"
+        assert get_logger("raft_tpu.comms").name == "raft_tpu.comms"
+        # cached: one instance per name
+        assert get_logger("obs") is get_logger("obs")
+
+    def test_callback_captures_child_records(self):
+        """set_callback on the singleton must keep capturing records
+        emitted through child loggers (propagation)."""
+        from raft_tpu.core.logger import get_logger
+        captured = []
+        set_callback(lambda lvl, msg: captured.append((lvl, msg)))
+        try:
+            get_logger("obs").info("from child %d", 7)
+        finally:
+            set_callback(None)
+        assert any("from child 7" in m for _lvl, m in captured)
+
+    def test_child_inherits_level_gating(self):
+        from raft_tpu.core.logger import get_logger
+        captured = []
+        set_callback(lambda lvl, msg: captured.append(msg))
+        try:
+            logger.set_level(3)  # WARN
+            get_logger("comms").info("filtered out")
+            get_logger("comms").warn("passes through")
+        finally:
+            logger.set_level(4)
+            set_callback(None)
+        assert not any("filtered out" in m for m in captured)
+        assert any("passes through" in m for m in captured)
+
+
 class TestInterruptible:
     def test_yield_no_throw_roundtrip(self):
         assert yield_no_throw() is False

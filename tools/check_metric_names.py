@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Lint the metric-name taxonomy (docs/observability.md).
+
+Two modes, one contract — every metric is ``raft.<module>.<op>...``
+(lowercase ``[a-z0-9_]`` segments, dot-separated) and a name is bound
+to exactly ONE instrument kind:
+
+* **source mode** (default): scan the instrumented tree for
+  ``obs.counter("...")`` / ``obs.gauge`` / ``obs.histogram`` /
+  ``obs.timed`` call sites with a literal first argument and fail on
+  - names violating the taxonomy regex,
+  - the same name registered under conflicting kinds (``obs.timed(n)``
+    registers the histogram ``n + ".seconds"``, so a ``timed`` name
+    also conflicts with a counter/gauge of that derived name).
+* **text mode** (``--text FILE``, ``-`` = stdin): parse a Prometheus
+  exposition dump (the ``obs.to_prometheus_text()`` output) and fail on
+  - family names not matching ``raft_[a-z0-9_]+``,
+  - duplicate ``# TYPE`` declarations for one family.
+
+Runs in the tier-1 path via ``tests/test_obs.py::TestMetricNameLint``
+(both modes) and standalone::
+
+    python tools/check_metric_names.py            # lint the source tree
+    python bench_suite.py ... | python tools/check_metric_names.py --text -
+
+Exit code 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the same taxonomy contract as raft_tpu.obs.registry.NAME_RE (kept
+# literal here so the lint has no import-time dependency on the tree
+# it checks)
+NAME_RE = re.compile(r"^raft\.[a-z0-9_]+(\.[a-z0-9_]+)*$")
+PROM_NAME_RE = re.compile(r"^raft_[a-z0-9_]+$")
+
+# obs.counter("raft.x.y", ...), obs.timed('raft.x.y'), ...
+CALL_RE = re.compile(
+    r"""\bobs\.(counter|gauge|histogram|timed)\(\s*(['"])([^'"]+)\2""")
+
+# trees holding instrumented call sites (bench/tools ride along so a
+# future metric added there is linted too)
+SCAN_ROOTS = ("raft_tpu", "tests", "tools", "bench_suite.py", "bench.py")
+
+
+def iter_source_files() -> List[str]:
+    out = []
+    for root in SCAN_ROOTS:
+        path = os.path.join(REPO, root)
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, _dirnames, filenames in os.walk(path):
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def lint_source(files: List[str] = None) -> List[str]:
+    """Scan call sites → list of violation strings."""
+    files = files if files is not None else iter_source_files()
+    self_path = os.path.abspath(__file__)
+    violations: List[str] = []
+    # name -> (kind, first definition site)
+    seen: Dict[str, Tuple[str, str]] = {}
+    for path in files:
+        if os.path.abspath(path) == self_path:
+            continue  # this file's docstring examples are not call sites
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in CALL_RE.finditer(text):
+            kind, name = m.group(1), m.group(3)
+            line = text.count("\n", 0, m.start()) + 1
+            site = f"{rel}:{line}"
+            if not NAME_RE.match(name):
+                violations.append(
+                    f"{site}: {name!r} violates the raft.<module>.<op> "
+                    f"taxonomy")
+                continue
+            # timed registers <name>.seconds as a histogram
+            reg_name = name + ".seconds" if kind == "timed" else name
+            reg_kind = "histogram" if kind == "timed" else kind
+            prev = seen.get(reg_name)
+            if prev is None:
+                seen[reg_name] = (reg_kind, site)
+            elif prev[0] != reg_kind:
+                violations.append(
+                    f"{site}: {reg_name!r} registered as {reg_kind} but "
+                    f"already a {prev[0]} at {prev[1]}")
+    return violations
+
+
+def lint_prometheus_text(text: str) -> List[str]:
+    """Validate a Prometheus exposition dump."""
+    violations: List[str] = []
+    typed: Dict[str, str] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                violations.append(f"line {ln}: malformed TYPE line")
+                continue
+            name, kind = parts[2], parts[3]
+            if not PROM_NAME_RE.match(name):
+                violations.append(
+                    f"line {ln}: family {name!r} not raft_-prefixed")
+            if name in typed:
+                violations.append(
+                    f"line {ln}: duplicate TYPE declaration for {name!r}")
+            typed[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        # sample line: name{labels} value — name must be raft_ prefixed
+        sample = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*)", line)
+        if sample and not sample.group(1).startswith("raft_"):
+            violations.append(
+                f"line {ln}: sample {sample.group(1)!r} not raft_-prefixed")
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--text", metavar="FILE", default=None,
+                    help="lint a Prometheus exposition dump instead of "
+                         "the source tree ('-' = stdin)")
+    args = ap.parse_args(argv)
+    if args.text is not None:
+        text = (sys.stdin.read() if args.text == "-"
+                else open(args.text, encoding="utf-8").read())
+        violations = lint_prometheus_text(text)
+    else:
+        violations = lint_source()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_metric_names: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
