@@ -245,6 +245,13 @@ def child_main():
                 elif "device_marginal_qps" in r:
                     out[f"{fam}_device_marginal_qps"] = \
                         r["device_marginal_qps"]
+                # fixed-cost attribution (ISSUE 2): per-batch wall
+                # minus chained marginal, plus the warm-plan QPS the
+                # AOT serving layer recovers (neighbors/plan.py)
+                if "fixed_cost_ms" in r:
+                    out[f"{fam}_fixed_cost_ms"] = r["fixed_cost_ms"]
+                if "plan_qps" in r:
+                    out[f"{fam}_plan_qps"] = r["plan_qps"]
                 out[f"{fam}_recall"] = r.get("recall")
                 if "recall_estimator" in r:  # pq: rescored headline +
                     out[f"{fam}_recall_estimator"] = \

@@ -292,6 +292,14 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=None,
     t_marg = _chained_search_time(
         run1, qb, reps, index.centers, index.lists_data,
         index.lists_norms, index.lists_indices, index.list_sizes)
+    # warm-plan serving point (neighbors/plan.py): the AOT executable
+    # fed per-call — what the fixed cost shrinks to once dispatch is
+    # enqueue-only. fixed_cost_ms = per-batch wall minus the chained
+    # in-jit marginal: the host/dispatch overhead the plan layer (and
+    # the next TPU window) must erase.
+    from raft_tpu.neighbors import plan as _plan
+    pl = _plan.warmup(index, q, k, sp)
+    t_plan = _time(lambda: pl.search(q), reps=3)
     results.append({
         "metric": (label or
                    f"ivf_flat_search_{n//1000}kx{d}_q{nq}_k{k}"
@@ -299,6 +307,8 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=None,
         "value": round(nq / t, 1), "unit": "queries/s",
         "recall": round(rec, 4),
         "marginal_qps": round(nq / t_marg, 1),
+        "plan_qps": round(nq / t_plan, 1),
+        "fixed_cost_ms": round((t - t_marg) * 1e3, 3),
         "build_s": round(t_build, 2),
         "build_warm_s": round(t_build_warm, 2)})
 
@@ -365,6 +375,10 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
         run1, qb, reps, index.centers, index.centers_rot,
         index.rotation_matrix, index.pq_centers, index.codes,
         index.code_norms, index.lists_indices, index.list_sizes, *extra)
+    # warm-plan serving point + fixed cost (see bench_ivf_flat)
+    from raft_tpu.neighbors import plan as _plan
+    pl = _plan.warmup(index, q, k, sp)
+    t_plan = _time(lambda: pl.search(q), reps=3)
     results.append({
         "metric": (label or
                    f"ivf_pq_search_{n//1000}kx{d}_q{nq}_k{k}"
@@ -374,6 +388,8 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
         "recall_estimator": round(rec_est, 4),
         "rescore_factor": sp.rescore_factor,
         "marginal_qps": round(nq / t_marg, 1),
+        "plan_qps": round(nq / t_plan, 1),
+        "fixed_cost_ms": round((t - t_marg) * 1e3, 3),
         "build_s": round(t_build, 2)})
 
 
@@ -449,6 +465,12 @@ def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=None,
         run1, qb, reps, index.centers, index.centers_rot,
         index.rotation_matrix, index.bits, index.norms2, index.scales,
         index.lists_indices)
+    # warm-plan serving point; the bq fixed cost is wall minus the
+    # chained DEVICE marginal, so it includes the rescore epilogue —
+    # the plan folds that epilogue on-device when the raw corpus fits
+    from raft_tpu.neighbors import plan as _plan
+    pl = _plan.warmup(index, q, k, sp)
+    t_plan = _time(lambda: pl.search(q), reps=3)
     results.append({
         "metric": (label or
                    f"ivf_bq_search_{n//1000}kx{d}_q{nq}_k{k}"
@@ -456,6 +478,8 @@ def bench_ivf_bq(results, n=500_000, nlists=1024, n_probes=None,
         "value": round(nq / t, 1), "unit": "queries/s",
         "recall": round(rec, 4),
         "device_marginal_qps": round(nq / t_marg, 1),
+        "plan_qps": round(nq / t_plan, 1),
+        "fixed_cost_ms": round((t - t_marg) * 1e3, 3),
         "build_s": round(t_build, 2)})
 
 

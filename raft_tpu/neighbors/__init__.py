@@ -25,6 +25,7 @@ from raft_tpu.neighbors.refine import refine
 from raft_tpu.neighbors import serialize
 from raft_tpu.neighbors import processing
 from raft_tpu.neighbors import host_memory
+from raft_tpu.neighbors import plan
 
 __all__ = [
     "IndexParams", "SearchParams",
@@ -32,5 +33,5 @@ __all__ = [
     "haversine_knn",
     "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ivf_bq", "ball_cover",
     "refine",
-    "serialize", "processing", "host_memory",
+    "serialize", "processing", "host_memory", "plan",
 ]

@@ -301,6 +301,7 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     the duplication keeps measured and cached searches byte-identical
     through one jit cache entry; -1 is the drop-free debug mode, not the
     serving path, so the extra coarse GEMM is accepted."""
+    from raft_tpu import obs
     pc = getattr(params, "probe_cap", 0)
     if pc > 0:
         return _round_cap(pc, queries.shape[0])
@@ -309,11 +310,16 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     # differently could push a list past it — see below)
     key = (queries.shape[0], n_probes, use_pallas)
     if pc == 0 and cache is not None and key in cache:
+        obs.counter("raft.ivf_scan.resolve_cap.cache_hits").inc()
         return cache[key]
     # measure over the SAME coarse selection the serving search runs
     # (use_pallas must match) — a tie resolved differently between two
     # selection programs could otherwise push a list past the measured
-    # cap and silently shed probes in the drop-free modes
+    # cap and silently shed probes in the drop-free modes. The
+    # measurement is a device round-trip (probe_cap's device_get) —
+    # the serving-path fixed cost the plan layer's warmup() exists to
+    # eliminate; the counter proves a warmed path never lands here.
+    obs.counter("raft.ivf_scan.resolve_cap.syncs").inc()
     probes = coarse_probes(queries, centers, n_probes, kind=kind,
                            use_pallas=use_pallas)
     cap = probe_cap(probes, n_lists)

@@ -30,29 +30,72 @@ class SearchParams:
 MAX_QUERY_BATCH = 4096
 
 
-def batched_search(search_one_batch, queries, max_batch: int = 0):
+def batched_search(search_one_batch, queries, max_batch: int = 0,
+                   pad_partial: bool = False, block: bool = False):
     """Run ``search_one_batch(q_slice) -> (d, i)`` over query batches and
     concatenate (the reference's search batching loop). The ragged last
     slice is padded to the batch size (last row repeated) and trimmed, so
-    every batch reuses ONE compiled shape."""
+    every batch reuses ONE compiled shape.
+
+    Async pipelined dispatch: nothing in this loop forces a host sync —
+    every sub-batch search is ENQUEUED back-to-back (JAX async
+    dispatch), the slice/pad buffers are loop-owned temporaries (safe
+    for callees that donate their query operand, e.g. an AOT
+    :class:`~raft_tpu.neighbors.plan.SearchPlan` executable), and the
+    terminal concatenate is the only consumer. ``block`` adds the
+    single terminal ``block_until_ready`` barrier — the serving-loop
+    contract: one sync per request, however many sub-batches it split
+    into. Callees must keep their own path sync-free (warm plans /
+    cached caps); a cap measurement inside the callee would serialize
+    the pipeline (counted by ``raft.ivf_scan.resolve_cap.syncs``).
+
+    ``pad_partial``: also pad a FULL query set smaller than
+    ``max_batch`` up to the batch size (fixed-shape callees — compiled
+    plan executables); default keeps the historic pass-through.
+    """
+    import jax
     import jax.numpy as jnp
+
+    from raft_tpu import obs
 
     mb = max_batch if max_batch > 0 else MAX_QUERY_BATCH
     nq = queries.shape[0]
-    if nq <= mb:
-        return search_one_batch(queries)
+    if nq <= mb and not (pad_partial and nq < mb):
+        out = search_one_batch(queries)
+        if block:
+            jax.block_until_ready(out)
+        return out
     outs = []
+    n_sub = 0
     for s in range(0, nq, mb):
         qb = queries[s:s + mb]
         short = mb - qb.shape[0]
+        n_sub += 1
         if short:
-            fill = jnp.broadcast_to(qb[-1:], (short,) + qb.shape[1:])
+            # pad with REAL rows from earlier batches when available:
+            # a tail padded with one repeated row concentrates its
+            # probes on that row's lists and can overflow a pinned/
+            # cached inverted-table cap, shedding real probes; earlier
+            # rows keep the pad in-distribution (their results are
+            # discarded). A single short batch cycles its own rows.
+            if s >= short:
+                fill = queries[s - short:s]
+            else:
+                reps = -(-short // qb.shape[0])
+                fill = jnp.tile(qb, (reps, 1))[:short]
             d, i = search_one_batch(jnp.concatenate([qb, fill], axis=0))
             outs.append((d[:mb - short], i[:mb - short]))
         else:
             outs.append(search_one_batch(qb))
+    obs.counter("raft.ann.batched_search.sub_batches").inc(n_sub)
     d, i = zip(*outs)
-    return jnp.concatenate(d, axis=0), jnp.concatenate(i, axis=0)
+    if len(outs) == 1:
+        d, i = d[0], i[0]
+    else:
+        d, i = jnp.concatenate(d, axis=0), jnp.concatenate(i, axis=0)
+    if block:
+        jax.block_until_ready((d, i))
+    return d, i
 
 
 def pin_scan_order(params, nq: int, n_lists: int):

@@ -115,6 +115,10 @@ class Index:
     size: int
     raw: Optional[np.ndarray] = None   # (n, dim) f32 host copy
     cap_cache: dict = dataclasses.field(default_factory=dict)
+    # AOT-compiled serving plans keyed by shape identity — see
+    # neighbors/plan.py (not index identity; not serialized)
+    plan_cache: dict = dataclasses.field(default_factory=dict,
+                                         repr=False, compare=False)
     # lazy device copy of `raw` for the fused rescore tier
     # (SearchParams.rescore_on_device); never serialized
     raw_dev: Optional[jax.Array] = None

@@ -113,6 +113,10 @@ class Index:
     # _ivf_scan.resolve_cap (not part of index identity/serialization)
     cap_cache: dict = field(default_factory=dict, repr=False,
                             compare=False)
+    # AOT-compiled serving plans keyed by shape identity — see
+    # neighbors/plan.py (not index identity; not serialized)
+    plan_cache: dict = field(default_factory=dict, repr=False,
+                             compare=False)
 
     @property
     def n_lists(self) -> int:

@@ -162,6 +162,10 @@ class Index:
     # _ivf_scan.resolve_cap (not index identity; not serialized)
     cap_cache: dict = dataclasses_field(default_factory=dict, repr=False,
                                         compare=False)
+    # AOT-compiled serving plans keyed by shape identity — see
+    # neighbors/plan.py (not index identity; not serialized)
+    plan_cache: dict = dataclasses_field(default_factory=dict, repr=False,
+                                         compare=False)
     # lazy device copy of `raw` for the fused rescore tier
     # (SearchParams.rescore_on_device); never serialized
     raw_dev: Optional[jax.Array] = None
@@ -895,8 +899,62 @@ def _fused_code_search(q, centers, centers_rot, rot, pq_centers, codes,
 
 # guards the lazy reconstruction-cache materialization: ladder
 # fallback tiers can run on a compile-budget thread concurrently with
-# the inline tail (see _recon_materialize)
+# the inline tail (see _ensure_decoded)
 _DECODE_LOCK = threading.Lock()
+
+
+def _base_code_norms(index: Index):
+    """Exact decoded-residual norms, derived once for older indexes
+    that predate the build-time pass."""
+    if index.code_norms is None:
+        fn = (_code_norms_per_cluster
+              if index.codebook_kind == CodebookGen.PER_CLUSTER
+              else _code_norms)
+        index.code_norms = fn(index.codes, index.pq_centers,
+                              index.lists_indices)
+    return index.code_norms
+
+
+def _ensure_code_norms(index: Index, params: "SearchParams",
+                       per_cluster: bool, kind: str):
+    """Code norms matched to the LUT tier the code scan decodes: the
+    fp8 tier's L2 epilogue must use norms of the fp8-QUANTIZED books
+    (reference fp_8bit tier — the LUT there carries the same
+    quantization in its distance terms); every other tier uses the
+    exact build-time norms. Shared by ``search`` and the plan layer."""
+    if (jnp.dtype(params.lut_dtype) == jnp.dtype(jnp.float8_e4m3fn)
+            and kind == "l2"):
+        if index.code_norms_fp8 is None:
+            books8 = index.pq_centers.astype(
+                jnp.float8_e4m3fn).astype(jnp.float32)
+            fn = (_code_norms_per_cluster if per_cluster
+                  else _code_norms)
+            index.code_norms_fp8 = fn(index.codes, books8,
+                                      index.lists_indices)
+        return index.code_norms_fp8
+    return _base_code_norms(index)
+
+
+def _ensure_decoded(index: Index, per_cluster: bool) -> None:
+    """Materialize the bf16 reconstruction cache lazily.
+
+    Lock: ladder fallback tiers may run in a compile-budget thread
+    while a later tier runs inline on the main thread — an unguarded
+    check-then-set would materialize the ~8× decoded cache TWICE
+    (peak-HBM hazard) and race the index mutation (r4 review finding).
+    The decode programs are simple proven-compilable gathers, so
+    holding the lock across them is bounded in practice."""
+    if index.decoded is not None and index.decoded_norms is not None:
+        return
+    with _DECODE_LOCK:
+        if index.decoded is None:
+            dec_fn = (_decode_lists_per_cluster if per_cluster
+                      else _decode_lists)
+            index.decoded = dec_fn(index.codes, index.pq_centers,
+                                   index.lists_indices)
+        if index.decoded_norms is None:
+            # alias the exact build-time norms — same quantity
+            index.decoded_norms = _base_code_norms(index)
 
 
 def search(index: Index, queries, k: int,
@@ -979,13 +1037,6 @@ def search(index: Index, queries, k: int,
         max_list = index.codes.shape[1]
         bins = min(max(128, (32 * kk) // max(n_probes, 1)), max_list)
 
-    def _norms(idx_):
-        if idx_.code_norms is None:
-            fn = (_code_norms_per_cluster if per_cluster else _code_norms)
-            idx_.code_norms = fn(idx_.codes, idx_.pq_centers,
-                                 idx_.lists_indices)
-        return idx_.code_norms
-
     scan_mode = params.scan_mode
     if scan_mode == "auto":
         from raft_tpu.ops.dispatch import pallas_enabled
@@ -1000,30 +1051,10 @@ def search(index: Index, queries, k: int,
             or scan_mode == "codes",
             "ivf_pq: lut_dtype=float8_e4m3fn requires scan_mode='codes' "
             "(resolved scan_mode is %r)", scan_mode)
-    def _recon_materialize():
-        # lock: ladder fallback tiers may run in a compile-budget
-        # thread while a later tier runs inline on the main thread —
-        # an unguarded check-then-set here would materialize the ~8×
-        # decoded cache TWICE (peak-HBM hazard) and race the index
-        # mutation (r4 review finding). The decode programs themselves
-        # are simple proven-compilable gathers, so holding the lock
-        # across them is bounded in practice.
-        if index.decoded is not None and index.decoded_norms is not None:
-            return
-        with _DECODE_LOCK:
-            if index.decoded is None:
-                dec_fn = (_decode_lists_per_cluster if per_cluster
-                          else _decode_lists)
-                index.decoded = dec_fn(
-                    index.codes, index.pq_centers, index.lists_indices)
-            if index.decoded_norms is None:
-                # alias the exact build-time norms — same quantity
-                index.decoded_norms = _norms(index)
-
     def _recon_list():
         """Reconstruct-cache fused list scan (l2 core only)."""
         from raft_tpu.neighbors import _ivf_scan
-        _recon_materialize()
+        _ensure_decoded(index, per_cluster)
         cap = _ivf_scan.resolve_cap(index.cap_cache, q, index.centers,
                                     params, n_probes, index.n_lists)
         # lists hold decoded rotated residuals: the scan offsets
@@ -1039,7 +1070,7 @@ def search(index: Index, queries, k: int,
     def _recon_probe():
         """Probe-major reconstruct scan — small per-probe programs,
         the always-compilable tail of the codes ladder."""
-        _recon_materialize()
+        _ensure_decoded(index, per_cluster)
         return _search_impl_reconstruct(
             q, index.centers, index.centers_rot,
             index.rotation_matrix, index.decoded,
@@ -1057,22 +1088,8 @@ def search(index: Index, queries, k: int,
                                         index.centers, params, n_probes,
                                         index.n_lists, kind=kind,
                                         use_pallas=True)
-            if (jnp.dtype(params.lut_dtype)
-                    == jnp.dtype(jnp.float8_e4m3fn) and kind == "l2"):
-                # L2 epilogue must use norms of what the kernel decodes
-                # — the fp8-quantized books (reference fp_8bit tier; the
-                # LUT there carries the same quantization in its
-                # distance terms)
-                if index.code_norms_fp8 is None:
-                    books8 = index.pq_centers.astype(
-                        jnp.float8_e4m3fn).astype(jnp.float32)
-                    fn = (_code_norms_per_cluster if per_cluster
-                          else _code_norms)
-                    index.code_norms_fp8 = fn(index.codes, books8,
-                                              index.lists_indices)
-                code_norms = index.code_norms_fp8
-            else:
-                code_norms = _norms(index)  # derives once, older indexes
+            code_norms = _ensure_code_norms(index, params, per_cluster,
+                                            kind)
 
             def codes_tier():
                 return _fused_code_search(

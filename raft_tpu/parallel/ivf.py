@@ -18,6 +18,7 @@ id translation is needed at merge.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -25,12 +26,37 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from raft_tpu import obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.precision import matmul_precision
 from raft_tpu.comms.comms import build_comms
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.util.host_sample import sample_rows
+
+
+# ---------------------------------------------------------------------------
+# Sharded search-plan cache (the neighbors/plan.py analogue at mesh
+# scope). Every distributed search used to build its `local` closure
+# and `jax.jit(jax.shard_map(local, ...))` wrapper PER CALL — a fresh
+# function identity every time, so jax's jit cache missed and the whole
+# shard_map re-traced (and, without a persistent compile cache,
+# re-COMPILED) on every serving call. The builders below are keyed by
+# everything that shapes the program (mesh, axis, k, n_probes, metric
+# core, scalars baked into the closure), so a warm key reuses one
+# compiled callable and the serving call is a single cached dispatch.
+# ---------------------------------------------------------------------------
+_SHMAP_PLANS: dict = {}
+
+
+def _shmap_plan(key, builder):
+    fn = _SHMAP_PLANS.get(key)
+    if fn is None:
+        obs.counter("raft.parallel.plan.misses").inc()
+        fn = _SHMAP_PLANS[key] = builder()
+    else:
+        obs.counter("raft.parallel.plan.hits").inc()
+    return fn
 
 
 def _shard0(arr, mesh, axis):
@@ -156,28 +182,36 @@ def distributed_ivf_flat_search(
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
     kind = _metric_kind(index.metric)
-    comms = build_comms(mesh, axis)
+    scale = float(index.scale)
 
-    def local(centers, lists_data, lists_indices, lists_norms, q_rep):
-        qq = jnp.sum(q_rep * q_rep, axis=1)
-        coarse = _coarse_scores(q_rep, centers, kind)
-        _, probes = lax.top_k(-coarse, n_probes)
+    def build():
+        comms = build_comms(mesh, axis)
 
-        def get_probe(p):
-            return _score_probe(q_rep, qq, lists_data, lists_norms,
-                                lists_indices, probes[:, p],
-                                float(index.scale), kind=kind)
+        def local(centers, lists_data, lists_indices, lists_norms,
+                  q_rep):
+            qq = jnp.sum(q_rep * q_rep, axis=1)
+            coarse = _coarse_scores(q_rep, centers, kind)
+            _, probes = lax.top_k(-coarse, n_probes)
 
-        d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
-        if sqrt:
-            d = jnp.sqrt(jnp.maximum(d, 0.0))
-        return _global_merge(comms, axis, d, i, k)
+            def get_probe(p):
+                return _score_probe(q_rep, qq, lists_data, lists_norms,
+                                    lists_indices, probes[:, p],
+                                    scale, kind=kind)
 
-    shmapped = jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
-                  P(axis, None), P()),
-        out_specs=(P(), P())))
+            d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
+            if sqrt:
+                d = jnp.sqrt(jnp.maximum(d, 0.0))
+            return _global_merge(comms, axis, d, i, k)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
+                      P(axis, None), P()),
+            out_specs=(P(), P())))
+
+    shmapped = _shmap_plan(
+        ("flat_list", mesh, axis, k, n_probes, kind, sqrt, scale),
+        build)
     q_rep = jax.device_put(q, NamedSharding(mesh, P()))
     d, i = shmapped(index.centers, index.lists_data, index.lists_indices,
                     index.lists_norms, q_rep)
@@ -206,29 +240,36 @@ def distributed_ivf_pq_search(
     sqrt = index.metric in (DistanceType.L2SqrtExpanded,
                             DistanceType.L2SqrtUnexpanded)
     kind = _metric_kind(index.metric)
-    comms = build_comms(mesh, axis)
 
-    def local(centers, centers_rot, rot, decoded, decoded_norms,
-              lists_indices, q_rep):
-        coarse = _coarse_scores(q_rep, centers, kind)
-        _, probes = lax.top_k(-coarse, n_probes)
-        q_rot = jnp.matmul(q_rep, rot.T, precision=matmul_precision())
+    def build():
+        comms = build_comms(mesh, axis)
 
-        def get_probe(p):
-            return _score_probe_reconstruct(
-                q_rot, centers_rot, decoded, decoded_norms, lists_indices,
-                probes[:, p], kind=kind)
+        def local(centers, centers_rot, rot, decoded, decoded_norms,
+                  lists_indices, q_rep):
+            coarse = _coarse_scores(q_rep, centers, kind)
+            _, probes = lax.top_k(-coarse, n_probes)
+            q_rot = jnp.matmul(q_rep, rot.T,
+                               precision=matmul_precision())
 
-        d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
-        if sqrt:
-            d = jnp.sqrt(jnp.maximum(d, 0.0))
-        return _global_merge(comms, axis, d, i, k)
+            def get_probe(p):
+                return _score_probe_reconstruct(
+                    q_rot, centers_rot, decoded, decoded_norms,
+                    lists_indices, probes[:, p], kind=kind)
 
-    shmapped = jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(axis, None, None),
-                  P(axis, None), P(axis, None), P()),
-        out_specs=(P(), P())))
+            d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
+            if sqrt:
+                d = jnp.sqrt(jnp.maximum(d, 0.0))
+            return _global_merge(comms, axis, d, i, k)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P(),
+                      P(axis, None, None), P(axis, None), P(axis, None),
+                      P()),
+            out_specs=(P(), P())))
+
+    shmapped = _shmap_plan(
+        ("pq_list", mesh, axis, k, n_probes, kind, sqrt), build)
     q_rep = jax.device_put(q, NamedSharding(mesh, P()))
     d, i = shmapped(index.centers, index.centers_rot,
                     index.rotation_matrix, index.decoded,
@@ -406,27 +447,33 @@ def distributed_ivf_flat_search_parts(
     n_probes = min(params.n_probes, dindex.n_lists)
     sqrt = dindex.metric in (DistanceType.L2SqrtExpanded,
                              DistanceType.L2SqrtUnexpanded)
-    comms = build_comms(mesh, axis)
 
-    def local(centers, pdata, pidx, pnorms, q_rep):
-        qq = jnp.sum(q_rep * q_rep, axis=1)
-        coarse = _coarse_scores(q_rep, centers, kind)
-        _, probes = lax.top_k(-coarse, n_probes)
+    def build():
+        comms = build_comms(mesh, axis)
 
-        def get_probe(p):
-            return _score_probe(q_rep, qq, pdata[0], pnorms[0], pidx[0],
-                                probes[:, p], 1.0, kind=kind)
+        def local(centers, pdata, pidx, pnorms, q_rep):
+            qq = jnp.sum(q_rep * q_rep, axis=1)
+            coarse = _coarse_scores(q_rep, centers, kind)
+            _, probes = lax.top_k(-coarse, n_probes)
 
-        d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
-        if sqrt:
-            d = jnp.sqrt(jnp.maximum(d, 0.0))
-        return _global_merge(comms, axis, d, i, k)
+            def get_probe(p):
+                return _score_probe(q_rep, qq, pdata[0], pnorms[0],
+                                    pidx[0], probes[:, p], 1.0,
+                                    kind=kind)
 
-    shmapped = jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(), P(axis, None, None, None), P(axis, None, None),
-                  P(axis, None, None), P()),
-        out_specs=(P(), P())))
+            d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
+            if sqrt:
+                d = jnp.sqrt(jnp.maximum(d, 0.0))
+            return _global_merge(comms, axis, d, i, k)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(axis, None, None, None),
+                      P(axis, None, None), P(axis, None, None), P()),
+            out_specs=(P(), P())))
+
+    shmapped = _shmap_plan(
+        ("flat_parts", mesh, axis, k, n_probes, kind, sqrt), build)
     q_rep = jax.device_put(q, NamedSharding(mesh, P()))
     centers_rep = jax.device_put(dindex.centers,
                                  NamedSharding(mesh, P()))
@@ -606,8 +653,8 @@ def distributed_ivf_pq_search_parts(
     op_dt = jnp.float32 if f32_lut else jnp.bfloat16
     op_prec = matmul_precision() if f32_lut else None
 
-    def local(centers, centers_rot, rot, books, pcodes, pidx, pnorms,
-              q_rep):
+    def _local(centers, centers_rot, rot, books, pcodes, pidx, pnorms,
+               q_rep, comms):
         coarse = _coarse_scores(q_rep, centers, kind)
         _, probes = lax.top_k(-coarse, n_probes)
         q_rot = jnp.matmul(q_rep, rot.T, precision=matmul_precision())
@@ -652,11 +699,18 @@ def distributed_ivf_pq_search_parts(
             d = jnp.sqrt(jnp.maximum(d, 0.0))
         return _global_merge(comms, axis, d, i, k)
 
-    shmapped = jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(axis, None, None, None),
-                  P(axis, None, None), P(axis, None, None), P()),
-        out_specs=(P(), P())))
+    def build():
+        comms = build_comms(mesh, axis)
+        local = functools.partial(_local, comms=comms)
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(axis, None, None, None),
+                      P(axis, None, None), P(axis, None, None), P()),
+            out_specs=(P(), P())))
+
+    shmapped = _shmap_plan(
+        ("pq_parts", mesh, axis, k, n_probes, kind, sqrt, pq_dim,
+         n_codes, lut_dt.name), build)
     rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
     d, i = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
                     rep(dindex.rotation_matrix), rep(dindex.pq_centers),
@@ -790,34 +844,40 @@ def distributed_ivf_bq_search_parts(
     rescore = params.rescore_factor > 0 and dindex.raw is not None
     kk = max(params.rescore_factor, 1) * k
     dim = dindex.dim
-    comms = build_comms(mesh, axis)
 
-    def local(centers, centers_rot, rot, pbits, pn2, psc, pidx, q_rep):
-        coarse = _coarse_scores(q_rep, centers, "l2")
-        _, probes = lax.top_k(-coarse, n_probes)
-        q_rot = q_rep @ rot.T
+    def build():
+        comms = build_comms(mesh, axis)
 
-        def get_probe(p):
-            list_id = probes[:, p]                       # (nq,)
-            pm1 = _unpack_pm1(pbits[0][list_id], dim)    # (nq, ml, d)
-            ql = q_rot - centers_rot[list_id]            # (nq, d)
-            ip = jnp.einsum("qld,qd->ql", pm1,
-                            ql.astype(jnp.bfloat16),
-                            preferred_element_type=jnp.float32)
-            qq = jnp.sum(ql * ql, axis=1)[:, None]
-            est = qq + pn2[0][list_id] - 2.0 * psc[0][list_id] * ip
-            ids = pidx[0][list_id]
-            return jnp.where(ids >= 0, est, jnp.inf), ids
+        def local(centers, centers_rot, rot, pbits, pn2, psc, pidx,
+                  q_rep):
+            coarse = _coarse_scores(q_rep, centers, "l2")
+            _, probes = lax.top_k(-coarse, n_probes)
+            q_rot = q_rep @ rot.T
 
-        d, i = _fine_scan(q_rep, get_probe, kk, n_probes, axis)
-        return _global_merge(comms, axis, d, i, kk)
+            def get_probe(p):
+                list_id = probes[:, p]                     # (nq,)
+                pm1 = _unpack_pm1(pbits[0][list_id], dim)  # (nq, ml, d)
+                ql = q_rot - centers_rot[list_id]          # (nq, d)
+                ip = jnp.einsum("qld,qd->ql", pm1,
+                                ql.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32)
+                qq = jnp.sum(ql * ql, axis=1)[:, None]
+                est = qq + pn2[0][list_id] - 2.0 * psc[0][list_id] * ip
+                ids = pidx[0][list_id]
+                return jnp.where(ids >= 0, est, jnp.inf), ids
 
-    shmapped = jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(), P(), P(), P(axis, None, None, None),
-                  P(axis, None, None), P(axis, None, None),
-                  P(axis, None, None), P()),
-        out_specs=(P(), P())))
+            d, i = _fine_scan(q_rep, get_probe, kk, n_probes, axis)
+            return _global_merge(comms, axis, d, i, kk)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis, None, None, None),
+                      P(axis, None, None), P(axis, None, None),
+                      P(axis, None, None), P()),
+            out_specs=(P(), P())))
+
+    shmapped = _shmap_plan(
+        ("bq_parts", mesh, axis, kk, n_probes, dim), build)
     rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
     d_est, ids = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
                           rep(dindex.rotation_matrix), dindex.parts_bits,

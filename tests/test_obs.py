@@ -306,6 +306,22 @@ class TestMetricNameLint:
         out = lint.lint_source([str(p)])
         assert len(out) == 1 and "raft.x.y.seconds" in out[0]
 
+    def test_required_serving_names_covered(self, tmp_path, monkeypatch):
+        """REQUIRED_NAMES coverage (ISSUE 2 satellite): the real tree
+        exposes every contracted serving instrument, and a tree that
+        lost them fails the full-scan lint one violation per name."""
+        lint = self._load()
+        assert not [v for v in lint.lint_source()
+                    if "REQUIRED_NAMES" in v]
+        empty = tmp_path / "empty_tree" / "raft_tpu"
+        empty.mkdir(parents=True)
+        (empty / "x.py").write_text(
+            self._call("counter", "raft.some.thing") + ".inc()\n")
+        monkeypatch.setattr(lint, "REPO", str(tmp_path / "empty_tree"))
+        out = lint.lint_source()
+        assert (len([v for v in out if "REQUIRED_NAMES" in v])
+                == len(lint.REQUIRED_NAMES))
+
     def test_text_mode_duplicate_type(self):
         lint = self._load()
         text = ("# TYPE raft_a counter\nraft_a_total 1\n"

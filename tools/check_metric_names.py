@@ -50,6 +50,20 @@ CALL_RE = re.compile(
 # future metric added there is linted too)
 SCAN_ROOTS = ("raft_tpu", "tests", "tools", "bench_suite.py", "bench.py")
 
+# serving-path instruments the plan layer CONTRACTS to expose (ISSUE 2:
+# plan-cache hit/miss + the resolve_cap measurement-sync counter whose
+# flatness proves a warmed plan never round-trips). Coverage check:
+# a refactor that silently drops one of these names fails the lint —
+# dashboards and the zero-sync test depend on them existing.
+REQUIRED_NAMES = (
+    "raft.plan.cache.hits",
+    "raft.plan.cache.misses",
+    "raft.plan.build.total",
+    "raft.ivf_scan.resolve_cap.syncs",
+    "raft.ivf_scan.resolve_cap.cache_hits",
+    "raft.ann.batched_search.sub_batches",
+)
+
 
 def iter_source_files() -> List[str]:
     out = []
@@ -66,7 +80,11 @@ def iter_source_files() -> List[str]:
 
 
 def lint_source(files: List[str] = None) -> List[str]:
-    """Scan call sites → list of violation strings."""
+    """Scan call sites → list of violation strings. The REQUIRED_NAMES
+    coverage check only applies to full-tree scans (``files=None``) —
+    an explicit file list (unit tests, partial lints) cannot be
+    expected to contain the serving instruments."""
+    full_scan = files is None
     files = files if files is not None else iter_source_files()
     self_path = os.path.abspath(__file__)
     violations: List[str] = []
@@ -100,6 +118,12 @@ def lint_source(files: List[str] = None) -> List[str]:
                 violations.append(
                     f"{site}: {reg_name!r} registered as {reg_kind} but "
                     f"already a {prev[0]} at {prev[1]}")
+    if full_scan:
+        for name in REQUIRED_NAMES:
+            if name not in seen:
+                violations.append(
+                    f"required serving metric {name!r} has no "
+                    f"instrument call site (REQUIRED_NAMES coverage)")
     return violations
 
 

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# The "no red snapshot" gate (VERDICT r5 weak #1): run this before
+# committing. It fails (nonzero exit) when either
+#   1. pyflakes finds an undefined name / unused-import class defect in
+#      raft_tpu/ (the seed's _bucketize_codes NameError — a red
+#      default path — would have been caught here), or
+#   2. the tier-1 pytest line (ROADMAP.md "Tier-1 verify") fails.
+# pyflakes is optional in the image; when absent the gate degrades to a
+# bytecode-compile sweep (catches syntax errors, not undefined names)
+# and says so.
+set -u
+cd "$(dirname "$0")/.."
+fail=0
+
+if python -c "import pyflakes" >/dev/null 2>&1; then
+    echo "precommit: pyflakes raft_tpu/"
+    python -m pyflakes raft_tpu || fail=1
+else
+    echo "precommit: pyflakes not installed; degrading to py_compile" >&2
+    python -m compileall -q raft_tpu || fail=1
+fi
+
+echo "precommit: metric-name taxonomy lint"
+python tools/check_metric_names.py || fail=1
+
+echo "precommit: tier-1 pytest (ROADMAP.md)"
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+echo "DOTS_PASSED=$dots"
+if [ "$rc" -ne 0 ]; then
+    # PRECOMMIT_MIN_DOTS: environments where a known set of seed tests
+    # cannot pass (e.g. a jax too old for jax.shard_map) gate on the
+    # pass COUNT not regressing instead of on a green exit — the same
+    # "no worse than the seed" contract the driver enforces.
+    if [ -n "${PRECOMMIT_MIN_DOTS:-}" ] \
+            && [ "$dots" -ge "$PRECOMMIT_MIN_DOTS" ]; then
+        echo "precommit: pytest rc=$rc but DOTS_PASSED=$dots >=" \
+             "PRECOMMIT_MIN_DOTS=$PRECOMMIT_MIN_DOTS — accepted"
+    else
+        fail=1
+    fi
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "precommit: FAILED — do not commit a red snapshot" >&2
+fi
+exit $fail
