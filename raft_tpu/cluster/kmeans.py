@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu import obs
+from raft_tpu.obs import spans
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
@@ -135,6 +136,7 @@ def sample_centroids(x, n_clusters: int, seed: int = 0, res=None) -> jax.Array:
     return take_rows(x, sample_rows(x.shape[0], n_clusters, seed))
 
 
+@spans.spanned("raft.kmeans.fit")
 @obs.timed("raft.kmeans.fit")
 def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,
         init_centroids=None, res=None
@@ -179,6 +181,9 @@ def fit(x, params: KMeansParams = KMeansParams(), sample_weight=None,
     # fetched each inertia; n_iter rides the same executed program)
     obs.counter("raft.kmeans.fit.total").inc()
     obs.counter("raft.kmeans.fit.rows").inc(n)
+    spans.current_span().set_attrs(rows=n, n_clusters=k,
+                                   n_iter=int(n_iter),
+                                   inertia=float(inertia))
     obs.histogram("raft.kmeans.fit.iterations",
                   buckets=obs.SIZE_BUCKETS).observe(int(n_iter))
     obs.gauge("raft.kmeans.fit.inertia").set(float(inertia))

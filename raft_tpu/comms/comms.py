@@ -409,9 +409,16 @@ class Comms:
         peer heartbeats abort EARLY (the collective will never complete
         without them), and on any abort ``monitor.last_suspects`` names
         the failed participants (SURVEY.md hard part (e))."""
+        from raft_tpu.obs import spans
         t0 = time.monotonic()
-        status = self._sync_stream(*arrays, timeout_s=timeout_s,
-                                   monitor=monitor)
+        # a real host wait — span it so a request trace shows the
+        # collective completion wait (and its outcome) in place. No
+        # rank attr: get_rank is lax.axis_index, trace-time only — the
+        # host side of a comms object is rank-agnostic by design
+        with spans.span("raft.comms.sync_stream") as sp:
+            status = self._sync_stream(*arrays, timeout_s=timeout_s,
+                                       monitor=monitor)
+            sp.set_attr("status", status.name.lower())
         # host-side, so these are REAL per-call figures (unlike the
         # trace-time collective counters): completion-wait latency and
         # the SUCCESS/ERROR/ABORT outcome mix the failure-recovery

@@ -91,6 +91,31 @@ def kernel_matmul_mode(interpret: bool = False):
     return _kernel_resolved
 
 
+def xla_precision_for_kernel(name: Optional[str]) -> lax.Precision:
+    """Map the per-call kernel-precision spellings onto an XLA
+    ``lax.Precision`` for plain einsum/dot call sites that accept the
+    SAME knob as the Pallas kernels (``kmeans_kernel_precision`` et
+    al.) but lower through XLA: ``None`` defers to the process-wide
+    ``matmul_precision()`` default; ``bf16x3`` maps to
+    ``Precision.HIGH`` (XLA's own 3-pass bf16 split — the same
+    accuracy class as the hand-rolled kernel path); ``bf16`` /
+    ``default`` take the single-pass MXU tier; ``highest`` is true
+    f32."""
+    if name is None:
+        return matmul_precision()
+    if isinstance(name, lax.Precision):
+        return name
+    name = str(name).lower()
+    if name == "bf16x3":
+        return lax.Precision.HIGH
+    if name in ("bf16", "default"):
+        return lax.Precision.DEFAULT
+    if name == "highest":
+        return lax.Precision.HIGHEST
+    raise ValueError(f"kernel precision {name!r}: want "
+                     "bf16x3|bf16|highest|default")
+
+
 def resolve_kernel_mode(name: Optional[str], interpret: bool = False):
     """Per-call kernel matmul mode: ``None`` defers to the process-wide
     ``kernel_matmul_mode()`` env default; otherwise ``bf16x3`` (3-pass

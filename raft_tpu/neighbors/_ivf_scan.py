@@ -302,15 +302,20 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     through one jit cache entry; -1 is the drop-free debug mode, not the
     serving path, so the extra coarse GEMM is accepted."""
     from raft_tpu import obs
+    from raft_tpu.obs import spans
     pc = getattr(params, "probe_cap", 0)
     if pc > 0:
-        return _round_cap(pc, queries.shape[0])
+        cap = _round_cap(pc, queries.shape[0])
+        spans.current_span().set_attrs(cap=cap, cap_mode="pinned")
+        return cap
     # the tier is part of the key: a cap measured under one coarse
     # selection program must not serve the other (a tie resolved
     # differently could push a list past it — see below)
     key = (queries.shape[0], n_probes, use_pallas)
     if pc == 0 and cache is not None and key in cache:
         obs.counter("raft.ivf_scan.resolve_cap.cache_hits").inc()
+        spans.current_span().set_attrs(cap=cache[key],
+                                       cap_mode="cache_hit")
         return cache[key]
     # measure over the SAME coarse selection the serving search runs
     # (use_pallas must match) — a tie resolved differently between two
@@ -320,9 +325,14 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
     # the serving-path fixed cost the plan layer's warmup() exists to
     # eliminate; the counter proves a warmed path never lands here.
     obs.counter("raft.ivf_scan.resolve_cap.syncs").inc()
-    probes = coarse_probes(queries, centers, n_probes, kind=kind,
-                           use_pallas=use_pallas)
-    cap = probe_cap(probes, n_lists)
+    # the measurement is the request's one host round-trip — a child
+    # span makes it visible in the per-request trace (and its absence
+    # on a warm path equally so)
+    with spans.span("raft.ivf_scan.resolve_cap",
+                    nq=int(queries.shape[0]), n_probes=n_probes):
+        probes = coarse_probes(queries, centers, n_probes, kind=kind,
+                               use_pallas=use_pallas)
+        cap = probe_cap(probes, n_lists)
     if pc == 0:
         # ceiling on the AUTO-measured width (drop-free -1 mode stays
         # unbounded): clustered query skew can double the drop-free cap
@@ -345,6 +355,7 @@ def resolve_cap(cache: Optional[dict], queries, centers, params,
             cap = min(cap, floor)
     if pc == 0 and cache is not None:
         cache[key] = cap
+    spans.current_span().set_attrs(cap=cap, cap_mode="measured")
     return cap
 
 

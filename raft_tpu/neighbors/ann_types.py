@@ -57,6 +57,7 @@ def batched_search(search_one_batch, queries, max_batch: int = 0,
     import jax.numpy as jnp
 
     from raft_tpu import obs
+    from raft_tpu.obs import spans
 
     mb = max_batch if max_batch > 0 else MAX_QUERY_BATCH
     nq = queries.shape[0]
@@ -71,22 +72,29 @@ def batched_search(search_one_batch, queries, max_batch: int = 0,
         qb = queries[s:s + mb]
         short = mb - qb.shape[0]
         n_sub += 1
-        if short:
-            # pad with REAL rows from earlier batches when available:
-            # a tail padded with one repeated row concentrates its
-            # probes on that row's lists and can overflow a pinned/
-            # cached inverted-table cap, shedding real probes; earlier
-            # rows keep the pad in-distribution (their results are
-            # discarded). A single short batch cycles its own rows.
-            if s >= short:
-                fill = queries[s - short:s]
+        # one child span per enqueued sub-batch: the request trace
+        # shows the split (same trace_id as the enclosing root span;
+        # durations are enqueue walls — nothing here syncs)
+        with spans.span("raft.ann.sub_batch", index=n_sub - 1,
+                        offset=s, rows=int(qb.shape[0]), padded=short):
+            if short:
+                # pad with REAL rows from earlier batches when
+                # available: a tail padded with one repeated row
+                # concentrates its probes on that row's lists and can
+                # overflow a pinned/cached inverted-table cap, shedding
+                # real probes; earlier rows keep the pad
+                # in-distribution (their results are discarded). A
+                # single short batch cycles its own rows.
+                if s >= short:
+                    fill = queries[s - short:s]
+                else:
+                    reps = -(-short // qb.shape[0])
+                    fill = jnp.tile(qb, (reps, 1))[:short]
+                d, i = search_one_batch(
+                    jnp.concatenate([qb, fill], axis=0))
+                outs.append((d[:mb - short], i[:mb - short]))
             else:
-                reps = -(-short // qb.shape[0])
-                fill = jnp.tile(qb, (reps, 1))[:short]
-            d, i = search_one_batch(jnp.concatenate([qb, fill], axis=0))
-            outs.append((d[:mb - short], i[:mb - short]))
-        else:
-            outs.append(search_one_batch(qb))
+                outs.append(search_one_batch(qb))
     obs.counter("raft.ann.batched_search.sub_batches").inc(n_sub)
     d, i = zip(*outs)
     if len(outs) == 1:

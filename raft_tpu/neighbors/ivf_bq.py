@@ -179,7 +179,10 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
                             1e-30)
     obs.counter("raft.ivf_bq.build.total").inc()
     obs.counter("raft.ivf_bq.build.rows").inc(n)
-    with obs.timed("raft.ivf_bq.build"):
+    from raft_tpu.obs import spans
+    with spans.span("raft.ivf_bq.build", rows=n,
+                    n_lists=params.n_lists), \
+            obs.timed("raft.ivf_bq.build"):
         n_train = max(params.n_lists,
                       int(n * params.kmeans_trainset_fraction))
         trainset = (take_rows(x, sample_rows(n, n_train, 0))
@@ -538,7 +541,15 @@ def search(index: Index, queries, k: int,
     (euclidean for the Sqrt metric), similarities DESCENDING for
     InnerProduct, 1 − cos ascending for cosine; estimator values in
     the same conventions otherwise."""
+    from raft_tpu.obs import spans
+    with spans.span("raft.ivf_bq.search", k=k) as sp:
+        return _search_spanned(index, queries, k, params, res, sp)
+
+
+def _search_spanned(index: Index, queries, k: int, params, res, sp
+                    ) -> Tuple[jax.Array, jax.Array]:
     q = as_array(queries).astype(jnp.float32)
+    sp.set_attr("nq", int(q.shape[0]))
     expects(q.shape[1] == index.dim, "ivf_bq.search: dim mismatch")
     from raft_tpu.neighbors.ann_types import (MAX_QUERY_BATCH,
                                               batched_search)
@@ -606,6 +617,7 @@ def search(index: Index, queries, k: int,
             max(1, (64 << 20) // max(1, max_list * index.dim * 2))))
     obs.histogram("raft.ivf_bq.search.n_probes",
                   buckets=obs.SIZE_BUCKETS).observe(n_probes)
+    sp.set_attrs(n_probes=n_probes, rescore=rescore)
     with obs.timed("raft.ivf_bq.search"):
         from raft_tpu.ops.compile_budget import run_tiers
         from raft_tpu.ops.pallas_ivf_scan import lc_mode

@@ -242,9 +242,13 @@ def build(dataset, params: IndexParams = IndexParams(), res=None) -> Index:
             "ivf_flat: unsupported metric %s", params.metric)
     obs.counter("raft.ivf_flat.build.total").inc()
     obs.counter("raft.ivf_flat.build.rows").inc(n)
+    from raft_tpu.obs import spans
     # RAII scope like the reference's nvtx range in build (nvtx.hpp:69);
-    # obs.timed also lands the wall time in raft.ivf_flat.build.seconds
-    with obs.timed("raft.ivf_flat.build"):
+    # obs.timed also lands the wall time in raft.ivf_flat.build.seconds,
+    # the span puts the build in the flight recorder
+    with spans.span("raft.ivf_flat.build", rows=n,
+                    n_lists=params.n_lists), \
+            obs.timed("raft.ivf_flat.build"):
         if params.metric == DistanceType.CosineExpanded:
             x = x / jnp.maximum(
                 jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
@@ -403,7 +407,17 @@ def search(index: Index, queries, k: int,
            ) -> Tuple[jax.Array, jax.Array]:
     """Search → (dists (nq, k), neighbor ids (nq, k)) (reference
     ivf_flat_search.cuh:1210)."""
+    from raft_tpu.obs import spans
+    # root span of the request (or child when batched/nested): the
+    # per-request story next to the aggregate counters below
+    with spans.span("raft.ivf_flat.search", k=k) as sp:
+        return _search_spanned(index, queries, k, params, res, sp)
+
+
+def _search_spanned(index: Index, queries, k: int, params, res, sp
+                    ) -> Tuple[jax.Array, jax.Array]:
     q = as_array(queries).astype(jnp.float32)
+    sp.set_attr("nq", int(q.shape[0]))
     expects(q.shape[1] == index.dim, "ivf_flat.search: dim mismatch")
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_flat.search: unknown scan_order {params.scan_order!r}")
@@ -417,6 +431,7 @@ def search(index: Index, queries, k: int,
         return batched_search(
             lambda qb: search(index, qb, k, pinned, res=res), q)
     n_probes = min(params.n_probes, index.n_lists)
+    sp.set_attr("n_probes", n_probes)
     # per-batch telemetry (the batched path recurses here per
     # sub-batch, so queries sum correctly across the split)
     obs.counter("raft.ivf_flat.search.queries").inc(q.shape[0])
@@ -440,6 +455,7 @@ def search(index: Index, queries, k: int,
                      or (params.scan_order == "auto"
                          and list_order_auto(nq, n_probes,
                                              index.n_lists))))
+    sp.set_attr("order", "list" if use_list else "probe")
     # RAII scope at the public search (the reference's nvtx range slot);
     # covers both the list-major and probe-major paths — obs.timed opens
     # the trace range and the order-labeled latency histogram together

@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu import obs
+from raft_tpu.obs import spans
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -72,7 +73,10 @@ class IndexParams:
     pq_dim: int = 0           # 0 = dim/4 heuristic (reference default path)
     codebook_kind: CodebookGen = CodebookGen.PER_SUBSPACE
     force_random_rotation: bool = False
-    # Pallas matmul tier for the balanced-EM trainer (docs/tuning.md)
+    # matmul tier for BOTH kmeans phases (docs/tuning.md): the Pallas
+    # balanced-EM coarse trainer takes it verbatim; the grouped PQ
+    # codebook trainer maps it onto the equivalent XLA einsum precision
+    # (core.precision.xla_precision_for_kernel)
     kmeans_kernel_precision: object = None
     # keep the raw f32 vectors on HOST for exact rescoring
     # (SearchParams.rescore_factor — the refine.cuh role fused into
@@ -247,10 +251,11 @@ def _labels_and_prep(x, centers, rot):
 
 @functools.partial(jax.jit, static_argnames=("pq_dim", "pq_len",
                                              "n_codes", "n_iters",
-                                             "chunk"))
+                                             "chunk", "precision"))
 def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
                          pq_dim: int, pq_len: int, n_codes: int,
-                         n_iters: int, chunk: int):
+                         n_iters: int, chunk: int,
+                         precision=None):
     """All pq_dim subspace codebooks trained in ONE compiled program —
     the balanced-EM semantics of the former per-subspace
     balanced_kmeans loop (assignment + masked mean + small-cluster
@@ -270,7 +275,13 @@ def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
     residuals_rot (n, rot_dim); cb_idx (m_pad,) int32 trainset rows
     (cyclically padded to a chunk multiple); valid (m_pad,) bool marks
     real rows; init_idx (pq_dim, n_codes) int32 init positions INTO
-    the trainset. Returns (pq_dim, n_codes, pq_len) codebooks."""
+    the trainset. ``precision`` is the XLA tier for the assignment/
+    update einsums (static; ``None`` = the process-wide
+    matmul_precision default) — ``IndexParams.kmeans_kernel_precision``
+    reaches here via ``core.precision.xla_precision_for_kernel``.
+    Returns (pq_dim, n_codes, pq_len) codebooks."""
+    if precision is None:
+        precision = matmul_precision()
     m = cb_idx.shape[0]
     tr = residuals_rot[cb_idx]                          # (m, rot_dim)
     sub = tr.reshape(m, pq_dim, pq_len).transpose(1, 0, 2)  # (S, m, l)
@@ -291,7 +302,7 @@ def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
             xb, vb, ib = inp                            # (S,B,l),(B,),(B,)
             ip = jnp.einsum("sbl,scl->sbc", xb, centers,
                             preferred_element_type=jnp.float32,
-                            precision=matmul_precision())
+                            precision=precision)
             bb = jnp.sum(xb * xb, axis=2)
             d = bb[:, :, None] + cc[:, None, :] - 2.0 * ip
             assign = jnp.argmin(d, axis=2)              # (S, B)
@@ -301,7 +312,7 @@ def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
             counts = counts + jnp.sum(oh, axis=1)
             sums = sums + jnp.einsum("sbc,sbl->scl", oh, xb,
                                      preferred_element_type=jnp.float32,
-                                     precision=matmul_precision())
+                                     precision=precision)
             # running top-C worst-cost rows per subspace (reseed pool);
             # padded rows never qualify
             dmin = jnp.where(vb[None, :] > 0, dmin, -jnp.inf)
@@ -338,11 +349,16 @@ def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
     single-program grouped trainer (_train_books_grouped).
 
     ``cb_idx``: optional HOST int array of trainset rows (the caller's
-    subsample); None trains on all rows. ``kernel_precision`` is
-    accepted for signature compatibility; the grouped trainer's
-    einsums always run at matmul_precision (the train phase is a
-    negligible share of build FLOPs)."""
-    del kernel_precision
+    subsample); None trains on all rows. ``kernel_precision`` follows
+    the Pallas-kernel spellings (None = env default, ``bf16x3``,
+    ``bf16``, ``highest``) and is threaded into the grouped trainer's
+    assignment/update einsums via
+    ``core.precision.xla_precision_for_kernel`` — the public
+    ``IndexParams.kmeans_kernel_precision`` knob therefore shapes PQ
+    codebook training exactly like the coarse trainer (it used to be
+    silently dropped here)."""
+    from raft_tpu.core.precision import xla_precision_for_kernel
+    precision = xla_precision_for_kernel(kernel_precision)
     n = residuals_rot.shape[0]
     if cb_idx is None:
         cb_idx = np.arange(n, dtype=np.int32)
@@ -357,7 +373,8 @@ def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
         for _ in range(pq_dim)]).astype(np.int32)
     return _train_books_grouped(
         residuals_rot, jnp.asarray(pad_idx), jnp.asarray(valid),
-        jnp.asarray(init_idx), pq_dim, pq_len, n_codes, n_iters, chunk)
+        jnp.asarray(init_idx), pq_dim, pq_len, n_codes, n_iters, chunk,
+        precision=precision)
 
 
 def _list_chunk(L: int, per_list_elems: int,
@@ -491,6 +508,7 @@ def _bucketize_codes(codes, labels, counts, pq_centers, n_lists: int,
     return codes_b, idx, counts, _code_norms(codes_b, pq_centers, idx)
 
 
+@spans.spanned("raft.ivf_pq.build")
 @obs.timed("raft.ivf_pq.build")
 def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
           res=None) -> Index:
@@ -501,6 +519,8 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     expects(params.n_lists <= n, "ivf_pq.build: n_lists > n_samples")
     obs.counter("raft.ivf_pq.build.total").inc()
     obs.counter("raft.ivf_pq.build.rows").inc(n)
+    spans.current_span().set_attrs(rows=n, n_lists=params.n_lists,
+                                   pq_bits=params.pq_bits)
     pq_dim = params.pq_dim if params.pq_dim > 0 else max(1, dim // 4)
     rot_dim = ((dim + pq_dim - 1) // pq_dim) * pq_dim
     pq_len = rot_dim // pq_dim
@@ -967,7 +987,14 @@ def search(index: Index, queries, k: int,
     the kernel tier is live, else the bf16 reconstruction-cache scan
     ("reconstruct", ~8x the codes' memory); "lut" is the CUDA-style
     gather formulation kept for parity testing."""
+    with spans.span("raft.ivf_pq.search", k=k) as sp:
+        return _search_spanned(index, queries, k, params, res, sp)
+
+
+def _search_spanned(index: Index, queries, k: int, params, res, sp
+                    ) -> Tuple[jax.Array, jax.Array]:
     q = as_array(queries).astype(jnp.float32)
+    sp.set_attr("nq", int(q.shape[0]))
     expects(q.shape[1] == index.dim, "ivf_pq.search: dim mismatch")
     expects(params.scan_mode in ("auto", "codes", "reconstruct", "lut"),
             f"ivf_pq.search: unknown scan_mode {params.scan_mode!r}")
@@ -989,6 +1016,7 @@ def search(index: Index, queries, k: int,
     expects(params.scan_order in ("auto", "probe", "list"),
             f"ivf_pq.search: unknown scan_order {params.scan_order!r}")
     n_probes = min(params.n_probes, index.n_lists)
+    sp.set_attr("n_probes", n_probes)
     # per-batch telemetry (the batched path recurses here per
     # sub-batch, so queries sum correctly across the split)
     obs.counter("raft.ivf_pq.search.queries").inc(q.shape[0])
@@ -1041,6 +1069,7 @@ def search(index: Index, queries, k: int,
     if scan_mode == "auto":
         from raft_tpu.ops.dispatch import pallas_enabled
         scan_mode = "codes" if pallas_enabled() else "reconstruct"
+    sp.set_attrs(mode=scan_mode, rescoring=rescoring)
     expects(jnp.dtype(params.lut_dtype) in
             (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
              jnp.dtype(jnp.float8_e4m3fn)),

@@ -36,6 +36,7 @@ docs/performance.md for the serving guide.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Tuple
 
@@ -43,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu import obs
+from raft_tpu.obs import spans
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -52,6 +54,21 @@ def _donate_ok() -> bool:
     """Buffer donation is a no-op (with a noisy warning) on CPU; only
     request it where the backend honors it."""
     return jax.default_backend() in ("tpu", "gpu", "axon")
+
+
+# the stage structure of a compiled serving program, in program order
+# with static attribution weights (the fused executable cannot be
+# host-timed per stage — spans.add_stage_spans marks these
+# attributed=True; tools/profile_ivf_pieces.py measures the real
+# split, see docs/observability.md "Diagnosing one slow query")
+_PLAN_STAGES = (
+    ("raft.plan.stage.coarse", 0.12),
+    ("raft.plan.stage.inversion", 0.05),
+    ("raft.plan.stage.scan", 0.55),
+    ("raft.plan.stage.merge", 0.18),
+    ("raft.plan.stage.postprocess", 0.10),
+)
+_RESCORE_STAGE = ("raft.plan.stage.rescore", 0.25)
 
 
 @dataclass
@@ -101,12 +118,28 @@ class SearchPlan:
                 self.dim)
         obs.counter("raft.plan.search.total").inc()
         obs.counter("raft.plan.search.queries").inc(self.nq)
-        if self._donate and isinstance(queries, jax.Array):
-            q = jnp.array(q, copy=True)  # caller keeps their buffer
-        d, i = self._run(q)
-        if block:
-            jax.block_until_ready((d, i))
+        with spans.span("raft.plan.search", family=self.family,
+                        nq=self.nq, k=self.k, n_probes=self.n_probes,
+                        cap=self.cap, sync_free=self.sync_free,
+                        blocked=block) as sp:
+            if self._donate and isinstance(queries, jax.Array):
+                q = jnp.array(q, copy=True)  # caller keeps their buffer
+            t0 = time.perf_counter()
+            d, i = self._run(q)
+            if block:
+                jax.block_until_ready((d, i))
+            # per-stage breakdown of the fused program (attributed —
+            # host walls only exist for the whole executable; under
+            # async dispatch this is enqueue time unless `block`)
+            spans.add_stage_spans(
+                self._stages(), time.perf_counter() - t0,
+                family=self.family, compiled=True)
+            sp.set_attr("plan_key", repr(self.key))
         return d, i
+
+    def _stages(self):
+        return (_PLAN_STAGES + (_RESCORE_STAGE,)
+                if self._host_epilogue is not None else _PLAN_STAGES)
 
     def search_batched(self, queries, block: bool = True
                        ) -> Tuple[jax.Array, jax.Array]:
@@ -126,10 +159,16 @@ class SearchPlan:
             # donation-compiled executable
             return self.search(queries, block=block)
         obs.counter("raft.plan.search.queries").inc(q.shape[0])
-        d, i = batched_search(self._run, q, max_batch=self.nq,
-                              pad_partial=True)
-        if block:
-            jax.block_until_ready((d, i))
+        # root span for the whole request; batched_search opens one
+        # child span per enqueued sub-batch under it
+        with spans.span("raft.plan.search_batched", family=self.family,
+                        nq=int(q.shape[0]), k=self.k,
+                        n_probes=self.n_probes, cap=self.cap,
+                        plan_nq=self.nq, blocked=block):
+            d, i = batched_search(self._run, q, max_batch=self.nq,
+                                  pad_partial=True)
+            if block:
+                jax.block_until_ready((d, i))
         return d, i
 
 
@@ -453,7 +492,9 @@ def build_plan(index, queries, k: int, params=None,
             q.shape)
     nq = q.shape[0]
     make, n_probes, kind, use_pallas_coarse = builder(index, k, params)
-    with obs.timed("raft.plan.build", family=family):
+    with spans.span("raft.plan.build", family=family, nq=nq,
+                    k=k) as bsp, \
+            obs.timed("raft.plan.build", family=family):
         # the ONE measurement round-trip of the plan lifecycle: also
         # prefills index.cap_cache so the cold path (ivf_flat.search et
         # al.) is sync-free at this shape from now on
@@ -461,14 +502,17 @@ def build_plan(index, queries, k: int, params=None,
                                     params, n_probes, index.n_lists,
                                     kind=kind,
                                     use_pallas=use_pallas_coarse)
+        bsp.set_attrs(cap=cap, n_probes=n_probes)
         fn, operands, host_epilogue, key_bits = make(nq, cap)
         key = (family, nq, index.dim, k, n_probes, cap, kind) + key_bits
         cached = index.plan_cache.get(key)
         if cached is not None:
             obs.counter("raft.plan.cache.hits").inc()
+            bsp.set_attr("plan_cache", "hit")
             return cached
         obs.counter("raft.plan.cache.misses").inc()
         obs.counter("raft.plan.build.total").inc()
+        bsp.set_attr("plan_cache", "miss")
         donate = _donate_ok()
         jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
         q_struct = jax.ShapeDtypeStruct((nq, index.dim), jnp.float32)

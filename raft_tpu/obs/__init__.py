@@ -1,12 +1,21 @@
-"""raft_tpu.obs — metrics + runtime telemetry.
+"""raft_tpu.obs — metrics, request tracing + runtime telemetry.
 
-The quantitative observability layer the reference never had (its story
-is NVTX ranges + spdlog — our ``core/trace.py`` / ``core/logger.py``):
-a dependency-free, thread-safe registry of counters, gauges and
-fixed-boundary histograms, wired into every hot path (ops dispatch,
-compile cache, IVF search/build, k-means, comms/health) under one
-``raft.<module>.<op>`` naming taxonomy shared with the xprof trace
-ranges.
+The observability layer the reference never had (its story is NVTX
+ranges + spdlog — our ``core/trace.py`` / ``core/logger.py``), in
+three planes sharing ONE ``raft.<module>.<op>`` naming taxonomy:
+
+* **metrics** (:mod:`raft_tpu.obs.registry`) — dependency-free,
+  thread-safe counters/gauges/fixed-boundary histograms wired into
+  every hot path; ``RAFT_TPU_METRICS=0`` no-ops it.
+* **request-scoped spans** (:mod:`raft_tpu.obs.spans`) — per-request
+  traces (trace_id/parent links, wall durations, attributes) through
+  the serving paths, landing in the always-on **flight recorder**
+  (:mod:`raft_tpu.obs.recorder`): the last N request stories, a
+  slow-query log, Chrome-trace export. ``RAFT_TPU_TRACE=0`` no-ops it.
+* **endpoint** (:mod:`raft_tpu.obs.endpoint`) — ``obs.serve()``, a
+  stdlib HTTP server exposing ``/metrics`` (Prometheus text),
+  ``/healthz`` (comms health gauges) and ``/debug/requests`` (the
+  recorder).
 
 Quick use::
 
@@ -14,12 +23,15 @@ Quick use::
     obs.counter("raft.myapp.requests", route="search").inc()
     with obs.timed("raft.myapp.handle"):
         ...
+    with obs.span("raft.myapp.request", user="abc") as sp:
+        ...
+    obs.RECORDER.requests(5)          # last 5 request traces
+    srv = obs.serve(port=9100)        # scrape/debug endpoint
     print(obs.to_prometheus_text())   # scrape endpoint body
     state = obs.snapshot()            # JSON-ready dict
 
-``RAFT_TPU_METRICS=0`` no-ops the whole registry. See
-docs/observability.md for the taxonomy, the exporters and how
-``obs.timed`` relates to profiler trace ranges.
+See docs/observability.md for the taxonomy, the exporters, the span/
+recorder knobs and how ``obs.timed`` relates to profiler trace ranges.
 """
 
 from raft_tpu.obs.registry import (
@@ -43,6 +55,17 @@ from raft_tpu.obs.registry import (
     enabled,
 )
 from raft_tpu.obs.timing import timed
+from raft_tpu.obs.spans import (
+    Span,
+    span,
+    current_span,
+    current_trace_id,
+    add_stage_spans,
+    set_trace_enabled,
+    trace_enabled,
+)
+from raft_tpu.obs.recorder import FlightRecorder, RECORDER, to_chrome_trace
+from raft_tpu.obs.endpoint import DebugServer, serve
 
 __all__ = [
     "REGISTRY",
@@ -64,4 +87,17 @@ __all__ = [
     "set_enabled",
     "enabled",
     "timed",
+    # spans / recorder / endpoint
+    "Span",
+    "span",
+    "current_span",
+    "current_trace_id",
+    "add_stage_spans",
+    "set_trace_enabled",
+    "trace_enabled",
+    "FlightRecorder",
+    "RECORDER",
+    "to_chrome_trace",
+    "DebugServer",
+    "serve",
 ]

@@ -1,0 +1,198 @@
+"""Serving debug endpoint — stdlib-only HTTP server for obs state.
+
+``obs.serve()`` starts a daemon-threaded HTTP server (no dependency
+beyond ``http.server``) exposing the three planes one serving box
+needs inspectable:
+
+* ``GET /metrics`` — the Prometheus exposition body
+  (``obs.to_prometheus_text()``): point a scraper here.
+* ``GET /healthz`` — comms/health verdict from the
+  ``raft.comms.health.*`` gauges: 200 ``{"status": "ok"}`` while no
+  session reports suspect ranks, 503 ``{"status": "degraded", ...}``
+  the moment one does (suspect counts + worst heartbeat staleness per
+  session ride in the body).
+* ``GET /debug/requests`` — the flight recorder
+  (:mod:`raft_tpu.obs.recorder`): structured JSON of the last N
+  request traces. Query params: ``n=<count>`` limits, ``slow=1``
+  restricts to the slow ring, ``trace=<id>`` selects one trace, and
+  ``format=chrome`` renders it (or, without ``trace``, the most
+  recent) as Chrome-trace JSON — save the body and load it in
+  Perfetto.
+
+Use::
+
+    from raft_tpu import obs
+    srv = obs.serve(port=9100)        # or port=0 for an ephemeral port
+    print(srv.url)                    # e.g. http://127.0.0.1:9100
+    ...
+    srv.close()
+
+The server binds loopback by default — it exposes internals (query
+shapes, timings); front it with real infrastructure before exposing it
+beyond the host.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from raft_tpu.obs import recorder as _recorder
+from raft_tpu.obs import registry as _registry
+
+__all__ = ["DebugServer", "serve"]
+
+
+def _health_body(snapshot: dict) -> dict:
+    """Health verdict from the comms/health gauges: any session with
+    ``raft.comms.health.suspects`` > 0 degrades the box."""
+    gauges = snapshot.get("gauges", {})
+    suspects = {}
+    staleness = {}
+    for series, value in gauges.items():
+        if series.startswith("raft.comms.health.suspects"):
+            suspects[series] = value
+        elif series.startswith("raft.comms.health.max_staleness_seconds"):
+            staleness[series] = value
+    degraded = any(v > 0 for v in suspects.values())
+    return {
+        "status": "degraded" if degraded else "ok",
+        "suspects": suspects,
+        "max_staleness_seconds": staleness,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server object carries the recorder/registry (see DebugServer)
+    server: "DebugServer"
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, indent=1).encode("utf-8"),
+                   "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        path = url.path.rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                text = self.server.registry.to_prometheus_text()
+                self._send(200, text.encode("utf-8"),
+                           "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                body = _health_body(self.server.registry.snapshot())
+                self._send_json(200 if body["status"] == "ok" else 503,
+                                body)
+            elif path == "/debug/requests":
+                self._debug_requests(q)
+            else:
+                self._send_json(404, {"error": f"no route {path!r}",
+                                      "routes": ["/metrics", "/healthz",
+                                                 "/debug/requests"]})
+        except BrokenPipeError:
+            pass
+
+    def _debug_requests(self, q: dict) -> None:
+        rec = self.server.recorder
+        trace_id = q.get("trace", [None])[0]
+        fmt = q.get("format", ["json"])[0]
+        n = None
+        if "n" in q:
+            try:
+                n = max(0, int(q["n"][0]))
+            except ValueError:
+                self._send_json(400, {"error": "n must be an integer"})
+                return
+        if trace_id is not None:
+            trace = rec.get(trace_id)
+            if trace is None:
+                self._send_json(404, {"error": f"trace {trace_id!r} not "
+                                               f"in the recorder ring"})
+                return
+            if fmt == "chrome":
+                self._send_json(200, _recorder.to_chrome_trace(trace))
+            else:
+                self._send_json(200, trace)
+            return
+        if fmt == "chrome":
+            latest = rec.requests(1)
+            if not latest:
+                self._send_json(404, {"error": "recorder is empty"})
+                return
+            self._send_json(200, _recorder.to_chrome_trace(latest[0]))
+            return
+        if q.get("slow", ["0"])[0] not in ("0", "", "false"):
+            body = rec.to_json(0)
+            body["traces"] = rec.slow_requests(n)
+            self._send_json(200, body)
+            return
+        self._send_json(200, rec.to_json(n))
+
+    def log_message(self, fmt: str, *args) -> None:
+        # route access logs through the framework logger at DEBUG —
+        # a scraper hitting /metrics every 15 s must not spam stderr
+        from raft_tpu.core.logger import get_logger
+        get_logger("obs").debug("endpoint: " + fmt % args)
+
+
+class DebugServer(ThreadingHTTPServer):
+    """The obs debug server; build via :func:`serve`."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, recorder=None, registry=None):
+        super().__init__(addr, _Handler)
+        self.recorder = recorder if recorder is not None \
+            else _recorder.RECORDER
+        self.registry = registry if registry is not None \
+            else _registry.REGISTRY
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "DebugServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self.serve_forever, kwargs={"poll_interval": 0.25},
+                daemon=True, name=f"raft-obs-endpoint-{self.port}")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "DebugServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve(host: str = "127.0.0.1", port: int = 0, recorder=None,
+          registry=None) -> DebugServer:
+    """Start the debug endpoint in a daemon thread → running
+    :class:`DebugServer` (``.url``, ``.port``, ``.close()``).
+    ``port=0`` binds an ephemeral port (tests, side-by-side procs)."""
+    return DebugServer((host, port), recorder=recorder,
+                       registry=registry).start()

@@ -1,0 +1,196 @@
+"""Flight recorder — the last N request traces, always on.
+
+A bounded ring buffer of completed span traces
+(:mod:`raft_tpu.obs.spans` hands every finished root trace here): the
+per-request story behind the aggregate metrics — per-stage breakdown,
+plan/cap attributes, sub-batch and shard spans — kept cheap enough to
+leave on in production (a deque append under a lock per REQUEST, not
+per span; zero work when no spans are opened, nothing at all under
+``RAFT_TPU_TRACE=0``).
+
+Knobs (read at construction):
+
+* ``RAFT_TPU_TRACE_RING`` — ring capacity in traces (default 128).
+* ``RAFT_TPU_TRACE_SLOW_MS`` — slow-request threshold; traces at or
+  above it are ALSO kept in a separate slow ring (so a burst of fast
+  requests cannot evict the interesting one) and logged through
+  ``core.logger`` at WARN (default 250 ms; runtime override via
+  :meth:`FlightRecorder.set_slow_threshold_ms`).
+
+Exports: :meth:`FlightRecorder.to_json` (the ``/debug/requests``
+body) and :func:`to_chrome_trace` — any recorded trace as Chrome
+trace-event JSON, loadable in Perfetto / ``chrome://tracing``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import List, Optional
+
+from raft_tpu.obs import registry as _registry
+
+__all__ = ["FlightRecorder", "RECORDER", "to_chrome_trace"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    """Bounded ring of completed request traces + slow-query log."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 slow_ms: Optional[float] = None,
+                 slow_capacity: int = 32,
+                 registry: Optional[object] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("RAFT_TPU_TRACE_RING", "128"))
+        if slow_ms is None:
+            slow_ms = _env_float("RAFT_TPU_TRACE_SLOW_MS", 250.0)
+        self.capacity = max(1, capacity)
+        self.slow_ms = slow_ms
+        self._ring = collections.deque(maxlen=self.capacity)
+        self._slow = collections.deque(maxlen=max(1, slow_capacity))
+        self._lock = threading.Lock()
+        self._registry = registry if registry is not None \
+            else _registry.REGISTRY
+        self.recorded_total = 0
+
+    # -- ingest ------------------------------------------------------------
+    @staticmethod
+    def _is_request(trace: dict) -> bool:
+        """Slow-query handling applies to REQUEST traces — search-path
+        roots (or anything tagged ``request=True``). A build or a
+        kmeans fit is expected to take seconds; warning on every one
+        would bury the signal the slow-query log exists for."""
+        name = trace.get("name", "")
+        return (name.endswith(".search") or ".search" in name
+                or bool(trace.get("attrs", {}).get("request")))
+
+    def record(self, trace: dict) -> None:
+        dur = trace.get("duration_ms", 0.0)
+        slow = dur >= self.slow_ms and self._is_request(trace)
+        with self._lock:
+            self._ring.append(trace)
+            if slow:
+                self._slow.append(trace)
+            self.recorded_total += 1
+        self._registry.counter("raft.obs.recorder.traces").inc()
+        if slow:
+            self._registry.counter("raft.obs.recorder.slow_traces").inc()
+            # the slow-query log line: enough to find the full trace in
+            # the ring (or the endpoint) without grepping spans
+            from raft_tpu.core.logger import get_logger
+            attrs = trace.get("attrs", {})
+            get_logger("obs").warn(
+                "slow request %s (%s): %.1f ms >= %.1f ms threshold "
+                "(%d spans%s)", trace.get("trace_id"), trace.get("name"),
+                dur, self.slow_ms, len(trace.get("spans", ())),
+                f", attrs={attrs}" if attrs else "")
+
+    # -- knobs -------------------------------------------------------------
+    def set_slow_threshold_ms(self, ms: float) -> None:
+        self.slow_ms = float(ms)
+
+    # -- query -------------------------------------------------------------
+    def requests(self, n: Optional[int] = None) -> List[dict]:
+        """Most-recent-first recorded traces (up to ``n``)."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:n] if n is not None else out
+
+    def slow_requests(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._slow)
+        out.reverse()
+        return out[:n] if n is not None else out
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            for t in reversed(self._ring):
+                if t.get("trace_id") == trace_id:
+                    return t
+            for t in reversed(self._slow):
+                if t.get("trace_id") == trace_id:
+                    return t
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._slow.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- export ------------------------------------------------------------
+    def to_json(self, n: Optional[int] = None) -> dict:
+        """The structured ``/debug/requests`` dump: recorder config +
+        most-recent-first traces (+ the slow ring's trace ids, so a
+        reader can tell which survived because they were slow)."""
+        with self._lock:
+            traces = list(self._ring)
+            slow_ids = [t.get("trace_id") for t in self._slow]
+        traces.reverse()
+        if n is not None:
+            traces = traces[:n]
+        return {
+            "capacity": self.capacity,
+            "slow_threshold_ms": self.slow_ms,
+            "recorded_total": self.recorded_total,
+            "slow_trace_ids": slow_ids,
+            "traces": traces,
+        }
+
+
+def to_chrome_trace(trace: dict) -> dict:
+    """One recorded trace as Chrome trace-event JSON (the object form:
+    ``{"traceEvents": [...]}`` — loads in Perfetto and
+    ``chrome://tracing``). Spans become complete (``ph="X"``) events
+    with microsecond ``ts``/``dur``; a span's ``rank`` attribute (the
+    shard spans of ``parallel/ivf.py``) maps to the event ``pid`` so
+    per-rank rows group visually, everything else rides in ``args``."""
+    base_us = float(trace.get("start_unix", 0.0)) * 1e6
+    events = [{
+        "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+        "args": {"name": f"raft_tpu {trace.get('trace_id', '')}"},
+    }]
+    for sp in trace.get("spans", ()):
+        attrs = sp.get("attrs", {})
+        try:
+            pid = int(attrs.get("rank", 0))
+        except (TypeError, ValueError):
+            pid = 0
+        args = {"trace_id": trace.get("trace_id"),
+                "span_id": sp.get("span_id")}
+        if sp.get("parent_id"):
+            args["parent_id"] = sp["parent_id"]
+        args.update(attrs)
+        events.append({
+            "name": sp.get("name", ""),
+            "cat": "raft",
+            "ph": "X",
+            "ts": base_us + sp.get("t_start_ms", 0.0) * 1e3,
+            "dur": max(0.0, sp.get("duration_ms", 0.0) * 1e3),
+            "pid": pid,
+            # fold the 64-bit thread ident into the int32 range chrome
+            # tooling expects
+            "tid": int(sp.get("tid", 0)) % (1 << 31),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": trace.get("trace_id"),
+                          "name": trace.get("name"),
+                          "duration_ms": trace.get("duration_ms")}}
+
+
+# the process-wide recorder every completed root span lands in; tests
+# can build private instances
+RECORDER = FlightRecorder()

@@ -1,0 +1,349 @@
+"""Request-scoped spans — the per-request story the aggregates lack.
+
+``raft_tpu.obs`` metrics answer "how often / how slow on average";
+``core.trace`` ranges answer "where inside one profiled session".
+Neither ties a p99 histogram bucket back to *which* query, plan, cap
+decision, or shard caused it after the fact. Spans do: every serving
+entry point opens a **root span**, nested scopes (sub-batches, cap
+resolution, shard dispatch) attach as **children** sharing one
+``trace_id``, and the completed trace — names, parent links, wall
+durations, attributes — lands in the always-on flight recorder
+(:mod:`raft_tpu.obs.recorder`), exportable as Chrome-trace/Perfetto
+JSON and served by the debug endpoint (:mod:`raft_tpu.obs.endpoint`).
+
+Span names use the SAME ``raft.<module>.<op>`` taxonomy as metrics and
+trace ranges (linted by ``tools/check_metric_names.py``), and every
+span also opens a ``core.trace.range`` of its name, so one name finds
+the histogram, the xprof range, and the recorded request.
+
+Quick use::
+
+    from raft_tpu.obs import spans
+    with spans.span("raft.myapp.handle", route="search") as sp:
+        with spans.span("raft.myapp.stage"):
+            ...
+        sp.set_attr("cache", "hit")
+
+Semantics and caveats:
+
+* **wall clock** — a span measures host time in its scope: under JAX
+  async dispatch that is enqueue time unless the scope synchronizes
+  (the same caveat as ``obs.timed``). ``sp.sync(value)`` optionally
+  blocks on a device value and records the device-inclusive duration
+  in ``attrs["device_ms"]``.
+* **attributed stages** — an AOT plan executes coarse/inversion/scan/
+  merge/postprocess as ONE fused program; per-stage host timing is
+  impossible by design. :func:`add_stage_spans` records the program's
+  stage structure as child spans whose durations split the measured
+  wall by static weights, marked ``attributed=True``. They show the
+  shape of the request; ``tools/profile_ivf_pieces.py`` is the
+  measured ground truth (docs/observability.md walkthrough).
+* **toggle** — ``RAFT_TPU_TRACE=0`` (mirroring ``RAFT_TPU_METRICS``)
+  no-ops the whole layer: ``span()`` returns one shared null object
+  (nothing is allocated or recorded), runtime toggle via
+  :func:`set_trace_enabled`.
+* **threads** — the active trace is thread-local; a trace never leaks
+  across requests served on different threads.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.obs.registry import NAME_RE
+
+__all__ = [
+    "Span",
+    "span",
+    "spanned",
+    "current_span",
+    "current_trace_id",
+    "add_stage_spans",
+    "add_child_span",
+    "set_trace_enabled",
+    "trace_enabled",
+]
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("RAFT_TPU_TRACE", "1").lower() not in (
+        "0", "false", "off", "no")
+
+
+_enabled = _env_enabled()
+_tls = threading.local()
+# itertools.count is atomic in CPython; ids only need process-local
+# uniqueness (the pid prefixes exported traces where it matters)
+_ids = itertools.count(1)
+
+
+def set_trace_enabled(on: bool = True) -> None:
+    """Runtime toggle (initial state from ``RAFT_TPU_TRACE``)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def trace_enabled() -> bool:
+    return _enabled
+
+
+def _new_id() -> str:
+    return f"{next(_ids):08x}"
+
+
+class _TraceState:
+    """Per-thread in-flight trace: the stack of open spans plus the
+    records of finished ones."""
+
+    __slots__ = ("trace_id", "spans", "stack", "t0", "t0_unix")
+
+    def __init__(self):
+        self.trace_id = f"{os.getpid():x}-{_new_id()}"
+        self.spans: List[dict] = []
+        self.stack: List["Span"] = []
+        self.t0 = time.perf_counter()
+        self.t0_unix = time.time()
+
+
+class Span:
+    """One open scope. Use via :func:`span`; context-manager only."""
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "trace_id",
+                 "_t0", "_trace", "_range", "_tid", "_root")
+
+    def __init__(self, name: str, attrs: Dict[str, object]):
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"span name {name!r} violates the raft.<module>.<op> "
+                f"taxonomy (want {NAME_RE.pattern})")
+        self.name = name
+        self.attrs = attrs
+        self.span_id = ""
+        self.parent_id = None
+        self.trace_id = ""
+        self._t0 = 0.0
+        self._trace = None
+        self._range = None
+        self._tid = 0
+        self._root = False
+
+    # -- attributes --------------------------------------------------------
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def set_attrs(self, **kv) -> None:
+        self.attrs.update(kv)
+
+    def sync(self, value) -> float:
+        """Block until ``value`` (any pytree of jax arrays) is ready and
+        record the device-inclusive elapsed time since span start as
+        ``attrs["device_ms"]``. Returns the elapsed seconds."""
+        import jax
+        jax.block_until_ready(value)
+        dt = time.perf_counter() - self._t0
+        self.attrs["device_ms"] = round(dt * 1e3, 3)
+        return dt
+
+    # -- scope -------------------------------------------------------------
+    def __enter__(self) -> "Span":
+        tr = getattr(_tls, "trace", None)
+        if tr is None:
+            tr = _TraceState()
+            _tls.trace = tr
+            self._root = True
+        self._trace = tr
+        self.trace_id = tr.trace_id
+        self.span_id = _new_id()
+        if tr.stack:
+            self.parent_id = tr.stack[-1].span_id
+        tr.stack.append(self)
+        self._tid = threading.get_ident()
+        # the span IS the profiler range (shared taxonomy): cheap no-op
+        # without an active profiler session
+        from raft_tpu.core import trace
+        self._range = trace.range(self.name)
+        self._range.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        rng, self._range = self._range, None
+        if rng is not None:
+            rng.__exit__(exc_type, exc, tb)
+        tr = self._trace
+        self._trace = None
+        if tr is None:
+            return False
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        try:
+            tr.stack.remove(self)
+        except ValueError:
+            pass
+        rec = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "t_start_ms": round((self._t0 - tr.t0) * 1e3, 3),
+            "duration_ms": round(dur * 1e3, 3),
+            "tid": self._tid,
+        }
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        tr.spans.append(rec)
+        if self._root:
+            _tls.trace = None
+            _finalize(tr, self, dur)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span for the disabled layer: accepts every Span
+    method, allocates nothing, records nothing."""
+
+    __slots__ = ()
+    name = ""
+    span_id = ""
+    trace_id = ""
+    parent_id = None
+    attrs: Dict[str, object] = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, key: str, value) -> None: ...
+
+    def set_attrs(self, **kv) -> None: ...
+
+    def sync(self, value) -> float:
+        return 0.0
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **attrs) -> Span:
+    """Open a span named under the ``raft.<module>.<op>`` taxonomy.
+    Returns the shared null object when tracing is disabled."""
+    if not _enabled:
+        return _NULL_SPAN
+    return Span(name, attrs)
+
+
+def spanned(name: str, **attrs):
+    """Decorator form: run every call of the wrapped function inside
+    ``span(name, **attrs)`` (fresh span per call — re-entrant). The
+    body can enrich it via ``current_span().set_attrs(...)``."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(name, **attrs):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def current_span():
+    """The innermost open span on this thread (the null span when
+    tracing is off or no span is open) — lets deep call sites attach
+    attributes (resolved cap, cache hit/miss) to the request that is
+    already in flight without opening a scope of their own."""
+    if not _enabled:
+        return _NULL_SPAN
+    tr = getattr(_tls, "trace", None)
+    if tr is not None and tr.stack:
+        return tr.stack[-1]
+    return _NULL_SPAN
+
+
+def current_trace_id() -> Optional[str]:
+    tr = getattr(_tls, "trace", None)
+    return tr.trace_id if tr is not None else None
+
+
+def add_stage_spans(stages: Sequence[Tuple[str, float]], total_s: float,
+                    **attrs) -> None:
+    """Record attributed child spans under the current span: ``stages``
+    is a sequence of ``(name, weight)``; each stage's duration splits
+    ``total_s`` proportionally, laid end-to-end over the interval that
+    just elapsed (``[now - total_s, now]``). Used by the AOT plan path,
+    where the stages execute inside ONE fused program and cannot be
+    host-timed individually — spans carry ``attributed=True`` so
+    exporters and readers can tell estimation from measurement."""
+    if not _enabled:
+        return
+    tr = getattr(_tls, "trace", None)
+    if tr is None or not tr.stack:
+        return
+    parent = tr.stack[-1]
+    total_w = sum(w for _, w in stages)
+    if total_w <= 0 or total_s < 0:
+        return
+    tid = threading.get_ident()
+    cursor = time.perf_counter() - total_s
+    for name, w in stages:
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"stage span name {name!r} violates the taxonomy")
+        dur = total_s * (w / total_w)
+        tr.spans.append({
+            "name": name,
+            "span_id": _new_id(),
+            "parent_id": parent.span_id,
+            "t_start_ms": round((cursor - tr.t0) * 1e3, 3),
+            "duration_ms": round(dur * 1e3, 3),
+            "tid": tid,
+            "attrs": {"attributed": True, **attrs},
+        })
+        cursor += dur
+
+
+def add_child_span(name: str, start_s: float, duration_s: float,
+                   **attrs) -> None:
+    """Record one already-timed child span under the current span
+    (``start_s`` on the ``time.perf_counter`` clock). The rank-tagged
+    shard spans of ``parallel/ivf.py`` use this: the SPMD dispatch runs
+    every rank inside one host call, so the per-rank spans share the
+    dispatch interval and are merged host-side into the one trace."""
+    if not _enabled:
+        return
+    tr = getattr(_tls, "trace", None)
+    if tr is None or not tr.stack:
+        return
+    if not NAME_RE.match(name):
+        raise ValueError(f"span name {name!r} violates the taxonomy")
+    tr.spans.append({
+        "name": name,
+        "span_id": _new_id(),
+        "parent_id": tr.stack[-1].span_id,
+        "t_start_ms": round((start_s - tr.t0) * 1e3, 3),
+        "duration_ms": round(duration_s * 1e3, 3),
+        "tid": threading.get_ident(),
+        "attrs": attrs,
+    })
+
+
+def _finalize(tr: _TraceState, root: Span, dur_s: float) -> None:
+    trace = {
+        "trace_id": tr.trace_id,
+        "name": root.name,
+        "start_unix": tr.t0_unix,
+        "duration_ms": round(dur_s * 1e3, 3),
+        "spans": tr.spans,
+    }
+    if root.attrs:
+        trace["attrs"] = dict(root.attrs)
+    # lazy import: recorder depends on registry/logger only, so the
+    # dependency between the two obs submodules stays one-way
+    from raft_tpu.obs import recorder as _recorder
+    _recorder.RECORDER.record(trace)

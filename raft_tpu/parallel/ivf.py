@@ -19,6 +19,7 @@ id translation is needed at merge.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Tuple
 
 import jax
@@ -27,6 +28,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu import obs
+from raft_tpu.obs import spans
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.precision import matmul_precision
@@ -53,10 +55,24 @@ def _shmap_plan(key, builder):
     fn = _SHMAP_PLANS.get(key)
     if fn is None:
         obs.counter("raft.parallel.plan.misses").inc()
+        spans.current_span().set_attr("shmap_plan", "miss")
         fn = _SHMAP_PLANS[key] = builder()
     else:
         obs.counter("raft.parallel.plan.hits").inc()
+        spans.current_span().set_attr("shmap_plan", "hit")
     return fn
+
+
+def _rank_spans(n_shards: int, t0: float, dt: float) -> None:
+    """One rank-tagged child span per mesh shard, merged host-side into
+    the current trace. The shard_map dispatch executes every rank
+    inside ONE host call (SPMD), so the per-rank spans share the
+    dispatch interval — they tag the trace with WHICH ranks served the
+    request (EQuARX-style rank-level accounting), not independent
+    per-rank walls. In Chrome-trace export the ``rank`` attribute maps
+    to the event pid, so ranks render as separate rows."""
+    for r in range(n_shards):
+        spans.add_child_span("raft.parallel.ivf.shard", t0, dt, rank=r)
 
 
 def _shard0(arr, mesh, axis):
@@ -209,12 +225,17 @@ def distributed_ivf_flat_search(
                       P(axis, None), P()),
             out_specs=(P(), P())))
 
-    shmapped = _shmap_plan(
-        ("flat_list", mesh, axis, k, n_probes, kind, sqrt, scale),
-        build)
-    q_rep = jax.device_put(q, NamedSharding(mesh, P()))
-    d, i = shmapped(index.centers, index.lists_data, index.lists_indices,
-                    index.lists_norms, q_rep)
+    with spans.span("raft.parallel.ivf.search", family="ivf_flat",
+                    nq=int(q.shape[0]), k=k, n_probes=n_probes,
+                    axis=axis, n_shards=n_shards):
+        shmapped = _shmap_plan(
+            ("flat_list", mesh, axis, k, n_probes, kind, sqrt, scale),
+            build)
+        q_rep = jax.device_put(q, NamedSharding(mesh, P()))
+        t0 = time.perf_counter()
+        d, i = shmapped(index.centers, index.lists_data,
+                        index.lists_indices, index.lists_norms, q_rep)
+        _rank_spans(n_shards, t0, time.perf_counter() - t0)
     return _postprocess(d, index.metric), i
 
 
@@ -268,12 +289,17 @@ def distributed_ivf_pq_search(
                       P()),
             out_specs=(P(), P())))
 
-    shmapped = _shmap_plan(
-        ("pq_list", mesh, axis, k, n_probes, kind, sqrt), build)
-    q_rep = jax.device_put(q, NamedSharding(mesh, P()))
-    d, i = shmapped(index.centers, index.centers_rot,
-                    index.rotation_matrix, index.decoded,
-                    index.decoded_norms, index.lists_indices, q_rep)
+    with spans.span("raft.parallel.ivf.search", family="ivf_pq",
+                    nq=int(q.shape[0]), k=k, n_probes=n_probes,
+                    axis=axis, n_shards=n_shards):
+        shmapped = _shmap_plan(
+            ("pq_list", mesh, axis, k, n_probes, kind, sqrt), build)
+        q_rep = jax.device_put(q, NamedSharding(mesh, P()))
+        t0 = time.perf_counter()
+        d, i = shmapped(index.centers, index.centers_rot,
+                        index.rotation_matrix, index.decoded,
+                        index.decoded_norms, index.lists_indices, q_rep)
+        _rank_spans(n_shards, t0, time.perf_counter() - t0)
     return _postprocess(d, index.metric), i
 
 
@@ -472,13 +498,19 @@ def distributed_ivf_flat_search_parts(
                       P(axis, None, None), P(axis, None, None), P()),
             out_specs=(P(), P())))
 
-    shmapped = _shmap_plan(
-        ("flat_parts", mesh, axis, k, n_probes, kind, sqrt), build)
-    q_rep = jax.device_put(q, NamedSharding(mesh, P()))
-    centers_rep = jax.device_put(dindex.centers,
-                                 NamedSharding(mesh, P()))
-    d, i = shmapped(centers_rep, dindex.parts_data, dindex.parts_indices,
-                    dindex.parts_norms, q_rep)
+    n_shards = mesh.shape[axis]
+    with spans.span("raft.parallel.ivf.search", family="ivf_flat_parts",
+                    nq=int(q.shape[0]), k=k, n_probes=n_probes,
+                    axis=axis, n_shards=n_shards):
+        shmapped = _shmap_plan(
+            ("flat_parts", mesh, axis, k, n_probes, kind, sqrt), build)
+        q_rep = jax.device_put(q, NamedSharding(mesh, P()))
+        centers_rep = jax.device_put(dindex.centers,
+                                     NamedSharding(mesh, P()))
+        t0 = time.perf_counter()
+        d, i = shmapped(centers_rep, dindex.parts_data,
+                        dindex.parts_indices, dindex.parts_norms, q_rep)
+        _rank_spans(n_shards, t0, time.perf_counter() - t0)
     return _postprocess(d, dindex.metric), i
 
 
@@ -708,14 +740,21 @@ def distributed_ivf_pq_search_parts(
                       P(axis, None, None), P(axis, None, None), P()),
             out_specs=(P(), P())))
 
-    shmapped = _shmap_plan(
-        ("pq_parts", mesh, axis, k, n_probes, kind, sqrt, pq_dim,
-         n_codes, lut_dt.name), build)
-    rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
-    d, i = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
-                    rep(dindex.rotation_matrix), rep(dindex.pq_centers),
-                    dindex.parts_codes, dindex.parts_indices,
-                    dindex.parts_norms, rep(q))
+    n_shards = mesh.shape[axis]
+    with spans.span("raft.parallel.ivf.search", family="ivf_pq_parts",
+                    nq=int(q.shape[0]), k=k, n_probes=n_probes,
+                    axis=axis, n_shards=n_shards):
+        shmapped = _shmap_plan(
+            ("pq_parts", mesh, axis, k, n_probes, kind, sqrt, pq_dim,
+             n_codes, lut_dt.name), build)
+        rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
+        t0 = time.perf_counter()
+        d, i = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
+                        rep(dindex.rotation_matrix),
+                        rep(dindex.pq_centers), dindex.parts_codes,
+                        dindex.parts_indices, dindex.parts_norms,
+                        rep(q))
+        _rank_spans(n_shards, t0, time.perf_counter() - t0)
     return _postprocess(d, dindex.metric), i
 
 
@@ -876,17 +915,25 @@ def distributed_ivf_bq_search_parts(
                       P(axis, None, None), P()),
             out_specs=(P(), P())))
 
-    shmapped = _shmap_plan(
-        ("bq_parts", mesh, axis, kk, n_probes, dim), build)
-    rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
-    d_est, ids = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
-                          rep(dindex.rotation_matrix), dindex.parts_bits,
-                          dindex.parts_norms2, dindex.parts_scales,
-                          dindex.parts_indices, rep(q))
-    from raft_tpu.neighbors.ivf_bq import (finish_search,
-                                           resolve_raw_device)
-    raw_dev = (resolve_raw_device(dindex, params.rescore_on_device)
-               if rescore else None)
-    return finish_search(d_est, ids, dindex.raw, q, k,
-                         metric=dindex.metric, rescore=rescore,
-                         raw_dev=raw_dev)
+    n_shards = mesh.shape[axis]
+    with spans.span("raft.parallel.ivf.search", family="ivf_bq_parts",
+                    nq=int(q.shape[0]), k=k, n_probes=n_probes,
+                    axis=axis, n_shards=n_shards, rescore=rescore):
+        shmapped = _shmap_plan(
+            ("bq_parts", mesh, axis, kk, n_probes, dim), build)
+        rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
+        t0 = time.perf_counter()
+        d_est, ids = shmapped(rep(dindex.centers),
+                              rep(dindex.centers_rot),
+                              rep(dindex.rotation_matrix),
+                              dindex.parts_bits, dindex.parts_norms2,
+                              dindex.parts_scales, dindex.parts_indices,
+                              rep(q))
+        _rank_spans(n_shards, t0, time.perf_counter() - t0)
+        from raft_tpu.neighbors.ivf_bq import (finish_search,
+                                               resolve_raw_device)
+        raw_dev = (resolve_raw_device(dindex, params.rescore_on_device)
+                   if rescore else None)
+        return finish_search(d_est, ids, dindex.raw, q, k,
+                             metric=dindex.metric, rescore=rescore,
+                             raw_dev=raw_dev)

@@ -1,27 +1,39 @@
 #!/usr/bin/env python
-"""Lint the metric-name taxonomy (docs/observability.md).
+"""Lint the metric/span-name taxonomy (docs/observability.md).
 
-Two modes, one contract — every metric is ``raft.<module>.<op>...``
-(lowercase ``[a-z0-9_]`` segments, dot-separated) and a name is bound
-to exactly ONE instrument kind:
+Three modes, one contract — every metric AND span name is
+``raft.<module>.<op>...`` (lowercase ``[a-z0-9_]`` segments,
+dot-separated) and a metric name is bound to exactly ONE instrument
+kind:
 
 * **source mode** (default): scan the instrumented tree for
   ``obs.counter("...")`` / ``obs.gauge`` / ``obs.histogram`` /
-  ``obs.timed`` call sites with a literal first argument and fail on
+  ``obs.timed`` / ``obs.span`` / ``spans.span`` / ``spans.spanned`` /
+  ``spans.add_child_span`` call sites with a literal first argument
+  and fail on
   - names violating the taxonomy regex,
   - the same name registered under conflicting kinds (``obs.timed(n)``
     registers the histogram ``n + ".seconds"``, so a ``timed`` name
-    also conflicts with a counter/gauge of that derived name).
+    also conflicts with a counter/gauge of that derived name; span
+    names are a separate plane and never kind-conflict with metrics).
 * **text mode** (``--text FILE``, ``-`` = stdin): parse a Prometheus
   exposition dump (the ``obs.to_prometheus_text()`` output) and fail on
   - family names not matching ``raft_[a-z0-9_]+``,
   - duplicate ``# TYPE`` declarations for one family.
+* **trace mode** (``--trace FILE``, ``-`` = stdin): parse an exported
+  Chrome-trace JSON (``obs.to_chrome_trace`` / the endpoint's
+  ``format=chrome``) and fail on
+  - malformed JSON or a missing ``traceEvents`` array,
+  - ``X`` events without ``ts``/``dur``/``pid``/``tid``,
+  - event names violating the ``raft.<module>.<op>`` taxonomy.
 
 Runs in the tier-1 path via ``tests/test_obs.py::TestMetricNameLint``
-(both modes) and standalone::
++ ``tests/test_obs_spans.py`` (all modes) and standalone::
 
     python tools/check_metric_names.py            # lint the source tree
     python bench_suite.py ... | python tools/check_metric_names.py --text -
+    curl .../debug/requests?format=chrome | \\
+        python tools/check_metric_names.py --trace -
 
 Exit code 0 = clean, 1 = violations (printed one per line).
 """
@@ -42,9 +54,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NAME_RE = re.compile(r"^raft\.[a-z0-9_]+(\.[a-z0-9_]+)*$")
 PROM_NAME_RE = re.compile(r"^raft_[a-z0-9_]+$")
 
-# obs.counter("raft.x.y", ...), obs.timed('raft.x.y'), ...
+# obs.counter("raft.x.y", ...), obs.timed('raft.x.y'),
+# spans.span("raft.x.y") / obs.span(...) / spans.spanned(...) /
+# spans.add_child_span(...) — spans share the taxonomy but are their
+# own plane (no instrument-kind conflicts with metrics)
 CALL_RE = re.compile(
-    r"""\bobs\.(counter|gauge|histogram|timed)\(\s*(['"])([^'"]+)\2""")
+    r"""\b(?:obs|spans)\.(counter|gauge|histogram|timed|span|spanned"""
+    r"""|add_child_span)\(\s*(['"])([^'"]+)\2""")
+SPAN_KINDS = ("span", "spanned", "add_child_span")
+
+# any full raft.* string literal (the attributed stage-name tables the
+# plan layer hands to spans.add_stage_spans are plain tuples, not call
+# sites) — used ONLY for REQUIRED_SPAN_NAMES coverage, never flagged
+LITERAL_RE = re.compile(r"""['"](raft\.[a-z0-9_]+(?:\.[a-z0-9_]+)+)['"]""")
 
 # trees holding instrumented call sites (bench/tools ride along so a
 # future metric added there is linted too)
@@ -62,6 +84,22 @@ REQUIRED_NAMES = (
     "raft.ivf_scan.resolve_cap.syncs",
     "raft.ivf_scan.resolve_cap.cache_hits",
     "raft.ann.batched_search.sub_batches",
+)
+
+# serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
+# the request root, the attributed stage breakdown, the sub-batch
+# split, and the rank-tagged shard spans. Checked against every full
+# raft.* string literal in a full-tree scan (stage names live in the
+# _PLAN_STAGES table, not a call site).
+REQUIRED_SPAN_NAMES = (
+    "raft.plan.search",
+    "raft.plan.search_batched",
+    "raft.plan.stage.coarse",
+    "raft.plan.stage.scan",
+    "raft.plan.stage.merge",
+    "raft.ann.sub_batch",
+    "raft.parallel.ivf.shard",
+    "raft.ivf_flat.search",
 )
 
 
@@ -90,6 +128,8 @@ def lint_source(files: List[str] = None) -> List[str]:
     violations: List[str] = []
     # name -> (kind, first definition site)
     seen: Dict[str, Tuple[str, str]] = {}
+    span_seen: Dict[str, str] = {}      # span name -> first site
+    literals: Dict[str, str] = {}       # any full raft.* literal
     for path in files:
         if os.path.abspath(path) == self_path:
             continue  # this file's docstring examples are not call sites
@@ -108,6 +148,11 @@ def lint_source(files: List[str] = None) -> List[str]:
                     f"{site}: {name!r} violates the raft.<module>.<op> "
                     f"taxonomy")
                 continue
+            if kind in SPAN_KINDS:
+                # spans share the taxonomy but not the instrument
+                # registry — record for coverage, no kind conflicts
+                span_seen.setdefault(name, site)
+                continue
             # timed registers <name>.seconds as a histogram
             reg_name = name + ".seconds" if kind == "timed" else name
             reg_kind = "histogram" if kind == "timed" else kind
@@ -118,12 +163,20 @@ def lint_source(files: List[str] = None) -> List[str]:
                 violations.append(
                     f"{site}: {reg_name!r} registered as {reg_kind} but "
                     f"already a {prev[0]} at {prev[1]}")
+        for m in LITERAL_RE.finditer(text):
+            if NAME_RE.match(m.group(1)):
+                literals.setdefault(m.group(1), rel)
     if full_scan:
         for name in REQUIRED_NAMES:
             if name not in seen:
                 violations.append(
                     f"required serving metric {name!r} has no "
                     f"instrument call site (REQUIRED_NAMES coverage)")
+        for name in REQUIRED_SPAN_NAMES:
+            if name not in span_seen and name not in literals:
+                violations.append(
+                    f"required serving span {name!r} has no span call "
+                    f"site or literal (REQUIRED_SPAN_NAMES coverage)")
     return violations
 
 
@@ -159,16 +212,59 @@ def lint_prometheus_text(text: str) -> List[str]:
     return violations
 
 
+def lint_chrome_trace(text: str) -> List[str]:
+    """Validate an exported Chrome-trace JSON: structure + the span
+    taxonomy on every event name (metadata ``ph="M"`` events are
+    structural and exempt)."""
+    import json
+    violations: List[str] = []
+    try:
+        obj = json.loads(text)
+    except ValueError as e:
+        return [f"trace: not valid JSON ({e})"]
+    events = obj.get("traceEvents") if isinstance(obj, dict) else obj
+    if not isinstance(events, list):
+        return ["trace: no traceEvents array"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            violations.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        name = ev.get("name", "")
+        if not NAME_RE.match(name):
+            violations.append(
+                f"event {i}: name {name!r} violates the "
+                f"raft.<module>.<op> taxonomy")
+        if ph != "X":
+            violations.append(f"event {i}: ph {ph!r} (expected 'X')")
+            continue
+        for field in ("ts", "dur", "pid", "tid"):
+            if not isinstance(ev.get(field), (int, float)):
+                violations.append(
+                    f"event {i} ({name}): missing/non-numeric "
+                    f"{field!r}")
+    return violations
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--text", metavar="FILE", default=None,
                     help="lint a Prometheus exposition dump instead of "
                          "the source tree ('-' = stdin)")
+    ap.add_argument("--trace", metavar="FILE", default=None,
+                    help="lint an exported Chrome-trace JSON "
+                         "(obs.to_chrome_trace output; '-' = stdin)")
     args = ap.parse_args(argv)
     if args.text is not None:
         text = (sys.stdin.read() if args.text == "-"
                 else open(args.text, encoding="utf-8").read())
         violations = lint_prometheus_text(text)
+    elif args.trace is not None:
+        text = (sys.stdin.read() if args.trace == "-"
+                else open(args.trace, encoding="utf-8").read())
+        violations = lint_chrome_trace(text)
     else:
         violations = lint_source()
     for v in violations:

@@ -20,8 +20,21 @@ else
     python -m compileall -q raft_tpu || fail=1
 fi
 
-echo "precommit: metric-name taxonomy lint"
+echo "precommit: metric + span name taxonomy lint"
 python tools/check_metric_names.py || fail=1
+
+# span layer round-trip: open one span, export the recorded trace as
+# Chrome-trace JSON, lint it (--trace mode). Catches an exporter or
+# span-name regression before the (slower) pytest stage does.
+echo "precommit: span trace-export lint"
+JAX_PLATFORMS=cpu python -c "
+import json
+from raft_tpu import obs
+with obs.span('raft.precommit.search', gate='precommit'):
+    with obs.span('raft.precommit.stage'):
+        pass
+print(json.dumps(obs.to_chrome_trace(obs.RECORDER.requests(1)[0])))
+" | python tools/check_metric_names.py --trace - || fail=1
 
 echo "precommit: tier-1 pytest (ROADMAP.md)"
 set -o pipefail

@@ -16,9 +16,12 @@ LOG="$OUT/tunnel_watch3.log"
 say() { echo "$(date '+%m-%d %H:%M:%S') $*" >>"$LOG"; }
 
 # round-start marker: bench.py's degraded path promotes a banked green
-# headline only when its embedded measured_at postdates this
+# headline only when its embedded measured_at postdates this. Written
+# UNCONDITIONALLY at watcher startup: a stale marker surviving from a
+# previous round would let bench.py promote the PREVIOUS round's TPU
+# headline as same-round (ADVICE r5)
 mkdir -p "$OUT"
-[ -f "$OUT/round_start.iso" ] || date '+%Y-%m-%dT%H:%M:%S' > "$OUT/round_start.iso"
+date '+%Y-%m-%dT%H:%M:%S' > "$OUT/round_start.iso"
 
 all_banked() {
   for s in h0 h1 d0 b0 n0 g0 x0; do
