@@ -260,6 +260,23 @@ def child_main():
             except Exception as e:  # must not void the headline
                 out[f"{fam}_error"] = repr(e)[:200]
             print(json.dumps(out), flush=True)  # bank each family's row
+        # sharded multi-chip builds (ISSUE 4): per-family wall seconds
+        # for the list-sharded build path, riding the same artifact so
+        # sharded_build_s and build_s are same-round comparable
+        try:
+            rows = []
+            bench_suite.bench_sharded_build(rows, n=n_ivf, nlists=nlists)
+            for r in rows:
+                fam = r["metric"].split("_sharded_build_")[0]
+                if "sharded_build_s" in r:
+                    out[f"{fam}_sharded_build_s"] = r["sharded_build_s"]
+                    out.setdefault("sharded_build_n_shards",
+                                   r.get("n_shards"))
+                elif "error" in r:
+                    out[f"{fam}_sharded_build_error"] = r["error"]
+        except Exception as e:
+            out["sharded_build_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

@@ -114,6 +114,137 @@ def balanced_kmeans(x, n_clusters: int, n_iters: int = 20,
                           kernel_precision=kernel_precision)
 
 
+# ---------------------------------------------------------------------------
+# Data-parallel trainer (ISSUE 4 tentpole): the MNMG form of the balanced
+# EM above — RAFT's own MNMG value proposition is exactly this loop built
+# from kmeans pieces + a raft::comms allreduce of the centroid sufficient
+# statistics (SURVEY.md §3.3); EQuARX shows the statistics exchange is the
+# compressible part, and here it is the ONLY per-sweep wire traffic.
+# ---------------------------------------------------------------------------
+
+# jitted-callable cache for the sharded EM program (the parallel/ivf
+# _shmap_plan pattern at trainer scope): without it every build would
+# re-trace + re-compile the whole shard_map'd fori_loop — the exact
+# serving-call retrace bug PR 2 fixed for searches, at build scope.
+_SHARDED_EM_PLANS: dict = {}
+
+
+def _sharded_em_plan(key, builder):
+    fn = _SHARDED_EM_PLANS.get(key)
+    if fn is None:
+        obs.counter("raft.kmeans_balanced.sharded.plan_misses").inc()
+        fn = _SHARDED_EM_PLANS[key] = builder()
+    else:
+        obs.counter("raft.kmeans_balanced.sharded.plan_hits").inc()
+    return fn
+
+
+def balanced_kmeans_sharded(x, n_clusters: int, n_iters: int = 20,
+                            balance_threshold: float = 0.25, seed: int = 0,
+                            kernel_precision: str | None = None,
+                            mesh=None, axis: str = "data",
+                            res=None) -> jax.Array:
+    """Data-parallel :func:`balanced_kmeans` over ``mesh[axis]``.
+
+    Rows are sharded over the mesh's data axis; each EM sweep computes
+    per-shard centroid sums/counts and ``psum``s the sufficient
+    statistics (the cuML-MNMG/raft::comms pattern), so per-sweep wire
+    traffic is O(n_clusters·dim), independent of the shard size. The
+    balancing/reseed step runs on the REPLICATED statistics: each shard
+    contributes its top-``n_clusters`` highest-assignment-cost rows,
+    the candidates are allgathered and re-ranked identically on every
+    shard, so the selected seeds — and therefore the centers — stay
+    bit-identical across shards. Returns (n_clusters, dim) replicated
+    centers.
+
+    Parity with the single-device trainer: the EM update is the same
+    math (sums/counts merely reduce in a different order) and the
+    reseed pool is the exact global top-k where the single-device path
+    uses ``approx_max_k`` — both are heuristic seed choices; centers
+    agree within fp tolerance whenever balancing rarely triggers (the
+    parity test's regime) and within recall tolerance downstream
+    otherwise."""
+    import jax.sharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from raft_tpu.comms.comms import build_comms
+    from raft_tpu.parallel.mesh import shard_map_compat
+
+    if mesh is None:
+        mesh = (res.mesh if res is not None and hasattr(res, "mesh")
+                else jax.sharding.Mesh(jax.devices(), (axis,)))
+    x = as_array(x).astype(jnp.float32)
+    n, dim = x.shape
+    n_shards = mesh.shape[axis]
+    obs.counter("raft.kmeans_balanced.em_sweeps").inc(n_iters)
+    obs.counter("raft.kmeans_balanced.build.total", path="sharded").inc()
+
+    # init centers: the SAME host-side draw as the single-device trainer
+    # (seed-for-seed identical inits are what makes parity testable);
+    # gathered eagerly — O(n_clusters·dim), replicated
+    c0 = take_rows(x, sample_rows(n, n_clusters, seed))
+
+    pad = (-n) % n_shards
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    valid = jnp.arange(n + pad) < n
+    m_local = (n + pad) // n_shards
+    # per-shard reseed candidates: enough that the global top-n_clusters
+    # is exact (each shard contributes up to n_clusters candidates)
+    kc = min(n_clusters, m_local)
+    avg = n / n_clusters
+
+    def build():
+        comms = build_comms(mesh, axis)
+
+        def local(x_sh, valid_sh, c_init):
+            w = valid_sh.astype(jnp.float32)
+
+            def one_iter(_, centers):
+                labels, d = _nn(x_sh, centers, kernel_precision)
+                counts = comms.allreduce(jax.ops.segment_sum(
+                    w, labels, num_segments=n_clusters))
+                sums = comms.allreduce(jax.ops.segment_sum(
+                    x_sh * w[:, None], labels, num_segments=n_clusters))
+                new_centers = sums / jnp.where(counts == 0.0, 1.0,
+                                               counts)[:, None]
+                # adjust_centers on replicated statistics: per-shard
+                # top-kc worst-cost REAL rows → allgather → exact global
+                # top-n_clusters, identical on every shard (pad rows
+                # carry -inf cost and never qualify)
+                dm = jnp.where(valid_sh, d, -jnp.inf)
+                wd, wi = lax.top_k(dm, kc)
+                cand = x_sh[wi]
+                gd = comms.allgather(wd).reshape(-1)
+                gc = comms.allgather(cand).reshape(-1, dim)
+                _, sel = lax.top_k(gd, n_clusters)
+                # pmax proves replication of the gathered-selection to
+                # shard_map (the _global_merge trick) — identity in value
+                seeds = lax.pmax(gc[sel], axis)
+                small = counts < balance_threshold * avg
+                slot = jnp.cumsum(small.astype(jnp.int32)) - 1
+                return jnp.where(
+                    small[:, None],
+                    seeds[jnp.clip(slot, 0, n_clusters - 1)],
+                    new_centers)
+
+            return lax.fori_loop(0, n_iters, one_iter, c_init)
+
+        return jax.jit(shard_map_compat(
+            local, mesh,
+            in_specs=(P(axis, None), P(axis), P()),
+            out_specs=P()))
+
+    with obs.timed("raft.kmeans_balanced.train", path="sharded"):
+        fn = _sharded_em_plan(
+            ("balanced_em", mesh, axis, n_clusters, n_iters,
+             float(balance_threshold), kernel_precision,
+             m_local, dim), build)
+        xs = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+        vs = jax.device_put(valid, NamedSharding(mesh, P(axis)))
+        cr = jax.device_put(c0, NamedSharding(mesh, P()))
+        return fn(xs, vs, cr)
+
+
 def build_hierarchical(x, n_clusters: int, n_iters: int = 20,
                        max_train_points: int = 1 << 18, seed: int = 0,
                        kernel_precision: str | None = None,

@@ -41,8 +41,30 @@ from raft_tpu.neighbors.ivf_flat import (
 
 def _fetch(a):
     """Host→device transfer point (module-local so tests can observe
-    fetch sizes without touching jax.numpy globally)."""
+    fetch sizes without touching jax.numpy globally). Both directions
+    of the host-memory contract route through here: list fetches at
+    search AND chunk ingestion at streaming build — a test asserting
+    peak device allocation hooks ONE symbol."""
     return jnp.asarray(a)
+
+
+def _place_chunk(n_lists: int, cursor, chunk, labels, id_base: int,
+                 lists_data, lists_idx, lists_norms=None, row_norms=None):
+    """Place one host chunk's rows into their list slots (per-list write
+    cursors) — the shared host-side assembly step of :func:`build` and
+    :func:`build_streaming`. ``row_norms`` (when given) land in
+    ``lists_norms`` alongside the rows."""
+    order = np.argsort(labels, kind="stable")
+    bounds = np.searchsorted(labels[order], np.arange(n_lists + 1))
+    for l in range(n_lists):
+        rows = order[bounds[l]:bounds[l + 1]]
+        if rows.size:
+            c = cursor[l]
+            lists_data[l, c:c + rows.size] = chunk[rows]
+            lists_idx[l, c:c + rows.size] = (id_base + rows)
+            if lists_norms is not None:
+                lists_norms[l, c:c + rows.size] = row_norms[rows]
+            cursor[l] += rows.size
 
 
 @dataclass
@@ -126,16 +148,8 @@ def build(dataset, params: IndexParams = IndexParams(),
     for start in range(0, n, chunk_rows):
         chunk = x[start:start + chunk_rows]
         labels = labels_all[start:start + chunk.shape[0]]
-        order = np.argsort(labels, kind="stable")
-        bounds = np.searchsorted(labels[order],
-                                 np.arange(params.n_lists + 1))
-        for l in range(params.n_lists):
-            rows = order[bounds[l]:bounds[l + 1]]
-            if rows.size:
-                c = cursor[l]
-                lists_data[l, c:c + rows.size] = chunk[rows]
-                lists_idx[l, c:c + rows.size] = (start + rows)
-                cursor[l] += rows.size
+        _place_chunk(params.n_lists, cursor, chunk, labels, start,
+                     lists_data, lists_idx)
 
     # norms in list blocks: O(block·max_list·dim) f64 temporaries only
     norms = np.empty((params.n_lists, max_list), np.float32)
@@ -145,6 +159,129 @@ def build(dataset, params: IndexParams = IndexParams(),
         norms[l0:l0 + blk] = (seg * seg).sum(-1).astype(np.float32)
     return HostIvfFlat(centers=centers, lists_data=lists_data,
                        lists_norms=norms, lists_indices=lists_idx,
+                       metric=params.metric, size=n, scale=1.0)
+
+
+def _label_norm_impl(chunk, centers):
+    from raft_tpu.cluster.kmeans_balanced import _nn
+    labels, _ = _nn(chunk, centers)
+    return labels.astype(jnp.int32), jnp.sum(chunk * chunk, axis=1)
+
+
+_LABEL_JIT = None
+
+
+def _label_chunk_fn():
+    """Fused label+norm program for streaming ingestion. The chunk
+    operand is DONATED on backends that support donation (TPU/GPU), so
+    each chunk's transfer buffer is recycled in place — peak device
+    memory stays one chunk, not one per in-flight dispatch. (CPU has no
+    donation; the loop's synchronous device_get bounds liveness there.)"""
+    global _LABEL_JIT
+    if _LABEL_JIT is None:
+        donate = (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+        _LABEL_JIT = jax.jit(_label_norm_impl, donate_argnums=donate)
+    return _LABEL_JIT
+
+
+def build_streaming(chunks, params: IndexParams = IndexParams(),
+                    train_rows: int = 1 << 18, seed: int = 0,
+                    res=None) -> HostIvfFlat:
+    """Build a host-resident IVF-Flat index from an ITERATOR of host
+    chunks — the ingestion path for corpora that never fit in HBM.
+
+    Peak device allocation is O(chunk + train_rows + n_lists·dim): the
+    coarse centers train on a bounded subsample drawn across the whole
+    stream, then every chunk takes ONE fused label+norm dispatch (the
+    chunk operand donated — see :func:`_label_chunk_fn`) while the
+    inverted lists assemble on the host. Chunks are buffered host-side
+    (numpy): host RAM bounds the corpus, device HBM never does. Every
+    host→device transfer routes through :func:`_fetch`, so tests can
+    assert the O(chunk) property by hooking one symbol.
+
+    Parity: labeling shares ``kmeans_balanced`` with the resident
+    build, so with ``train_rows >= n`` the trainer sees exactly the
+    in-memory ``ivf_flat.build`` trainset (fraction 1.0) and list
+    membership is identical to the resident index's.
+    """
+    from raft_tpu import obs
+    from raft_tpu.obs import spans
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.distance.distance_types import DistanceType as _DT
+
+    chunk_list = []
+    for c in chunks:
+        c = np.ascontiguousarray(np.asarray(c, dtype=np.float32))
+        expects(c.ndim == 2, "build_streaming: chunks must be 2-D")
+        if chunk_list:
+            expects(c.shape[1] == chunk_list[0].shape[1],
+                    "build_streaming: chunk dim mismatch (%d vs %d)",
+                    c.shape[1], chunk_list[0].shape[1])
+        if params.metric == _DT.CosineExpanded:
+            c = c / np.maximum(
+                np.linalg.norm(c, axis=1, keepdims=True), 1e-30)
+        chunk_list.append(c)
+    expects(len(chunk_list) > 0, "build_streaming: empty chunk stream")
+    n = sum(c.shape[0] for c in chunk_list)
+    dim = chunk_list[0].shape[1]
+    expects(params.n_lists <= n, "build_streaming: n_lists > n_samples")
+
+    with spans.span("raft.build.streaming", rows=n,
+                    chunks=len(chunk_list), n_lists=params.n_lists):
+        obs.counter("raft.build.streaming.chunks").inc(len(chunk_list))
+        obs.counter("raft.build.streaming.rows").inc(n)
+
+        # bounded trainset drawn across the whole stream (host-side
+        # draw, row order preserved: train_rows >= n degenerates to the
+        # exact in-memory trainset)
+        t_rows = min(n, train_rows)
+        if t_rows < n:
+            rng = np.random.default_rng(seed)
+            sel = np.sort(rng.choice(n, t_rows, replace=False))
+        else:
+            sel = np.arange(n)
+        train = np.empty((t_rows, dim), np.float32)
+        off = pos = 0
+        for c in chunk_list:
+            hit = sel[(sel >= off) & (sel < off + c.shape[0])] - off
+            train[pos:pos + hit.size] = c[hit]
+            pos += hit.size
+            off += c.shape[0]
+        with obs.timed("raft.build.streaming.train"):
+            centers = kmeans_balanced.build_hierarchical(
+                _fetch(train), params.n_lists, params.kmeans_n_iters,
+                kernel_precision=params.kmeans_kernel_precision,
+                res=res)
+        del train
+
+        # pass 1 over the stream: one fused label+norm dispatch per
+        # chunk, results landing host-side immediately (O(chunk) HBM)
+        labels_h, norms_h = [], []
+        with obs.timed("raft.build.streaming.label"):
+            label_fn = _label_chunk_fn()
+            for c in chunk_list:
+                lbl, nrm = label_fn(_fetch(c), centers)
+                labels_h.append(np.asarray(jax.device_get(lbl)))
+                norms_h.append(np.asarray(jax.device_get(nrm)))
+
+        counts = np.zeros(params.n_lists, np.int64)
+        for lbl in labels_h:
+            counts += np.bincount(lbl, minlength=params.n_lists)
+        max_list = max(8, int(-(-int(counts.max()) // 8) * 8))
+        lists_data = np.zeros((params.n_lists, max_list, dim),
+                              np.float32)
+        lists_idx = np.full((params.n_lists, max_list), -1, np.int32)
+        lists_norms = np.zeros((params.n_lists, max_list), np.float32)
+
+        # pass 2: host-side placement, chunk by chunk (no device work)
+        cursor = np.zeros(params.n_lists, np.int64)
+        base = 0
+        for c, lbl, nrm in zip(chunk_list, labels_h, norms_h):
+            _place_chunk(params.n_lists, cursor, c, lbl, base,
+                         lists_data, lists_idx, lists_norms, nrm)
+            base += c.shape[0]
+    return HostIvfFlat(centers=centers, lists_data=lists_data,
+                       lists_norms=lists_norms, lists_indices=lists_idx,
                        metric=params.metric, size=n, scale=1.0)
 
 

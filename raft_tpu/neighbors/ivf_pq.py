@@ -83,6 +83,13 @@ class IndexParams:
     # search, the ivf_bq pattern). The device never stores them; an
     # estimator-only index stays pq_dim+8 bytes/vector
     keep_raw: bool = False
+    # grouped-codebook-trainer balancing: codewords whose assignment
+    # count falls below reseed_threshold·(rows/n_codes) re-seed from
+    # the highest-cost rows each EM sweep (the adjust_centers role,
+    # reference ivf_pq_build.cuh:436 applied to train_per_subset).
+    # 0 disables reseeding; the default matches the coarse trainer's
+    # balance_threshold (was a hardcoded 0.25, ADVICE r5)
+    reseed_threshold: float = 0.25
 
 
 @dataclass
@@ -255,7 +262,7 @@ def _labels_and_prep(x, centers, rot):
 def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
                          pq_dim: int, pq_len: int, n_codes: int,
                          n_iters: int, chunk: int,
-                         precision=None):
+                         precision=None, reseed_threshold=0.25):
     """All pq_dim subspace codebooks trained in ONE compiled program —
     the balanced-EM semantics of the former per-subspace
     balanced_kmeans loop (assignment + masked mean + small-cluster
@@ -279,7 +286,10 @@ def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
     update einsums (static; ``None`` = the process-wide
     matmul_precision default) — ``IndexParams.kmeans_kernel_precision``
     reaches here via ``core.precision.xla_precision_for_kernel``.
-    Returns (pq_dim, n_codes, pq_len) codebooks."""
+    ``reseed_threshold`` (traced scalar — distinct values never
+    recompile) gates the small-codeword reseed:
+    ``IndexParams.reseed_threshold``. Returns (pq_dim, n_codes, pq_len)
+    codebooks."""
     if precision is None:
         precision = matmul_precision()
     m = cb_idx.shape[0]
@@ -331,7 +341,7 @@ def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
                                              (xs, vs, base))
         newc = sums / jnp.maximum(counts, 1.0)[:, :, None]
         newc = jnp.where(counts[:, :, None] > 0, newc, centers)
-        small = counts < 0.25 * avg
+        small = counts < reseed_threshold * avg
         slot = jnp.cumsum(small.astype(jnp.int32), axis=1) - 1
         seeds = jnp.take_along_axis(sub, wi[:, :, None], axis=1)
         reseed = jnp.take_along_axis(
@@ -343,7 +353,8 @@ def _train_books_grouped(residuals_rot, cb_idx, valid, init_idx,
 
 def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
                                   n_codes: int, n_iters: int, seed: int,
-                                  kernel_precision=None, cb_idx=None):
+                                  kernel_precision=None, cb_idx=None,
+                                  reseed_threshold: float = 0.25):
     """Per-subspace k-means over residual subvectors (reference
     train_per_subset, ivf_pq_build.cuh:464) — host glue around the
     single-program grouped trainer (_train_books_grouped).
@@ -374,7 +385,7 @@ def _train_codebooks_per_subspace(residuals_rot, pq_dim: int, pq_len: int,
     return _train_books_grouped(
         residuals_rot, jnp.asarray(pad_idx), jnp.asarray(valid),
         jnp.asarray(init_idx), pq_dim, pq_len, n_codes, n_iters, chunk,
-        precision=precision)
+        precision=precision, reseed_threshold=reseed_threshold)
 
 
 def _list_chunk(L: int, per_list_elems: int,
@@ -595,7 +606,8 @@ def build(dataset, params: IndexParams = IndexParams(), seed: int = 0,
     pq_centers = _train_codebooks_per_subspace(
         residuals_rot, pq_dim, pq_len, n_codes,
         params.kmeans_n_iters, seed + 2,
-        kernel_precision=params.kmeans_kernel_precision, cb_idx=cb_idx)
+        kernel_precision=params.kmeans_kernel_precision, cb_idx=cb_idx,
+        reseed_threshold=params.reseed_threshold)
 
     codes = _encode(residuals_rot, pq_centers)  # (n, pq_dim) u8
 

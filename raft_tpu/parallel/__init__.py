@@ -6,7 +6,8 @@ brute-force k-NN (sharded DB + ring top-k merge), MNMG k-means (sharded
 data + psum'd centroid statistics), and sharded IVF search.
 """
 
-from raft_tpu.parallel.mesh import make_mesh, shard_rows, replicate
+from raft_tpu.parallel.mesh import (make_mesh, shard_rows, replicate,
+                                    shard_map_compat)
 from raft_tpu.parallel.knn import distributed_knn
 from raft_tpu.parallel.kmeans import distributed_kmeans_fit, distributed_kmeans_step
 from raft_tpu.parallel.ivf import (
@@ -22,10 +23,13 @@ from raft_tpu.parallel.ivf import (
     distributed_ivf_pq_search_parts,
     distributed_ivf_bq_build,
     distributed_ivf_bq_search_parts,
+    sharded_ivf_flat_build,
+    sharded_ivf_pq_build,
+    sharded_ivf_bq_build,
 )
 
 __all__ = [
-    "make_mesh", "shard_rows", "replicate",
+    "make_mesh", "shard_rows", "replicate", "shard_map_compat",
     "distributed_knn",
     "distributed_kmeans_fit", "distributed_kmeans_step",
     "shard_ivf_flat", "shard_ivf_pq",
@@ -34,4 +38,6 @@ __all__ = [
     "distributed_ivf_flat_build", "distributed_ivf_flat_search_parts",
     "distributed_ivf_pq_build", "distributed_ivf_pq_search_parts",
     "distributed_ivf_bq_build", "distributed_ivf_bq_search_parts",
+    "sharded_ivf_flat_build", "sharded_ivf_pq_build",
+    "sharded_ivf_bq_build",
 ]

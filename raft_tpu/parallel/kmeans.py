@@ -102,8 +102,9 @@ def distributed_kmeans_fit(
         c, inertia, n_iter, _ = lax.while_loop(cond, body, state)
         return c, inertia, n_iter
 
-    shmapped = jax.jit(jax.shard_map(
-        local, mesh=mesh,
+    from raft_tpu.parallel.mesh import shard_map_compat
+    shmapped = jax.jit(shard_map_compat(
+        local, mesh,
         in_specs=(P(axis, None), P(axis), P()),
         out_specs=(P(), P(), P())))
     xs = jax.device_put(x, NamedSharding(mesh, P(axis, None)))

@@ -84,6 +84,13 @@ REQUIRED_NAMES = (
     "raft.ivf_scan.resolve_cap.syncs",
     "raft.ivf_scan.resolve_cap.cache_hits",
     "raft.ann.batched_search.sub_batches",
+    # sharded/streaming build instruments (ISSUE 4): per-family sharded
+    # build counters and the streaming ingestion counters — the
+    # sharded_build_s bench rows and the build dashboards key on these
+    "raft.build.sharded.total",
+    "raft.build.sharded.rows",
+    "raft.build.streaming.chunks",
+    "raft.build.streaming.rows",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -100,6 +107,10 @@ REQUIRED_SPAN_NAMES = (
     "raft.ann.sub_batch",
     "raft.parallel.ivf.shard",
     "raft.ivf_flat.search",
+    # build-scaling roots (ISSUE 4): the sharded list-layout builds and
+    # the streaming ingestion path each open one
+    "raft.build.sharded",
+    "raft.build.streaming",
 )
 
 

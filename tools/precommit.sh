@@ -36,6 +36,15 @@ with obs.span('raft.precommit.search', gate='precommit'):
 print(json.dumps(obs.to_chrome_trace(obs.RECORDER.requests(1)[0])))
 " | python tools/check_metric_names.py --trace - || fail=1
 
+# sharded-build parity first (fast, fails loud): the data-parallel
+# trainer and the list-layout sharded builds must keep matching the
+# single-device builds before anything ships (ISSUE 4 satellite). On a
+# jax too old for the virtual mesh the tests skip, not fail.
+echo "precommit: sharded-build + streaming parity tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_build.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 echo "precommit: tier-1 pytest (ROADMAP.md)"
 set -o pipefail
 rm -f /tmp/_t1.log
