@@ -277,6 +277,31 @@ def child_main():
         except Exception as e:
             out["sharded_build_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # serving-runtime row (ISSUE 5): closed-loop micro-batched QPS
+        # vs per-request plan.search, p50/p99 and mean batch occupancy
+        # — the artifact's evidence that batched serving beats
+        # per-request dispatch at identical recall with zero
+        # steady-state compiles
+        try:
+            rows = []
+            bench_suite.bench_serve(rows, n=n_ivf, nlists=nlists)
+            for r in rows:
+                if "serve_qps" in r:
+                    out["serve_qps"] = r["serve_qps"]
+                    out["serve_per_request_qps"] = r["per_request_qps"]
+                    out["serve_speedup_vs_per_request"] = \
+                        r.get("speedup_vs_per_request")
+                    out["serve_p50_ms"] = r["serve_p50_ms"]
+                    out["serve_p99_ms"] = r["serve_p99_ms"]
+                    out["serve_batch_occupancy"] = r["batch_occupancy"]
+                    out["serve_steady_state_compiles"] = \
+                        r["steady_state_compiles"]
+                    out["serve_recall"] = r.get("recall")
+                elif "error" in r:
+                    out.setdefault("serve_error", r["error"])
+        except Exception as e:
+            out["serve_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

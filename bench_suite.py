@@ -563,6 +563,153 @@ def bench_sharded_build(results, n=None, nlists=1024):
                             "error": repr(e)[:200]})
 
 
+def bench_serve(results, n=500_000, nlists=1024, n_probes=None):
+    """Closed-loop serving bench (ISSUE 5): the micro-batching runtime
+    (``raft_tpu.serve``) vs per-request ``plan.search`` at the same
+    flat operating point. Independent callers each submit ONE query at
+    a time; the batcher coalesces them into ladder shapes, so
+    ``serve_qps`` must beat ``per_request_qps`` (the acceptance floor
+    is 1.5x on the 500k TPU point) at identical recall, with ZERO plan
+    compilations in steady state (asserted via the ``raft.plan.cache``
+    counters and reported as ``steady_state_compiles``).
+
+    Knobs: ``BENCH_SERVE_CLIENTS`` (closed-loop caller threads, 16),
+    ``BENCH_SERVE_SECONDS`` (measure window, 2.0). An open-loop Poisson
+    row (``tools/loadgen.py``) rides along at ~70% of the measured
+    closed-loop rate — queue-delay/occupancy under an arrival process
+    instead of lockstep callers."""
+    import threading
+    import jax
+    from raft_tpu import obs, serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.neighbors import plan as _plan
+    if n_probes is None:
+        n_probes = FLAT_PROBES
+    n_probes = min(n_probes, nlists)
+    d, nq_pool, k = 128, 256, 32
+    db, q = _ann_dataset(n, d, nq_pool)
+    q_np = np.asarray(q)
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=nlists,
+                                                    kmeans_n_iters=10))
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 2.0))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 16))
+
+    # per-request baseline: each caller alone on the nq=1 plan — the
+    # chip at per-request batch size (what serving looked like before
+    # this subsystem)
+    p1 = _plan.warmup(index, q_np[:1], k, sp)
+    t0 = time.perf_counter()
+    done = 0
+    while time.perf_counter() - t0 < seconds / 2:
+        p1.search(q_np[done % nq_pool:done % nq_pool + 1], block=True)
+        done += 1
+    per_request_qps = done / (time.perf_counter() - t0)
+
+    cfg = serve.ServeConfig(batch_sizes=(1, 8, 32, 128), max_queue=512,
+                            max_wait_ms=2.0)
+    server = serve.SearchServer.from_index(index, q_np[:128], k,
+                                           params=sp, config=cfg)
+    try:
+        # recall on the sample set THROUGH the batcher (pad rows and
+        # scatter included), vs the per-request plan path
+        served_ids = np.concatenate(
+            [np.asarray(server.search(q_np[s:s + 1])[1])
+             for s in range(nq_pool)])
+        rec_serve = _ivf_recall(served_ids, db, q, k)
+        rec_plan = _ivf_recall(
+            np.concatenate([np.asarray(
+                p1.search(q_np[s:s + 1], block=True)[1])
+                for s in range(nq_pool)]), db, q, k)
+
+        # closed-loop measurement: `clients` caller threads, one query
+        # each, steady state (the warmup above compiled every shape)
+        before = obs.snapshot()
+        lats, counts = [], []
+        stop = time.perf_counter() + seconds
+        lock = threading.Lock()
+
+        def client(tid):
+            my_lats = []
+            i = tid
+            while time.perf_counter() < stop:
+                t1 = time.perf_counter()
+                server.search(q_np[i % nq_pool:i % nq_pool + 1])
+                my_lats.append(time.perf_counter() - t1)
+                i += clients
+            with lock:
+                lats.extend(my_lats)
+                counts.append(len(my_lats))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+        compiles = (cnt.get("raft.plan.cache.misses", 0.0)
+                    + cnt.get("raft.plan.build.total", 0.0))
+        slots = cnt.get("raft.serve.batch.slots", 0.0)
+        occupancy = (cnt.get("raft.serve.batch.rows", 0.0) / slots
+                     if slots else 0.0)
+        serve_qps = sum(counts) / wall
+        lats.sort()
+
+        def pct(p):
+            return lats[min(len(lats) - 1,
+                            int(p / 100 * (len(lats) - 1)))] * 1e3
+
+        results.append({
+            "metric": f"serve_closed_loop_{n//1000}kx{d}_q1_k{k}"
+                      f"_p{n_probes}_qps",
+            "value": round(serve_qps, 1), "unit": "queries/s",
+            "serve_qps": round(serve_qps, 1),
+            "per_request_qps": round(per_request_qps, 1),
+            "speedup_vs_per_request": round(
+                serve_qps / per_request_qps, 2) if per_request_qps
+            else None,
+            "serve_p50_ms": round(pct(50), 3),
+            "serve_p99_ms": round(pct(99), 3),
+            "batch_occupancy": round(occupancy, 4),
+            "steady_state_compiles": int(compiles),
+            "clients": clients,
+            "recall": round(rec_serve, 4),
+            "recall_per_request": round(rec_plan, 4)})
+
+        # open-loop row: Poisson arrivals at ~70% of the closed-loop
+        # rate (sub-saturation — queue delay, not collapse)
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "raft_loadgen",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "loadgen.py"))
+            loadgen = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(loadgen)
+            rep = loadgen.run_open_loop(
+                server, q_np, rate_qps=max(10.0, 0.7 * serve_qps),
+                duration_s=min(seconds, 2.0), nq=1, seed=0)
+            results.append({
+                "metric": f"serve_open_loop_{n//1000}kx{d}_q1_k{k}"
+                          f"_p{n_probes}_qps",
+                "value": rep["achieved_qps"], "unit": "queries/s",
+                "offered_qps": rep["offered_qps"],
+                "serve_p50_ms": rep["p50_ms"],
+                "serve_p99_ms": rep["p99_ms"],
+                "shed": rep["shed"],
+                "deadline_expired": rep["deadline_expired"]})
+        except Exception as e:
+            results.append({
+                "metric": f"serve_open_loop_{n//1000}kx{d}_q1_k{k}"
+                          f"_p{n_probes}_qps", "error": repr(e)[:200]})
+    finally:
+        server.close()
+
+
 def _big_enabled() -> bool:
     """Reference-scale shapes (cpp/bench/neighbors/knn.cuh:380-389:
     2M/10M×128, 10k×8192) — hours on the CPU mesh, so opt-in via
@@ -715,7 +862,7 @@ def bench_host_ivf(results):
 # the judge checks come first and the long-compile pairwise family last)
 _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_flat, bench_ivf_pq, bench_ivf_pq4,
-          bench_ivf_bq, bench_sharded_build,
+          bench_ivf_bq, bench_serve, bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,
           bench_ivf_flat_int8, bench_linalg_random, bench_ball_cover,

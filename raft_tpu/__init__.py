@@ -35,7 +35,8 @@ Handle = DeviceResources
 
 _SUBPACKAGES = (
     "cluster", "comms", "core", "distance", "label", "linalg", "matrix",
-    "neighbors", "obs", "ops", "parallel", "random", "solver", "sparse",
+    "neighbors", "obs", "ops", "parallel", "random", "serve", "solver",
+    "sparse",
     "spatial", "spectral", "stats", "util",
 )
 

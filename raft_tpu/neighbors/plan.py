@@ -28,14 +28,17 @@ A :class:`SearchPlan` is the serving-shape contract made explicit:
   terminal ``block_until_ready``; the dispatch-sync-dispatch loop of
   the cold path disappears.
 
-Plans are cached on the index (``index.plan_cache``; hits/misses under
-``raft.plan.cache.*``). The cold path — ``ivf_flat.search`` etc. — is
+Plans are cached on the index (``index.plan_cache``; hits/misses/
+evictions under ``raft.plan.cache.*``, LRU-bounded by
+``RAFT_TPU_PLAN_CACHE_MAX`` — the serving shape ladder churns shapes
+routinely). The cold path — ``ivf_flat.search`` etc. — is
 unchanged and remains the flexible/debug entry; see
 docs/performance.md for the serving guide.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Tuple
@@ -48,6 +51,18 @@ from raft_tpu.obs import spans
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
+
+
+def _plan_cache_max() -> int:
+    """LRU bound on ``index.plan_cache`` (``RAFT_TPU_PLAN_CACHE_MAX``,
+    default 64 plans; <= 0 disables the bound). Read per call so tests
+    and operators can move it at runtime. The serving shape ladder
+    (``raft_tpu.serve``) makes (nq, k, n_probes, cap) churn routine —
+    an unbounded cache would hold every executable ever compiled."""
+    try:
+        return int(os.environ.get("RAFT_TPU_PLAN_CACHE_MAX", "64"))
+    except ValueError:
+        return 64
 
 
 def _donate_ok() -> bool:
@@ -505,8 +520,11 @@ def build_plan(index, queries, k: int, params=None,
         bsp.set_attrs(cap=cap, n_probes=n_probes)
         fn, operands, host_epilogue, key_bits = make(nq, cap)
         key = (family, nq, index.dim, k, n_probes, cap, kind) + key_bits
-        cached = index.plan_cache.get(key)
+        cached = index.plan_cache.pop(key, None)
         if cached is not None:
+            # re-insert at the MRU end: the plain insertion-ordered dict
+            # doubles as the LRU order
+            index.plan_cache[key] = cached
             obs.counter("raft.plan.cache.hits").inc()
             bsp.set_attr("plan_cache", "hit")
             return cached
@@ -523,6 +541,11 @@ def build_plan(index, queries, k: int, params=None,
                           _operands=operands,
                           _host_epilogue=host_epilogue, _donate=donate)
         index.plan_cache[key] = plan
+        cache_max = _plan_cache_max()
+        if cache_max > 0:
+            while len(index.plan_cache) > cache_max:
+                index.plan_cache.pop(next(iter(index.plan_cache)))
+                obs.counter("raft.plan.cache.evictions").inc()
     if warm:
         plan.search(q, block=True)
     return plan

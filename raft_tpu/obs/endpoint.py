@@ -6,11 +6,17 @@ needs inspectable:
 
 * ``GET /metrics`` — the Prometheus exposition body
   (``obs.to_prometheus_text()``): point a scraper here.
-* ``GET /healthz`` — comms/health verdict from the
-  ``raft.comms.health.*`` gauges: 200 ``{"status": "ok"}`` while no
-  session reports suspect ranks, 503 ``{"status": "degraded", ...}``
-  the moment one does (suspect counts + worst heartbeat staleness per
-  session ride in the body).
+* ``GET /healthz`` — health verdict from the ``raft.comms.health.*``
+  gauges AND the ``raft.serve.*`` overload gauges: 200 ``{"status":
+  "ok"}`` while no session reports suspect ranks and the serving
+  runtime is not overloaded, 503 ``{"status": "degraded", ...}`` the
+  moment either plane trips (suspect counts, heartbeat staleness,
+  queue depth / shed rate / degrade level ride in the body).
+* ``POST /search`` — JSON search route backed by an attached
+  :class:`raft_tpu.serve.SearchServer` (``obs.serve(searcher=srv)``):
+  ``{"queries": [[...], ...], "k": 10}`` → ``{"distances", "ids"}``;
+  backpressure rejections return 429, expired deadlines 504
+  (docs/serving.md).
 * ``GET /debug/requests`` — the flight recorder
   (:mod:`raft_tpu.obs.recorder`): structured JSON of the last N
   request traces. Query params: ``n=<count>`` limits, ``slow=1``
@@ -47,8 +53,11 @@ __all__ = ["DebugServer", "serve"]
 
 
 def _health_body(snapshot: dict) -> dict:
-    """Health verdict from the comms/health gauges: any session with
-    ``raft.comms.health.suspects`` > 0 degrades the box."""
+    """Health verdict from TWO planes: the comms/health gauges (any
+    session with ``raft.comms.health.suspects`` > 0) AND the serving
+    overload gauges (``raft.serve.*`` — a single-host server drowning
+    in its own queue must stop reporting healthy, not only one whose
+    peers look suspect)."""
     gauges = snapshot.get("gauges", {})
     suspects = {}
     staleness = {}
@@ -57,12 +66,33 @@ def _health_body(snapshot: dict) -> dict:
             suspects[series] = value
         elif series.startswith("raft.comms.health.max_staleness_seconds"):
             staleness[series] = value
-    degraded = any(v > 0 for v in suspects.values())
-    return {
-        "status": "degraded" if degraded else "ok",
+    comms_degraded = any(v > 0 for v in suspects.values())
+
+    def _gsum(prefix: str) -> float:
+        return sum(v for k, v in gauges.items()
+                   if k == prefix or k.startswith(prefix + "{"))
+
+    overloaded = _gsum("raft.serve.overloaded")
+    depth = _gsum("raft.serve.queue.depth")
+    qmax = _gsum("raft.serve.queue.max")
+    shed_rate = _gsum("raft.serve.shed.rate")
+    serve_degraded = (overloaded > 0 or shed_rate > 0
+                      or (qmax > 0 and depth >= qmax))
+    body = {
+        "status": ("degraded" if (comms_degraded or serve_degraded)
+                   else "ok"),
         "suspects": suspects,
         "max_staleness_seconds": staleness,
     }
+    if any(k.startswith("raft.serve.") for k in gauges):
+        body["serve"] = {
+            "overloaded": overloaded,
+            "queue_depth": depth,
+            "queue_max": qmax,
+            "shed_rate_per_s": shed_rate,
+            "degrade_level": _gsum("raft.serve.degrade.level"),
+        }
+    return body
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -101,6 +131,58 @@ class _Handler(BaseHTTPRequestHandler):
                                                  "/debug/requests"]})
         except BrokenPipeError:
             pass
+
+    def do_POST(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        path = urlparse(self.path).path.rstrip("/") or "/"
+        try:
+            if path == "/search":
+                self._search()
+            else:
+                self._send_json(404, {"error": f"no POST route {path!r}",
+                                      "routes": ["/search"]})
+        except BrokenPipeError:
+            pass
+
+    def _search(self) -> None:
+        """``POST /search`` — JSON in, JSON out, backed by the attached
+        :class:`raft_tpu.serve.SearchServer` (``serve(searcher=...)``).
+        Body: ``{"queries": [[...], ...], "k": int?, "deadline_ms":
+        float?}``. Admission errors map to explicit status codes: 429
+        rejected (backpressure), 504 deadline expired."""
+        # lazy import: raft_tpu.serve imports raft_tpu.obs — importing
+        # it at module scope would cycle through obs/__init__
+        from raft_tpu.serve.types import DeadlineExceeded, RejectedError
+        srv = getattr(self.server, "searcher", None)
+        if srv is None:
+            self._send_json(404, {"error": "no searcher attached "
+                                           "(obs.serve(searcher=...))"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            queries = body["queries"]
+            k = body.get("k")
+            deadline_ms = body.get("deadline_ms")
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request body: {e!r}"})
+            return
+        from raft_tpu.obs import spans as _spans
+        try:
+            with _spans.span("raft.serve.http", route="/search"):
+                d, i = srv.search(queries, k=k, deadline_ms=deadline_ms)
+        except RejectedError as e:
+            self._send_json(429, {"error": "rejected", "detail": str(e)})
+            return
+        except DeadlineExceeded as e:
+            self._send_json(504, {"error": "deadline", "detail": str(e)})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": type(e).__name__,
+                                  "detail": str(e)[:500]})
+            return
+        self._send_json(200, {"distances": d.tolist(), "ids": i.tolist(),
+                              "nq": len(i), "k": len(i[0]) if len(i)
+                              else 0})
 
     def _debug_requests(self, q: dict) -> None:
         rec = self.server.recorder
@@ -150,12 +232,15 @@ class DebugServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
-    def __init__(self, addr, recorder=None, registry=None):
+    def __init__(self, addr, recorder=None, registry=None,
+                 searcher=None):
         super().__init__(addr, _Handler)
         self.recorder = recorder if recorder is not None \
             else _recorder.RECORDER
         self.registry = registry if registry is not None \
             else _registry.REGISTRY
+        # optional raft_tpu.serve.SearchServer backing POST /search
+        self.searcher = searcher
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -190,9 +275,11 @@ class DebugServer(ThreadingHTTPServer):
 
 
 def serve(host: str = "127.0.0.1", port: int = 0, recorder=None,
-          registry=None) -> DebugServer:
+          registry=None, searcher=None) -> DebugServer:
     """Start the debug endpoint in a daemon thread → running
     :class:`DebugServer` (``.url``, ``.port``, ``.close()``).
-    ``port=0`` binds an ephemeral port (tests, side-by-side procs)."""
+    ``port=0`` binds an ephemeral port (tests, side-by-side procs).
+    ``searcher`` (a :class:`raft_tpu.serve.SearchServer`) enables the
+    ``POST /search`` JSON route."""
     return DebugServer((host, port), recorder=recorder,
-                       registry=registry).start()
+                       registry=registry, searcher=searcher).start()

@@ -1,0 +1,61 @@
+"""raft_tpu.serve — dynamic micro-batching serving runtime.
+
+The first subsystem that makes ``raft_tpu`` a *service* rather than a
+library: independent callers submit search requests; a bounded queue +
+dispatcher thread coalesces them into the largest admissible compiled
+shape from a pre-warmed :class:`~raft_tpu.serve.ladder.PlanLadder`
+(``neighbors/plan.py`` AOT executables), pads ragged tails with
+duplicated real rows, executes ONE plan per batch, and scatters
+per-request slices back to caller futures — the chip runs at saturated
+batch sizes however small the individual requests are.
+
+Robustness contract (docs/serving.md):
+
+* bounded queue → over-depth submissions fail NOW with
+  :class:`RejectedError` (explicit backpressure);
+* per-request deadlines → expired requests complete with
+  :class:`DeadlineExceeded` instead of occupying batch slots;
+* graceful degradation → above a queue-delay watermark, ``n_probes``
+  steps down a configured ladder (p99 bounded at slightly reduced
+  recall) and steps back up when the queue drains.
+
+Quick use::
+
+    from raft_tpu import serve
+    from raft_tpu.neighbors import ivf_flat
+
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
+    srv = serve.SearchServer.from_index(
+        index, sample_queries, k=32,
+        params=ivf_flat.SearchParams(n_probes=96),
+        config=serve.ServeConfig(batch_sizes=(1, 8, 32, 128),
+                                 probes_ladder=(96, 48, 24),
+                                 default_deadline_ms=500.0))
+    fut = srv.submit(queries, k=10)          # -> concurrent Future
+    dists, ids = srv.search(queries, k=10)   # blocking convenience
+    srv.close()
+
+HTTP serving: pass the server to the obs debug endpoint and `POST
+/search` is live (``obs.serve(searcher=srv)``); ``/healthz`` folds the
+``raft.serve.*`` overload gauges into its verdict. Load-test with
+``tools/loadgen.py``; capacity-plan from the ``raft.serve.*`` metrics
+(docs/serving.md walkthrough).
+"""
+
+from raft_tpu.serve.batcher import (OCCUPANCY_BUCKETS,
+                                    SERVE_LATENCY_BUCKETS, SearchServer)
+from raft_tpu.serve.controller import LoadController
+from raft_tpu.serve.ladder import PlanLadder
+from raft_tpu.serve.types import (DeadlineExceeded, RejectedError,
+                                  ServeConfig)
+
+__all__ = [
+    "DeadlineExceeded",
+    "LoadController",
+    "OCCUPANCY_BUCKETS",
+    "PlanLadder",
+    "RejectedError",
+    "SERVE_LATENCY_BUCKETS",
+    "SearchServer",
+    "ServeConfig",
+]

@@ -1,0 +1,372 @@
+"""Dynamic micro-batcher: independent requests → saturated plan shapes.
+
+The serving gap this closes: every caller invoking ``plan.search``
+alone runs the chip at per-request batch sizes — nq=1 dispatches on
+hardware whose fixed per-dispatch cost was measured at ~9 ms
+(docs/performance.md). The batcher is the standard TPU-runtime answer
+(TPU-KNN, arxiv 2206.14286; continuous batching a la Ragged Paged
+Attention, arxiv 2604.15464): a bounded queue, one dispatcher thread
+that coalesces whatever is waiting into the largest admissible compiled
+shape from the pre-warmed :class:`~raft_tpu.serve.ladder.PlanLadder`,
+pads the ragged tail with duplicated REAL rows from the same batch
+(pad results discarded — a pad row's neighbors can never leak into
+another caller's results), executes the plan, and scatters per-request
+slices back to caller futures.
+
+Robustness is part of the contract, not an afterthought:
+
+* **backpressure** — the queue is bounded (``ServeConfig.max_queue``);
+  a submission over it fails NOW with :class:`RejectedError`.
+* **deadlines** — an expired request completes with
+  :class:`DeadlineExceeded` and never occupies a batch slot.
+* **graceful degradation** — the :class:`LoadController` steps
+  ``n_probes`` down the configured ladder above the queue-delay
+  watermark and back up when drained (p99 bounded at slightly reduced
+  recall instead of unbounded latency).
+
+Every decision lands in ``raft.serve.*`` metrics and spans
+(docs/serving.md has the taxonomy and a capacity-planning walkthrough).
+
+Threading model: ONE dispatcher thread owns all device work, so the
+underlying jax dispatch is never called concurrently; caller threads
+only touch numpy and futures. Future callbacks run on the dispatcher
+thread — keep them trivial.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.obs import spans
+from raft_tpu.serve.controller import LoadController
+from raft_tpu.serve.ladder import PlanLadder
+from raft_tpu.serve.types import (DeadlineExceeded, RejectedError,
+                                  ServeConfig, _Request)
+
+__all__ = ["SearchServer", "SERVE_LATENCY_BUCKETS", "OCCUPANCY_BUCKETS"]
+
+# serving latency needs finer edges than the registry default around the
+# tens-of-ms watermark region (p99-under-watermark is asserted from
+# these buckets in tests/test_serve.py)
+SERVE_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2,
+    0.25, 0.3, 0.5, 1.0, 2.5, 5.0, 10.0)
+OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                     0.875, 1.0)
+
+_SHED_RATE_WINDOW_S = 10.0
+
+
+class SearchServer:
+    """The serving runtime over one index: ``submit() -> Future`` plus
+    a blocking ``search()`` convenience. Construct via
+    :meth:`from_index` (real plans) or directly from a
+    :class:`PlanLadder` (tests inject fakes)."""
+
+    def __init__(self, ladder: PlanLadder,
+                 config: Optional[ServeConfig] = None,
+                 start: bool = True):
+        self._ladder = ladder
+        self._cfg = config if config is not None else ServeConfig()
+        self._controller = LoadController(len(ladder.rungs), self._cfg)
+        self._q: deque = deque()
+        self._rows_queued = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._shed_times: deque = deque()
+        obs.gauge("raft.serve.queue.max").set(self._cfg.max_queue)
+        obs.gauge("raft.serve.queue.depth").set(0)
+        obs.gauge("raft.serve.shed.rate").set(0.0)
+        if start:
+            self.start()
+
+    @classmethod
+    def from_index(cls, index, rep_queries, k: int, params=None,
+                   config: Optional[ServeConfig] = None,
+                   start: bool = True) -> "SearchServer":
+        """Build + pre-warm the (shape × rung) plan ladder for
+        ``index`` and start serving. ``rep_queries`` is the
+        representative cap-measurement sample (same contract as
+        ``plan.build_plan``)."""
+        config = config if config is not None else ServeConfig()
+        ladder = PlanLadder.build(index, rep_queries, k, params,
+                                  shapes=config.batch_sizes,
+                                  probes_ladder=config.probes_ladder,
+                                  prewarm=config.prewarm)
+        return cls(ladder, config, start=start)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "SearchServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="raft-serve-batcher")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop admitting, fail everything still queued with
+        :class:`RejectedError`, and join the dispatcher."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        # a never-started server still owes its queue explicit errors
+        self._drain_closed()
+
+    def __enter__(self) -> "SearchServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def ladder(self) -> PlanLadder:
+        return self._ladder
+
+    @property
+    def config(self) -> ServeConfig:
+        return self._cfg
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None):
+        """Enqueue one request → ``Future`` resolving to ``(dists,
+        ids)``, each ``(nq, k)`` numpy arrays. Admission is decided NOW:
+        a full queue or a closed server fails the future immediately
+        with :class:`RejectedError` (explicit backpressure, never
+        unbounded growth)."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        expects(q.ndim == 2 and q.shape[1] == self._ladder.dim,
+                "serve.submit: queries must be (nq, dim=%d), got %s",
+                self._ladder.dim, q.shape)
+        nq = int(q.shape[0])
+        expects(0 < nq <= self._ladder.max_shape,
+                "serve.submit: nq=%d exceeds the largest ladder shape "
+                "%d — split the request or widen the ladder", nq,
+                self._ladder.max_shape)
+        k = self._ladder.k if k is None else int(k)
+        expects(0 < k <= self._ladder.k,
+                "serve.submit: k=%d exceeds the plan k=%d", k,
+                self._ladder.k)
+        if deadline_ms is None:
+            deadline_ms = self._cfg.default_deadline_ms
+        now = time.perf_counter()
+        req = _Request(queries=q, nq=nq, k=k, t_enq=now,
+                       deadline=(now + deadline_ms / 1e3
+                                 if deadline_ms and deadline_ms > 0
+                                 else None))
+        obs.counter("raft.serve.requests.total").inc()
+        obs.counter("raft.serve.queries.total").inc(nq)
+        with self._cond:
+            if self._closed:
+                self._shed(req, "closed")
+                return req.future
+            if len(self._q) >= self._cfg.max_queue:
+                self._shed(req, "queue_full")
+                return req.future
+            self._q.append(req)
+            self._rows_queued += nq
+            obs.gauge("raft.serve.queue.depth").set(len(self._q))
+            self._cond.notify()
+        return req.future
+
+    def search(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(queries, k, deadline_ms).result(timeout)
+
+    # -- internals ---------------------------------------------------------
+    def _shed(self, req: _Request, reason: str) -> None:
+        """Refuse admission (called under the queue lock). Counted AND
+        span-attributed — the shed decision must be visible in both
+        observability planes."""
+        obs.counter("raft.serve.shed.total", reason=reason).inc()
+        self._shed_times.append(time.monotonic())
+        self._update_shed_rate_locked()
+        with spans.span("raft.serve.request", nq=req.nq, k=req.k,
+                        outcome="shed", reason=reason):
+            pass
+        req.future.set_exception(RejectedError(
+            f"request rejected ({reason}): queue depth "
+            f"{len(self._q)}/{self._cfg.max_queue}"))
+
+    def _update_shed_rate_locked(self) -> None:
+        now = time.monotonic()
+        while self._shed_times and now - self._shed_times[0] > \
+                _SHED_RATE_WINDOW_S:
+            self._shed_times.popleft()
+        obs.gauge("raft.serve.shed.rate").set(
+            len(self._shed_times) / _SHED_RATE_WINDOW_S)
+
+    def _drain_closed(self) -> None:
+        with self._cond:
+            pending = list(self._q)
+            self._q.clear()
+            self._rows_queued = 0
+            obs.gauge("raft.serve.queue.depth").set(0)
+        for r in pending:
+            if not r.future.done():
+                obs.counter("raft.serve.shed.total", reason="closed").inc()
+                r.future.set_exception(
+                    RejectedError("server closed while queued"))
+
+    def _fail_deadline(self, req: _Request, now: float) -> None:
+        waited_ms = round((now - req.t_enq) * 1e3, 3)
+        obs.counter("raft.serve.deadline.total").inc()
+        with spans.span("raft.serve.request", nq=req.nq, k=req.k,
+                        outcome="deadline", waited_ms=waited_ms):
+            spans.add_child_span("raft.serve.queue_wait", req.t_enq,
+                                 now - req.t_enq)
+        req.future.set_exception(DeadlineExceeded(
+            f"deadline expired after {waited_ms} ms in queue"))
+
+    def _take_batch_locked(self):
+        """Pop whole requests up to the largest shape, dropping expired
+        ones without letting them occupy a slot."""
+        now = time.perf_counter()
+        max_shape = self._ladder.max_shape
+        batch, rows, expired = [], 0, []
+        while self._q:
+            r = self._q[0]
+            if r.deadline is not None and now >= r.deadline:
+                self._q.popleft()
+                self._rows_queued -= r.nq
+                expired.append(r)
+                continue
+            if batch and rows + r.nq > max_shape:
+                break
+            self._q.popleft()
+            self._rows_queued -= r.nq
+            batch.append(r)
+            rows += r.nq
+        depth = len(self._q)
+        obs.gauge("raft.serve.queue.depth").set(depth)
+        return batch, rows, expired, depth, now
+
+    def _loop(self) -> None:
+        cfg = self._cfg
+        idle_s = max(cfg.degrade_cooldown_ms / 1e3, 0.02)
+        wait_s = cfg.max_wait_ms / 1e3
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    if not self._cond.wait(timeout=idle_s):
+                        # idle tick: the ladder steps back toward full
+                        # quality, the overload verdict clears, the
+                        # shed-rate window decays
+                        self._controller.observe(0.0, 0)
+                        self._update_shed_rate_locked()
+                if self._closed:
+                    break
+                # batching window: let the head-of-line request wait up
+                # to max_wait_ms for a fuller batch (or until the
+                # largest shape is already covered)
+                head_t = self._q[0].t_enq
+                while (self._rows_queued < self._ladder.max_shape
+                       and not self._closed and self._q):
+                    remaining = wait_s - (time.perf_counter() - head_t)
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                if self._closed:
+                    break
+                batch, rows, expired, depth, now = \
+                    self._take_batch_locked()
+            for r in expired:
+                self._fail_deadline(r, now)
+            if batch:
+                self._execute(batch, rows, depth)
+        self._drain_closed()
+
+    def _execute(self, batch, rows: int, depth: int) -> None:
+        t_start = time.perf_counter()
+        head_wait = t_start - min(r.t_enq for r in batch)
+        level = self._controller.observe(head_wait, depth)
+        shape, plan = self._ladder.plan_for(rows, level)
+        qb = (batch[0].queries if len(batch) == 1
+              else np.concatenate([r.queries for r in batch], axis=0))
+        pad = shape - rows
+        if pad:
+            # duplicated-REAL-row padding (the pad_partial rule of
+            # ann_types.batched_search): repeated real rows stay
+            # in-distribution for the measured probe cap; their result
+            # rows are sliced off before scatter
+            obs.counter("raft.serve.batch.padded_rows").inc(pad)
+            reps = -(-pad // rows)
+            qb = np.concatenate([qb, np.tile(qb, (reps, 1))[:pad]],
+                                axis=0)
+        err = None
+        with spans.span("raft.serve.batch", shape=shape, rows=rows,
+                        requests=len(batch),
+                        occupancy=round(rows / shape, 4),
+                        n_probes=plan.n_probes, level=level) as bsp:
+            for idx, r in enumerate(batch):
+                spans.add_child_span("raft.serve.queue_wait", r.t_enq,
+                                     t_start - r.t_enq, request=idx,
+                                     rows=r.nq)
+            with spans.span("raft.serve.execute", shape=shape,
+                            n_probes=plan.n_probes):
+                try:
+                    d, i = plan.search(qb, block=True)
+                    d, i = np.asarray(d), np.asarray(i)
+                except Exception as e:     # scatter the failure, keep serving
+                    err = e
+                    bsp.set_attr("error", type(e).__name__)
+        t_done = time.perf_counter()
+        exec_dur = t_done - t_start
+        obs.counter("raft.serve.batch.total", level=level).inc()
+        obs.counter("raft.serve.batch.rows").inc(rows)
+        obs.counter("raft.serve.batch.slots").inc(shape)
+        obs.histogram("raft.serve.batch.size",
+                      buckets=obs.SIZE_BUCKETS).observe(rows)
+        obs.histogram("raft.serve.batch.occupancy",
+                      buckets=OCCUPANCY_BUCKETS).observe(rows / shape)
+        off = 0
+        for r in batch:
+            wait_s = t_start - r.t_enq
+            obs.histogram("raft.serve.queue.delay.seconds",
+                          buckets=SERVE_LATENCY_BUCKETS).observe(wait_s)
+            if err is not None:
+                obs.counter("raft.serve.errors.total").inc()
+                r.future.set_exception(err)
+                continue
+            d_r = d[off:off + r.nq, :r.k].copy()
+            i_r = i[off:off + r.nq, :r.k].copy()
+            off += r.nq
+            lat = t_done - r.t_enq
+            obs.histogram("raft.serve.request.seconds",
+                          buckets=SERVE_LATENCY_BUCKETS).observe(lat)
+            obs.counter("raft.serve.completed.total").inc()
+            # per-request root trace: queue-wait + (shared) execution
+            # children under one raft.serve.request root — the flight
+            # recorder shows each caller's story, batch sharing included
+            with spans.span("raft.serve.request", nq=r.nq, k=r.k,
+                            outcome="ok", level=level,
+                            batch_shape=shape,
+                            latency_ms=round(lat * 1e3, 3)):
+                spans.add_child_span("raft.serve.queue_wait", r.t_enq,
+                                     wait_s)
+                spans.add_child_span("raft.serve.execute", t_start,
+                                     exec_dur, shape=shape,
+                                     shared=len(batch) > 1)
+            r.future.set_result((d_r, i_r))

@@ -1,0 +1,99 @@
+"""The plan-shape ladder: pre-warmed fixed-shape SearchPlans.
+
+A serving batch must execute at one of a few compiled shapes — the
+continuous-batching contract of every TPU inference runtime (Ragged
+Paged Attention, arxiv 2604.15464, makes the same move for attention):
+a small ladder of nq values covers any occupancy with bounded padding
+waste, and every rung is AOT-compiled (``neighbors/plan.py``) before
+traffic arrives, so steady-state serving performs ZERO compiles.
+
+The ladder is two-dimensional: ``shapes`` (batch nq, ascending) ×
+``rungs`` (``n_probes`` per degradation level, descending — rung 0 is
+full quality). The load controller picks the rung; the batcher picks
+the smallest shape that fits the coalesced rows.
+
+The ladder holds DIRECT references to its plans: the LRU bound on
+``index.plan_cache`` (``RAFT_TPU_PLAN_CACHE_MAX``) can evict the cache
+entries without invalidating a running server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+__all__ = ["PlanLadder"]
+
+
+class PlanLadder:
+    """(shape, rung) → a plan-like object with ``.search(q, block=)``,
+    ``.nq`` and ``.n_probes``. Build real ladders via :meth:`build`;
+    tests may construct one directly from fake plans."""
+
+    def __init__(self, shapes: Tuple[int, ...], rungs: Tuple[int, ...],
+                 plans: Dict[Tuple[int, int], object], dim: int, k: int):
+        expects(len(shapes) > 0 and len(rungs) > 0,
+                "PlanLadder: need at least one shape and one rung")
+        expects(list(shapes) == sorted(set(shapes)),
+                "PlanLadder: shapes must be ascending and distinct")
+        for s in shapes:
+            for r in range(len(rungs)):
+                expects((s, r) in plans,
+                        "PlanLadder: missing plan for shape=%d rung=%d",
+                        s, r)
+        self.shapes = tuple(int(s) for s in shapes)
+        self.rungs = tuple(int(r) for r in rungs)
+        self.dim = int(dim)
+        self.k = int(k)
+        self._plans = dict(plans)
+
+    @property
+    def max_shape(self) -> int:
+        return self.shapes[-1]
+
+    def plan_for(self, rows: int, rung: int):
+        """The smallest-shape plan that fits ``rows`` at ``rung`` →
+        ``(shape, plan)``."""
+        expects(0 < rows <= self.max_shape,
+                "PlanLadder: %d rows exceed the largest shape %d",
+                rows, self.max_shape)
+        rung = min(max(rung, 0), len(self.rungs) - 1)
+        for s in self.shapes:
+            if rows <= s:
+                return s, self._plans[(s, rung)]
+        raise AssertionError("unreachable")  # guarded by expects above
+
+    @classmethod
+    def build(cls, index, rep_queries, k: int, params=None,
+              shapes: Tuple[int, ...] = (1, 8, 32, 128),
+              probes_ladder: Tuple[int, ...] = (),
+              prewarm: bool = True) -> "PlanLadder":
+        """AOT-compile the full (shape × rung) grid from one
+        representative query batch (the cap-measurement sample —
+        docs/performance.md). ``probes_ladder`` empty means a single
+        rung at ``params.n_probes``."""
+        from raft_tpu.neighbors import plan as plan_mod
+
+        family, _ = plan_mod._resolve_builder(index)
+        if params is None:
+            params = plan_mod._default_params(family)
+        q = np.asarray(rep_queries, np.float32)
+        expects(q.ndim == 2 and q.shape[1] == index.dim,
+                "PlanLadder: rep_queries must be (nq, dim=%d), got %s",
+                index.dim, q.shape)
+        rungs = tuple(probes_ladder) or (min(params.n_probes,
+                                             index.n_lists),)
+        plans: Dict[Tuple[int, int], object] = {}
+        for ri, n_probes in enumerate(rungs):
+            p_r = dataclasses.replace(params, n_probes=n_probes)
+            for s in shapes:
+                reps = -(-s // q.shape[0])
+                q_s = np.tile(q, (reps, 1))[:s]
+                plans[(s, ri)] = plan_mod.build_plan(index, q_s, k, p_r,
+                                                     warm=prewarm)
+        return cls(shapes=tuple(shapes), rungs=rungs, plans=plans,
+                   dim=index.dim, k=k)

@@ -1,0 +1,105 @@
+"""Serving-runtime types: config, request record, typed errors.
+
+Kept dependency-free (stdlib + numpy only, no jax / no obs import) so
+the error types can be imported anywhere — including by
+``raft_tpu.obs.endpoint``'s ``POST /search`` route — without circular
+imports through the serving stack.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+__all__ = [
+    "DeadlineExceeded",
+    "RejectedError",
+    "ServeConfig",
+]
+
+
+class RejectedError(RuntimeError):
+    """The request was refused admission (queue full, or the server is
+    closed) — backpressure made explicit. The caller sees this the
+    moment it submits; nothing was enqueued and nothing will run."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline expired while it waited in the queue; it
+    was dropped without occupying a batch slot."""
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Operating contract of a :class:`~raft_tpu.serve.SearchServer`.
+
+    * ``batch_sizes`` — the plan-shape ladder (ascending nq). Every
+      batch executes at one of these compiled shapes; a ragged tail is
+      padded with duplicated real rows from the same batch (results
+      discarded), so steady-state serving never compiles.
+    * ``max_queue`` — bounded queue depth (requests). Submissions over
+      it fail immediately with :class:`RejectedError` — the queue can
+      never grow without bound.
+    * ``max_wait_ms`` — batching window: how long the head-of-line
+      request may wait for a fuller batch before dispatch. The latency
+      floor a lone request pays to give coalescing a chance.
+    * ``default_deadline_ms`` — per-request deadline applied when
+      ``submit`` doesn't pass one; ``0`` = no deadline. Expired
+      requests complete with :class:`DeadlineExceeded` instead of
+      occupying a batch slot.
+    * ``probes_ladder`` — graceful-degradation rungs: descending
+      ``n_probes`` values, rung 0 = full quality. Empty = no
+      degradation (the search params' ``n_probes`` is the only rung).
+    * ``degrade_watermark_ms`` — the queue-delay objective (the p99
+      budget). The load controller steps the ladder DOWN when
+      head-of-line queue delay crosses ``degrade_trigger_frac`` of it
+      (acting with headroom keeps p99 *under* the watermark), and back
+      UP when delay falls below ``upgrade_watermark_ms``.
+    * ``degrade_cooldown_ms`` — minimum spacing between ladder steps
+      (both directions) so one slow batch doesn't slam the ladder to
+      the floor.
+    * ``prewarm`` — compile + run every (shape × rung) plan at server
+      construction; with it off, rungs compile on first use (a compile
+      stall exactly when the server is overloaded — leave it on).
+    """
+
+    batch_sizes: Tuple[int, ...] = (1, 8, 32, 128)
+    max_queue: int = 256
+    max_wait_ms: float = 2.0
+    default_deadline_ms: float = 0.0
+    probes_ladder: Tuple[int, ...] = ()
+    degrade_watermark_ms: float = 200.0
+    degrade_trigger_frac: float = 0.5
+    upgrade_watermark_ms: float = 20.0
+    degrade_cooldown_ms: float = 50.0
+    prewarm: bool = True
+
+    def __post_init__(self):
+        if not self.batch_sizes or list(self.batch_sizes) != sorted(
+                set(int(s) for s in self.batch_sizes)):
+            raise ValueError("ServeConfig.batch_sizes must be distinct "
+                             "ascending positive ints")
+        if min(self.batch_sizes) < 1:
+            raise ValueError("ServeConfig.batch_sizes entries must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError("ServeConfig.max_queue must be >= 1")
+        if self.probes_ladder and list(self.probes_ladder) != sorted(
+                set(self.probes_ladder), reverse=True):
+            raise ValueError("ServeConfig.probes_ladder must be strictly "
+                             "descending n_probes values (rung 0 first)")
+        if not 0.0 < self.degrade_trigger_frac <= 1.0:
+            raise ValueError("ServeConfig.degrade_trigger_frac must be "
+                             "in (0, 1]")
+
+
+@dataclass
+class _Request:
+    """One queued search request (internal)."""
+
+    queries: object             # np.ndarray (nq, dim) float32
+    nq: int
+    k: int
+    future: Future = field(default_factory=Future)
+    t_enq: float = 0.0          # perf_counter at admission
+    deadline: Optional[float] = None   # absolute perf_counter, or None

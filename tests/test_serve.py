@@ -1,0 +1,524 @@
+"""Serving-runtime tests (ISSUE 5): micro-batching correctness (mixed
+nq coalescing + duplicated-real-row padding must never leak a pad
+row's neighbors into another caller's results — ids checked against
+per-request brute force), admission control (bounded queue with
+explicit rejection, deadlines that never occupy batch slots), the
+overload story (ladder steps down under 2x-sustainable arrivals, p99
+of accepted requests stays under the watermark, ladder steps back up
+on drain — all asserted from ``raft.serve.*`` metrics), zero compiles
+in steady state, the plan-cache LRU bound, and the endpoint
+integration (overload-aware ``/healthz``, ``POST /search``)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import plan as plan_mod
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors.brute_force import brute_force_knn
+from raft_tpu.random import make_blobs
+from raft_tpu.serve import (DeadlineExceeded, PlanLadder, RejectedError,
+                            SearchServer, ServeConfig)
+
+
+def _csum(snap, name):
+    """Sum a counter family across its labeled series."""
+    return sum(v for k, v in snap["counters"].items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _cdiff(before, after, name):
+    return _csum(after, name) - _csum(before, name)
+
+
+def _gauge(name):
+    return obs.snapshot()["gauges"].get(name, 0.0)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    x, _ = make_blobs(n_samples=4000, n_features=32, centers=20,
+                      cluster_std=2.0, seed=0)
+    q, _ = make_blobs(n_samples=64, n_features=32, centers=20,
+                      cluster_std=2.0, seed=1)
+    return np.asarray(x), np.asarray(q)
+
+
+@pytest.fixture(scope="module")
+def flat_index(dataset):
+    x, _ = dataset
+    return ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16,
+                                                  kmeans_n_iters=4))
+
+
+# probing every list makes IVF exact, so served ids must match the
+# per-request brute-force ground truth row for row — any pad-row
+# leakage or scatter off-by-one shows up as a wrong id set
+_EXACT = ivf_flat.SearchParams(n_probes=16)
+
+
+class _FakePlan:
+    """Deterministic stand-in for a SearchPlan: sleeps a configured
+    per-batch service time, returns each input row's marker (its first
+    feature) as every result id — so tests can prove exactly which
+    rows were executed and that scatter routes rows to the right
+    caller."""
+
+    def __init__(self, nq, n_probes, delay_s, k=4, calls=None):
+        self.nq = nq
+        self.n_probes = n_probes
+        self.delay_s = delay_s
+        self.k = k
+        self.calls = calls if calls is not None else []
+
+    def search(self, q, block=True):
+        self.calls.append(np.asarray(q).copy())
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        marker = np.asarray(q)[:, :1]
+        d = np.repeat(marker.astype(np.float32), self.k, axis=1)
+        i = np.repeat(marker.astype(np.int64), self.k, axis=1)
+        return d, i
+
+
+def _fake_ladder(shapes=(1, 4, 16), rung_delays=(0.0,), dim=4, k=4,
+                 calls=None):
+    """rung_delays[r] = per-batch service time at rung r (a descending
+    n_probes ladder is faster at higher rungs)."""
+    calls = calls if calls is not None else []
+    rungs = tuple(8 // (1 << r) for r in range(len(rung_delays)))
+    plans = {(s, r): _FakePlan(s, rungs[r], rung_delays[r], k=k,
+                               calls=calls)
+             for s in shapes for r in range(len(rung_delays))}
+    return PlanLadder(shapes=shapes, rungs=rungs, plans=plans, dim=dim,
+                      k=k), calls
+
+
+def _rows(n, dim=4, base=0):
+    """n single-query rows whose marker (first feature) is unique."""
+    out = np.zeros((n, dim), np.float32)
+    out[:, 0] = np.arange(base, base + n, dtype=np.float32)
+    return out
+
+
+class TestCorrectness:
+    def test_mixed_nq_matches_per_request_brute_force(self, dataset,
+                                                      flat_index):
+        """Coalesced mixed-nq requests, ragged tails padded with
+        duplicated real rows, results scattered back: every caller's
+        ids equal its own per-request brute-force neighbors."""
+        x, q = dataset
+        k = 8
+        cfg = ServeConfig(batch_sizes=(1, 4, 16, 32), max_queue=128,
+                          max_wait_ms=4.0)
+        srv = SearchServer.from_index(flat_index, q[:32], k,
+                                      params=_EXACT, config=cfg)
+        try:
+            # same metric as the index (its default L2Expanded —
+            # squared distances)
+            d_bf, i_bf = brute_force_knn(x, q, k,
+                                         metric=DistanceType.L2Expanded,
+                                         mode="exact")
+            d_bf, i_bf = np.asarray(d_bf), np.asarray(i_bf)
+            sizes = [1, 3, 5, 8, 2, 7, 4, 6, 1, 9, 2, 16]  # sums to 64
+            futs, off = [], 0
+            for m in sizes:
+                futs.append((off, m, srv.submit(q[off:off + m], k=k)))
+                off += m
+            assert off == len(q)
+            for off, m, f in futs:
+                d, i = f.result(timeout=120)
+                assert d.shape == (m, k) and i.shape == (m, k)
+                for r in range(m):
+                    assert set(i[r].tolist()) == \
+                        set(i_bf[off + r].tolist()), \
+                        f"row {off + r}: pad/scatter leak"
+                np.testing.assert_allclose(d, d_bf[off:off + m],
+                                           rtol=1e-4, atol=1e-4)
+        finally:
+            srv.close()
+
+    def test_threaded_callers_and_k_slicing(self, dataset, flat_index):
+        """Concurrent blocking callers with per-request k below the
+        plan k get correctly sliced results."""
+        x, q = dataset
+        cfg = ServeConfig(batch_sizes=(1, 4, 16), max_wait_ms=2.0)
+        srv = SearchServer.from_index(flat_index, q[:16], 8,
+                                      params=_EXACT, config=cfg)
+        _, i_bf = brute_force_knn(x, q, 3, mode="exact")
+        i_bf = np.asarray(i_bf)
+        errs = []
+
+        def caller(s):
+            try:
+                d, i = srv.search(q[s:s + 2], k=3, timeout=120)
+                assert d.shape == (2, 3)
+                for r in range(2):
+                    assert set(i[r].tolist()) == \
+                        set(i_bf[s + r].tolist())
+            except Exception as e:   # surfaced below
+                errs.append(e)
+
+        try:
+            threads = [threading.Thread(target=caller, args=(s,))
+                       for s in range(0, 32, 2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+        finally:
+            srv.close()
+
+    def test_submit_validation(self, dataset, flat_index):
+        x, q = dataset
+        srv = SearchServer.from_index(flat_index, q[:4], 4,
+                                      params=_EXACT,
+                                      config=ServeConfig(
+                                          batch_sizes=(1, 4)))
+        try:
+            with pytest.raises(Exception):
+                srv.submit(q[:8])          # nq over the largest shape
+            with pytest.raises(Exception):
+                srv.submit(q[:2, :8])      # dim mismatch
+            with pytest.raises(Exception):
+                srv.submit(q[:2], k=99)    # k over the plan k
+        finally:
+            srv.close()
+
+
+class TestAdmission:
+    def test_deadline_expired_never_occupies_a_slot(self):
+        ladder, calls = _fake_ladder(shapes=(1, 4),
+                                     rung_delays=(0.005,))
+        cfg = ServeConfig(batch_sizes=(1, 4), max_wait_ms=0.0)
+        srv = SearchServer(ladder, cfg, start=False)
+        before = obs.snapshot()
+        try:
+            f_dead = srv.submit(_rows(1, base=1000), deadline_ms=1.0)
+            f_live = srv.submit(_rows(1, base=2000))
+            time.sleep(0.05)            # deadline expires in queue
+            srv.start()
+            d, i = f_live.result(timeout=30)
+            assert i[0, 0] == 2000
+            with pytest.raises(DeadlineExceeded):
+                f_dead.result(timeout=30)
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.serve.deadline.total") == 1
+            # the expired request's marker row never reached a plan
+            served = np.concatenate(calls)[:, 0] if calls else []
+            assert 1000 not in set(np.asarray(served).tolist())
+        finally:
+            srv.close()
+
+    def test_queue_full_rejects_explicitly(self):
+        ladder, _ = _fake_ladder(shapes=(1,), rung_delays=(0.01,))
+        cfg = ServeConfig(batch_sizes=(1,), max_queue=2,
+                          max_wait_ms=0.0)
+        srv = SearchServer(ladder, cfg, start=False)
+        before = obs.snapshot()
+        futs = [srv.submit(_rows(1, base=i)) for i in range(5)]
+        rejected = [f for f in futs if f.done()]
+        # queue holds 2; the other 3 failed the moment they submitted
+        assert len(rejected) == 3
+        for f in rejected:
+            with pytest.raises(RejectedError):
+                f.result(timeout=0)
+        after = obs.snapshot()
+        assert _cdiff(before, after, "raft.serve.shed.total") == 3
+        assert after["gauges"]["raft.serve.queue.depth"] <= 2
+        assert after["gauges"]["raft.serve.shed.rate"] > 0
+        srv.start()
+        for f in futs:
+            if f not in rejected:
+                f.result(timeout=30)
+        srv.close()
+
+    def test_close_fails_queued_requests(self):
+        ladder, _ = _fake_ladder(shapes=(1,), rung_delays=(0.0,))
+        srv = SearchServer(ladder, ServeConfig(batch_sizes=(1,)),
+                           start=False)
+        f = srv.submit(_rows(1))
+        srv.close()
+        with pytest.raises(RejectedError):
+            f.result(timeout=5)
+        # post-close submissions are rejected too, not hung
+        with pytest.raises(RejectedError):
+            srv.submit(_rows(1)).result(timeout=5)
+
+
+class TestOverload:
+    def test_degrades_bounds_p99_and_recovers(self):
+        """Arrivals far above rung-0 sustainable throughput: the queue
+        stays bounded (excess explicitly shed), the ladder steps down
+        so accepted p99 stays under the watermark, and once the burst
+        drains the ladder steps back up — all read from raft.serve.*
+        metrics."""
+        # rung 0: 16 rows / 50 ms = 320 rows/s; rung 1 is 25x faster
+        ladder, _ = _fake_ladder(shapes=(1, 16),
+                                 rung_delays=(0.05, 0.002))
+        watermark = 300.0
+        cfg = ServeConfig(batch_sizes=(1, 16), max_queue=64,
+                          max_wait_ms=1.0,
+                          degrade_watermark_ms=watermark,
+                          degrade_trigger_frac=0.5,
+                          upgrade_watermark_ms=20.0,
+                          degrade_cooldown_ms=20.0)
+        srv = SearchServer(ladder, cfg)
+        before = obs.snapshot()
+        try:
+            # instant burst of 200 single-row requests: >= 2x what rung
+            # 0 can absorb inside the watermark, > max_queue in total
+            futs = [srv.submit(_rows(1, base=i)) for i in range(200)]
+            outcomes = {"ok": 0, "shed": 0, "deadline": 0}
+            for f in futs:
+                try:
+                    f.result(timeout=60)   # no hangs: every future
+                    outcomes["ok"] += 1    # resolves within budget
+                except RejectedError:
+                    outcomes["shed"] += 1
+                except DeadlineExceeded:
+                    outcomes["deadline"] += 1
+            after = obs.snapshot()
+            # bounded queue: everything over max_queue (+ what the
+            # dispatcher drained mid-burst) was explicitly rejected
+            assert outcomes["shed"] >= 200 - cfg.max_queue - 64
+            assert outcomes["ok"] + outcomes["shed"] + \
+                outcomes["deadline"] == 200
+            assert _cdiff(before, after, "raft.serve.shed.total") == \
+                outcomes["shed"]
+            assert _cdiff(before, after,
+                          "raft.serve.completed.total") == outcomes["ok"]
+            # the ladder stepped down under load...
+            down = (after["counters"]
+                    .get("raft.serve.degrade.steps{direction=down}", 0)
+                    - before["counters"]
+                    .get("raft.serve.degrade.steps{direction=down}", 0))
+            assert down >= 1
+            # ...and accepted p99 stayed under the watermark: the
+            # bucket holding the 99th percentile of
+            # raft.serve.request.seconds has an upper edge <= watermark
+            hist = after["histograms"]["raft.serve.request.seconds"]
+            hb = before.get("histograms", {}).get(
+                "raft.serve.request.seconds",
+                {"count": 0, "buckets": {}})
+            count = hist["count"] - hb["count"]
+            target = 0.99 * count
+            cum = 0.0
+            p99_edge = float("inf")
+            for edge, c in hist["buckets"].items():
+                if edge == "+Inf":
+                    continue
+                cum += c - hb["buckets"].get(edge, 0)
+                if cum >= target:
+                    p99_edge = float(edge)
+                    break
+            assert p99_edge <= watermark / 1e3, \
+                f"p99 bucket edge {p99_edge}s over the watermark"
+            # drain: idle ticks walk the ladder back to full quality
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if (_gauge("raft.serve.degrade.level") == 0
+                        and _gauge("raft.serve.overloaded") == 0):
+                    break
+                time.sleep(0.05)
+            assert _gauge("raft.serve.degrade.level") == 0
+            assert _gauge("raft.serve.overloaded") == 0
+            final = obs.snapshot()
+            up = (final["counters"]
+                  .get("raft.serve.degrade.steps{direction=up}", 0)
+                  - before["counters"]
+                  .get("raft.serve.degrade.steps{direction=up}", 0))
+            assert up >= 1
+        finally:
+            srv.close()
+
+
+class TestSteadyState:
+    def test_zero_compiles_after_warmup(self, dataset, flat_index):
+        """The acceptance counter: once the ladder is pre-warmed,
+        traffic causes ZERO plan compilations (raft.plan.cache
+        counters stay flat)."""
+        if not obs.enabled():
+            pytest.skip("metrics disabled (RAFT_TPU_METRICS=0)")
+        x, q = dataset
+        cfg = ServeConfig(batch_sizes=(1, 4, 16), max_wait_ms=1.0)
+        srv = SearchServer.from_index(flat_index, q[:16], 8,
+                                      params=_EXACT, config=cfg)
+        try:
+            before = obs.snapshot()
+            futs = [srv.submit(q[s:s + 3]) for s in range(0, 60, 3)]
+            for f in futs:
+                f.result(timeout=120)
+            after = obs.snapshot()
+            assert _cdiff(before, after, "raft.plan.cache.misses") == 0
+            assert _cdiff(before, after, "raft.plan.build.total") == 0
+            assert _cdiff(before, after,
+                          "raft.serve.batch.rows") == 60
+        finally:
+            srv.close()
+
+
+class TestPlanCacheLRU:
+    def test_bound_evicts_lru_and_counts(self, dataset, monkeypatch):
+        x, q = dataset
+        idx = ivf_flat.build(x[:1500], ivf_flat.IndexParams(
+            n_lists=8, kmeans_n_iters=3))
+        sp = ivf_flat.SearchParams(n_probes=4)
+        monkeypatch.setenv("RAFT_TPU_PLAN_CACHE_MAX", "2")
+        before = obs.snapshot()
+        p1 = plan_mod.build_plan(idx, q[:1], 4, sp, warm=False)
+        p2 = plan_mod.build_plan(idx, q[:2], 4, sp, warm=False)
+        # touch p1 so p2 is the LRU entry when p3 lands
+        assert plan_mod.build_plan(idx, q[:1], 4, sp, warm=False) is p1
+        p3 = plan_mod.build_plan(idx, q[:4], 4, sp, warm=False)
+        after = obs.snapshot()
+        assert len(idx.plan_cache) == 2
+        assert _cdiff(before, after, "raft.plan.cache.evictions") == 1
+        kept = set(idx.plan_cache)
+        assert p1.key in kept and p3.key in kept
+        assert p2.key not in kept
+        # rebuilding the evicted shape recompiles (a counted miss), and
+        # the evicted plan object itself still serves (direct refs,
+        # e.g. a ladder, survive eviction)
+        before = obs.snapshot()
+        plan_mod.build_plan(idx, q[:2], 4, sp, warm=False)
+        after = obs.snapshot()
+        assert _cdiff(before, after, "raft.plan.cache.misses") == 1
+        p2.search(q[:2])
+
+    def test_unbounded_when_disabled(self, dataset, monkeypatch):
+        x, q = dataset
+        idx = ivf_flat.build(x[:1500], ivf_flat.IndexParams(
+            n_lists=8, kmeans_n_iters=3))
+        sp = ivf_flat.SearchParams(n_probes=4)
+        monkeypatch.setenv("RAFT_TPU_PLAN_CACHE_MAX", "0")
+        for nq in (1, 2, 4):
+            plan_mod.build_plan(idx, q[:nq], 4, sp, warm=False)
+        assert len(idx.plan_cache) == 3
+
+
+class TestEndpointIntegration:
+    def _get(self, url):
+        try:
+            r = urllib.request.urlopen(url, timeout=5)
+            return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _post(self, url, obj):
+        body = json.dumps(obj).encode("utf-8")
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            r = urllib.request.urlopen(req, timeout=30)
+            return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_healthz_degrades_on_serve_overload(self):
+        """A single-host overloaded server stops reporting healthy:
+        the serve gauges join the comms-suspect plane in the verdict."""
+        reg = obs.MetricsRegistry(enabled=True)
+        reg.gauge("raft.serve.overloaded").set(1)
+        reg.gauge("raft.serve.queue.depth").set(17)
+        reg.gauge("raft.serve.queue.max").set(64)
+        reg.gauge("raft.serve.degrade.level").set(2)
+        with obs.serve(port=0, registry=reg) as srv:
+            code, body = self._get(srv.url + "/healthz")
+            assert code == 503
+            body = json.loads(body)
+            assert body["status"] == "degraded"
+            assert body["serve"]["overloaded"] == 1
+            assert body["serve"]["queue_depth"] == 17
+            assert body["serve"]["degrade_level"] == 2
+        # shed rate alone also degrades (sustained rejection is not
+        # healthy even after the queue drains)
+        reg2 = obs.MetricsRegistry(enabled=True)
+        reg2.gauge("raft.serve.overloaded").set(0)
+        reg2.gauge("raft.serve.shed.rate").set(3.5)
+        with obs.serve(port=0, registry=reg2) as srv:
+            code, body = self._get(srv.url + "/healthz")
+            assert code == 503
+        # and a healthy serve plane stays 200 with the serve section
+        reg3 = obs.MetricsRegistry(enabled=True)
+        reg3.gauge("raft.serve.overloaded").set(0)
+        reg3.gauge("raft.serve.queue.depth").set(1)
+        reg3.gauge("raft.serve.queue.max").set(64)
+        with obs.serve(port=0, registry=reg3) as srv:
+            code, body = self._get(srv.url + "/healthz")
+            assert code == 200
+            assert json.loads(body)["serve"]["queue_max"] == 64
+
+    def test_post_search_route(self, dataset, flat_index):
+        x, q = dataset
+        server = SearchServer.from_index(
+            flat_index, q[:8], 8, params=_EXACT,
+            config=ServeConfig(batch_sizes=(1, 8), max_wait_ms=1.0))
+        _, i_bf = brute_force_knn(x, q[:2], 4, mode="exact")
+        try:
+            with obs.serve(port=0, searcher=server) as dbg:
+                code, out = self._post(dbg.url + "/search",
+                                       {"queries": q[:2].tolist(),
+                                        "k": 4})
+                assert code == 200
+                ids = np.asarray(out["ids"])
+                assert ids.shape == (2, 4)
+                for r in range(2):
+                    assert set(ids[r].tolist()) == \
+                        set(np.asarray(i_bf)[r].tolist())
+                # malformed bodies are a 400, not a stack trace
+                code, out = self._post(dbg.url + "/search",
+                                       {"nope": 1})
+                assert code == 400
+                # no POST route elsewhere
+                code, out = self._post(dbg.url + "/metrics", {})
+                assert code == 404
+        finally:
+            server.close()
+
+    def test_post_search_without_searcher(self):
+        with obs.serve(port=0) as dbg:
+            code, out = self._post(dbg.url + "/search",
+                                   {"queries": [[0.0]]})
+            assert code == 404
+
+
+class TestLoadgen:
+    def test_open_loop_accounting(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "raft_loadgen",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "loadgen.py"))
+        loadgen = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen)
+        ladder, _ = _fake_ladder(shapes=(1, 8),
+                                 rung_delays=(0.001,), dim=4)
+        srv = SearchServer(ladder, ServeConfig(batch_sizes=(1, 8),
+                                               max_wait_ms=0.5))
+        try:
+            pool = _rows(64)
+            rep = loadgen.run_open_loop(srv, pool, rate_qps=200.0,
+                                        duration_s=0.5, nq=1, seed=1)
+            assert rep["offered"] > 0
+            assert (rep["completed"] + rep["shed"]
+                    + rep["deadline_expired"] + rep["errors"]
+                    == rep["offered"])
+            assert rep["p50_ms"] >= 0
+            assert any(k.startswith("raft.serve.")
+                       for k in rep["serve_metrics"])
+        finally:
+            srv.close()
+        assert loadgen.percentile([1.0, 2.0, 3.0], 50) == 2.0
+        assert loadgen.percentile([1.0, 2.0, 3.0], 0) == 1.0
+        assert loadgen.percentile([1.0, 2.0, 3.0], 100) == 3.0

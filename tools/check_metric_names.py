@@ -91,6 +91,17 @@ REQUIRED_NAMES = (
     "raft.build.sharded.rows",
     "raft.build.streaming.chunks",
     "raft.build.streaming.rows",
+    # serving-runtime instruments (ISSUE 5): admission/robustness
+    # counters the overload tests and /healthz verdict key on, plus the
+    # plan-cache eviction counter of the LRU bound the serve ladder
+    # made necessary
+    "raft.serve.requests.total",
+    "raft.serve.shed.total",
+    "raft.serve.deadline.total",
+    "raft.serve.degrade.steps",
+    "raft.serve.queue.depth",
+    "raft.serve.batch.rows",
+    "raft.plan.cache.evictions",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -111,6 +122,13 @@ REQUIRED_SPAN_NAMES = (
     # the streaming ingestion path each open one
     "raft.build.sharded",
     "raft.build.streaming",
+    # serving-runtime spans (ISSUE 5): the per-request root, its
+    # queue-wait/execution children, and the batch root tagged with
+    # occupancy
+    "raft.serve.request",
+    "raft.serve.queue_wait",
+    "raft.serve.execute",
+    "raft.serve.batch",
 )
 
 

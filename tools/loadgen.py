@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Open-loop load generator for ``raft_tpu.serve`` (ISSUE 5).
+
+Closed-loop clients (each waiting for its answer before sending the
+next) cannot overload a server — their arrival rate collapses to the
+service rate, hiding every queueing pathology. This tool generates
+OPEN-loop traffic: Poisson arrivals at a configured rate, submitted
+through ``SearchServer.submit`` without waiting, deadlines optional —
+the arrival process a population of independent users actually
+presents. Used by ``bench_suite.bench_serve`` (the open-loop row) and
+runnable standalone:
+
+    # steady load against a synthetic index
+    python tools/loadgen.py --rate 200 --duration 5
+
+    # the overload demo: calibrate sustainable throughput, then offer
+    # 2x it and watch the degradation ladder hold p99 while n_probes
+    # (and recall) step down — and step back up as the queue drains
+    python tools/loadgen.py --demo
+
+Reports land as one JSON line: offered/completed/shed/deadline counts,
+achieved QPS, accepted-latency p50/p99, and the ``raft.serve.*``
+metrics diff of the run (batch occupancy, degrade steps, per-level
+batch counts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def percentile(xs, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) of a sequence."""
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    rank = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[rank]
+
+
+def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
+                  duration_s: float, nq: int = 1,
+                  k: Optional[int] = None,
+                  deadline_ms: Optional[float] = None,
+                  seed: int = 0, drain_timeout_s: float = 60.0) -> dict:
+    """Offer Poisson traffic at ``rate_qps`` requests/s for
+    ``duration_s``; every request draws ``nq`` consecutive rows from
+    ``query_pool``. Returns the accounting + latency report."""
+    from raft_tpu import obs
+    from raft_tpu.serve import DeadlineExceeded, RejectedError
+
+    rng = random.Random(seed)
+    pool_n = query_pool.shape[0]
+    lock = threading.Lock()
+    latencies, outcomes = [], {"ok": 0, "shed": 0, "deadline": 0,
+                               "error": 0}
+    pending = []
+    before = obs.snapshot()
+    t0 = time.perf_counter()
+    t_next = t0
+    offered = 0
+    while True:
+        now = time.perf_counter()
+        if now - t0 >= duration_s:
+            break
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.005))
+            continue
+        t_next += rng.expovariate(rate_qps)
+        s = rng.randrange(0, max(1, pool_n - nq))
+        t_sub = time.perf_counter()
+        fut = server.submit(query_pool[s:s + nq], k=k,
+                            deadline_ms=deadline_ms)
+        offered += 1
+
+        def _done(f, t_sub=t_sub):
+            try:
+                f.result()
+            except RejectedError:
+                kind = "shed"
+            except DeadlineExceeded:
+                kind = "deadline"
+            except Exception:
+                kind = "error"
+            else:
+                kind = "ok"
+            with lock:
+                outcomes[kind] += 1
+                if kind == "ok":
+                    latencies.append(time.perf_counter() - t_sub)
+
+        fut.add_done_callback(_done)
+        pending.append(fut)
+    # drain: every future must resolve (no hangs is part of the serving
+    # contract — a stuck future here is a bug, not load)
+    deadline = time.perf_counter() + drain_timeout_s
+    for f in pending:
+        try:
+            f.result(timeout=max(0.0, deadline - time.perf_counter()))
+        except Exception:
+            pass
+    wall = time.perf_counter() - t0
+    diff = obs.snapshot_diff(before, obs.snapshot())
+    with lock:
+        report = {
+            "offered": offered,
+            "offered_qps": round(offered / wall, 1),
+            "completed": outcomes["ok"],
+            "shed": outcomes["shed"],
+            "deadline_expired": outcomes["deadline"],
+            "errors": outcomes["error"],
+            "achieved_qps": round(outcomes["ok"] * nq / wall, 1),
+            "p50_ms": round(percentile(latencies, 50) * 1e3, 2),
+            "p99_ms": round(percentile(latencies, 99) * 1e3, 2),
+            "serve_metrics": {
+                k_: v for k_, v in diff.get("counters", {}).items()
+                if k_.startswith("raft.serve.")},
+        }
+    return report
+
+
+def measure_sustainable_qps(server, query_pool: np.ndarray, nq: int = 1,
+                            seconds: float = 1.0) -> float:
+    """Closed-loop calibration: one caller in a tight loop — the
+    serving rate with zero queueing. The overload demo offers a
+    multiple of this."""
+    t0 = time.perf_counter()
+    done = 0
+    while time.perf_counter() - t0 < seconds:
+        server.search(query_pool[done % 8: done % 8 + nq])
+        done += 1
+    return done / (time.perf_counter() - t0)
+
+
+def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
+                       probes_ladder, deadline_ms: float):
+    from raft_tpu import serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.random import make_blobs
+
+    x, _ = make_blobs(n_samples=n, n_features=dim,
+                      centers=max(8, n // 200), seed=0)
+    q, _ = make_blobs(n_samples=512, n_features=dim,
+                      centers=max(8, n // 200), seed=1)
+    x, q = np.asarray(x), np.asarray(q)
+    index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
+                                                   kmeans_n_iters=4))
+    cfg = serve.ServeConfig(
+        batch_sizes=(1, 8, 32), max_queue=256, max_wait_ms=2.0,
+        probes_ladder=tuple(probes_ladder),
+        default_deadline_ms=deadline_ms,
+        degrade_watermark_ms=200.0, upgrade_watermark_ms=20.0,
+        degrade_cooldown_ms=50.0)
+    params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
+    srv = serve.SearchServer.from_index(index, q[:32], k=k,
+                                        params=params, config=cfg)
+    return srv, q
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=20_000,
+                    help="synthetic index rows")
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--n-lists", type=int, default=64)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--nq", type=int, default=1,
+                    help="queries per request")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="offered request rate (Poisson, requests/s)")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--probes-ladder", type=str, default="32,16,8",
+                    help="comma-separated descending n_probes rungs")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--demo", action="store_true",
+                    help="overload demo: offer 2x the calibrated "
+                         "sustainable rate and show the ladder holding "
+                         "p99 while recall steps down")
+    args = ap.parse_args(argv)
+
+    ladder = tuple(int(s) for s in args.probes_ladder.split(","))
+    srv, q = _build_demo_server(args.n, args.dim, args.n_lists, args.k,
+                                ladder, args.deadline_ms)
+    try:
+        if args.demo:
+            from raft_tpu import obs
+            sustainable = measure_sustainable_qps(srv, q, nq=args.nq)
+            rate = 2.0 * sustainable
+            print(json.dumps({"phase": "calibrate",
+                              "sustainable_qps": round(sustainable, 1),
+                              "offered_qps": round(rate, 1)}),
+                  flush=True)
+            report = run_open_loop(
+                srv, q, rate_qps=rate, duration_s=args.duration,
+                nq=args.nq, deadline_ms=args.deadline_ms or None,
+                seed=args.seed)
+            report["phase"] = "overload"
+            report["watermark_ms"] = srv.config.degrade_watermark_ms
+            report["p99_under_watermark"] = (
+                report["p99_ms"] <= srv.config.degrade_watermark_ms)
+            print(json.dumps(report), flush=True)
+            # drain: the ladder must step back up once load stops
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 5.0:
+                lvl = obs.snapshot()["gauges"].get(
+                    "raft.serve.degrade.level", 0.0)
+                if lvl == 0:
+                    break
+                time.sleep(0.05)
+            print(json.dumps({"phase": "drain",
+                              "degrade_level": lvl,
+                              "recovered": lvl == 0}), flush=True)
+        else:
+            report = run_open_loop(
+                srv, q, rate_qps=args.rate, duration_s=args.duration,
+                nq=args.nq, deadline_ms=args.deadline_ms or None,
+                seed=args.seed)
+            print(json.dumps(report), flush=True)
+    finally:
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -45,6 +45,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_build.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# serving-runtime contract next (ISSUE 5 satellite): micro-batching
+# correctness (no pad-row leakage), backpressure/deadline/degradation
+# semantics, and the healthz/search endpoint integration.
+echo "precommit: serving runtime tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 echo "precommit: tier-1 pytest (ROADMAP.md)"
 set -o pipefail
 rm -f /tmp/_t1.log
