@@ -383,7 +383,9 @@ def _last_green_tpu(path=None):
                     try:
                         t_meas = time.mktime(
                             time.strptime(ts, "%Y-%m-%dT%H:%M:%S"))
-                        age = time.time() - t_meas
+                        # wall clock on purpose: measured_at is a
+                        # wall-clock stamp from another process
+                        age = time.time() - t_meas  # graftlint: disable=GL005
                         if round_start is not None:
                             same_round = (t_meas >= round_start
                                           and 0 <= age < 24 * 3600)

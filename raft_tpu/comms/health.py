@@ -46,6 +46,8 @@ class _InProcessBoard:
     (session, rank) — cliques sharing the default board must not read
     each other's heartbeats."""
 
+    GUARDED_BY = ("_beats",)        # tools/graftlint GL003
+
     def __init__(self):
         self._beats: Dict[Tuple[str, int], int] = {}
         self._lock = threading.Lock()

@@ -39,6 +39,8 @@ def _coordination_client():
 class _InProcessRegistry:
     """Shared mailbox for ranks living in one process (test meshes)."""
 
+    GUARDED_BY = ("_boxes",)        # tools/graftlint GL003
+
     def __init__(self):
         self._boxes: Dict[Tuple[str, int, int, int, int], queue.Queue] = {}
         self._lock = threading.Lock()

@@ -52,7 +52,8 @@ def axpy(alpha: float, x, y, res=None) -> jax.Array:
 def dot(x, y, res=None) -> jax.Array:
     """<x, y> (reference linalg/dot.cuh)."""
     return jnp.dot(as_array(x), as_array(y),
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=jnp.float32,
+                   precision=matmul_precision())
 
 
 def transpose(a, res=None) -> jax.Array:

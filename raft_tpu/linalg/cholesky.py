@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.precision import matmul_precision
 
 
 def cholesky_r1_update(l_factor, new_col, eps: float = 0.0, res=None
@@ -30,7 +31,7 @@ def cholesky_r1_update(l_factor, new_col, eps: float = 0.0, res=None
     if n == 0:
         return jnp.sqrt(jnp.maximum(new_col[:1, None], eps if eps > 0 else 0.0))
     b = jax.scipy.linalg.solve_triangular(l_factor, new_col[:n], lower=True)
-    d2 = new_col[n] - jnp.dot(b, b) + eps
+    d2 = new_col[n] - jnp.dot(b, b, precision=matmul_precision()) + eps
     d = jnp.sqrt(jnp.maximum(d2, 0.0))
     top = jnp.concatenate([l_factor, jnp.zeros((n, 1), l_factor.dtype)], axis=1)
     bottom = jnp.concatenate([b, jnp.asarray([d], l_factor.dtype)])[None, :]

@@ -116,12 +116,15 @@ def _score_block(qsub, data, norms, scale):
     ``ivf_flat._score_probe`` (bf16 on the MXU; int8 via folded scale)."""
     qq = jnp.sum(qsub * qsub, axis=2)
     if data.dtype == jnp.bfloat16:
+        # one MXU pass on purpose: operands are already bf16
         ip = jnp.einsum("gcd,gld->gcl", qsub.astype(jnp.bfloat16), data,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT)
     elif data.dtype == jnp.int8:
         ip = scale * jnp.einsum("gcd,gld->gcl", qsub,
                                 data.astype(jnp.float32),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+                                precision=matmul_precision())
     else:
         ip = jnp.einsum("gcd,gld->gcl", qsub, data,
                         preferred_element_type=jnp.float32,
@@ -462,9 +465,11 @@ def gather_query_rows(queries, qmap, mode: str = ""):
     def one_chunk(idx_c):
         oh = jax.nn.one_hot(idx_c, nq, dtype=jnp.bfloat16)  # (c, cap, nq)
         hi = jnp.einsum("lcq,qd->lcd", oh, qh,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT)
         lo = jnp.einsum("lcq,qd->lcd", oh, ql,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT)
         return hi + lo
 
     out = jax.lax.map(one_chunk, safe.reshape(-1, chunk, cap))

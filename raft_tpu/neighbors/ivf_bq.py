@@ -288,8 +288,11 @@ def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
         qg = q_rot[jnp.clip(qm, 0, nq - 1)]           # (chunk, cap, d)
         pm1 = _unpack_pm1(bw, dim)                    # (chunk, ML, d) ±1
         if kind == "ip":
+            # one-pass bf16 estimator tier on purpose (exact re-rank
+            # follows)
             ip = jnp.einsum("gcd,gld->gcl", qg.astype(jnp.bfloat16),
-                            pm1, preferred_element_type=jnp.float32)
+                            pm1, preferred_element_type=jnp.float32,
+                            precision=lax.Precision.DEFAULT)
             # q·c_l dominates the estimator: full precision, like the
             # Pallas tier's post-scan correction
             corr = jnp.einsum("gcd,gd->gc", qg, cl,
@@ -299,7 +302,8 @@ def _fused_bq_search(queries, centers, centers_rot, rot, bits, norms2,
         else:
             qsub = qg - cl[:, None, :]
             ip = jnp.einsum("gcd,gld->gcl", qsub.astype(jnp.bfloat16),
-                            pm1, preferred_element_type=jnp.float32)
+                            pm1, preferred_element_type=jnp.float32,
+                            precision=lax.Precision.DEFAULT)
             qq = jnp.sum(qsub * qsub, axis=2)         # (chunk, cap)
             est = (qq[:, :, None] + n2[:, None, :]
                    - 2.0 * sc[:, None, :] * ip)       # (chunk, cap, ML)

@@ -345,12 +345,15 @@ def _score_probe(queries, qq, lists_data, lists_norms, lists_indices,
     data = lists_data[list_id]                  # (nq, max_list, dim)
     ids = lists_indices[list_id]                # (nq, max_list)
     if data.dtype == jnp.bfloat16:
+        # one MXU pass on purpose: operands are already bf16
         ip = jnp.einsum("qd,qld->ql", queries.astype(jnp.bfloat16), data,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT)
     elif data.dtype == jnp.int8:
         ip = scale * jnp.einsum("qd,qld->ql", queries,
                                 data.astype(jnp.float32),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+                                precision=matmul_precision())
     else:
         ip = jnp.einsum("qd,qld->ql", queries, data,
                         preferred_element_type=jnp.float32,

@@ -423,14 +423,16 @@ def _batched_masked_kmeans(data, valid, n_codes: int, n_iters: int, key,
             xx = jnp.sum(db * db, axis=2)[:, :, None]
             cc = jnp.sum(c * c, axis=2)[:, None, :]
             ip = jnp.einsum("lmd,lcd->lmc", db, c,
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32,
+                            precision=matmul_precision())
             d = xx + cc - 2.0 * ip
             assign = jnp.argmin(d, axis=2)
             oh = jax.nn.one_hot(assign, n_codes, dtype=jnp.float32)
             oh = oh * vb[:, :, None]
             counts = jnp.sum(oh, axis=1)
             sums = jnp.einsum("lmc,lmd->lcd", oh, db,
-                              preferred_element_type=jnp.float32)
+                              preferred_element_type=jnp.float32,
+                              precision=matmul_precision())
             newc = sums / jnp.maximum(counts, 1.0)[:, :, None]
             return jnp.where(counts[:, :, None] > 0, newc, c), None
 
@@ -764,13 +766,16 @@ def _score_probe_reconstruct(q_rot, centers_rot, decoded, decoded_norms,
     ids = lists_indices[list_id]                     # (nq, ml)
     if kind == "ip":
         qb = q_rot.astype(jnp.bfloat16)
+        # one MXU pass on purpose: the bf16 reconstruction scan tier
         ip = jnp.einsum("qd,qld->ql", qb, data,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32,
+                        precision=lax.Precision.DEFAULT)
         cq = jnp.sum(q_rot * centers_rot[list_id], axis=1)  # (nq,)
         return jnp.where(ids >= 0, -(ip + cq[:, None]), jnp.inf), ids
     resid = (q_rot - centers_rot[list_id]).astype(jnp.bfloat16)
     ip = jnp.einsum("qd,qld->ql", resid, data,
-                    preferred_element_type=jnp.float32)
+                    preferred_element_type=jnp.float32,
+                    precision=lax.Precision.DEFAULT)
     rr = jnp.sum(resid.astype(jnp.float32) ** 2, axis=1)
     d = rr[:, None] + decoded_norms[list_id] - 2.0 * ip
     return jnp.where(ids >= 0, jnp.maximum(d, 0.0), jnp.inf), ids

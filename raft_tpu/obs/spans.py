@@ -106,7 +106,9 @@ class _TraceState:
         self.spans: List[dict] = []
         self.stack: List["Span"] = []
         self.t0 = time.perf_counter()
-        self.t0_unix = time.time()
+        # wall-clock on purpose: exported trace timestamps must be
+        # correlatable across processes
+        self.t0_unix = time.time()  # graftlint: disable=GL005
 
 
 class Span:

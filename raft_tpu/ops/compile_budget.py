@@ -40,7 +40,9 @@ from raft_tpu.core.logger import logger
 
 # tier state, process-global: (ladder name, tier name) -> True
 _OK: dict = {}
-# (ladder name, tier name) -> wall time the budget expired
+# (ladder name, tier name) -> time.monotonic() when the budget expired
+# (monotonic, not wall clock: an NTP step must not stretch or shrink a
+# poison window)
 _POISONED: dict = {}
 _LOCK = threading.Lock()
 
@@ -160,7 +162,7 @@ def run_tiers(name: str, tiers: Sequence[Tuple[str, Callable]],
                 _OK[key] = True
             return result["out"]
         with _LOCK:
-            _POISONED[key] = time.time()
+            _POISONED[key] = time.monotonic()
         logger.warn(
             "%s: tier %s exceeded the %.0f s compile budget; compile "
             "PARKED (never killed — see compile_budget docstring), "
@@ -178,7 +180,7 @@ def run_tiers(name: str, tiers: Sequence[Tuple[str, Callable]],
                 sibkey = (name, sib)
                 with _LOCK:
                     if sibkey not in _OK and sibkey not in _POISONED:
-                        _POISONED[sibkey] = time.time()
+                        _POISONED[sibkey] = time.monotonic()
                         logger.warn("%s: tier %s skipped (same-family "
                                     "sibling of the parked %s)",
                                     name, sib, tname)

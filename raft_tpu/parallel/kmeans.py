@@ -85,28 +85,37 @@ def distributed_kmeans_fit(
         c0 = _plus_plus(x[:n], jnp.ones((n,), jnp.float32),
                         jax.random.key(params.seed), k)
 
-    def local(x_shard, valid_shard, c_init):
-        def body(state):
-            c, _, it, shift = state
-            new_c, inertia = distributed_kmeans_step(
-                x_shard, c, valid_shard, k, axis)
-            shift = jnp.sum((new_c - c) ** 2)
-            return new_c, inertia, it + 1, shift
+    def build():
+        from raft_tpu.parallel.mesh import shard_map_compat
 
-        def cond(state):
-            _, _, it, shift = state
-            return jnp.logical_and(it < params.max_iter, shift > params.tol)
+        def local(x_shard, valid_shard, c_init):
+            def body(state):
+                c, _, it, shift = state
+                new_c, inertia = distributed_kmeans_step(
+                    x_shard, c, valid_shard, k, axis)
+                shift = jnp.sum((new_c - c) ** 2)
+                return new_c, inertia, it + 1, shift
 
-        state = (c_init, jnp.asarray(jnp.inf, jnp.float32),
-                 jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, jnp.float32))
-        c, inertia, n_iter, _ = lax.while_loop(cond, body, state)
-        return c, inertia, n_iter
+            def cond(state):
+                _, _, it, shift = state
+                return jnp.logical_and(it < params.max_iter,
+                                       shift > params.tol)
 
-    from raft_tpu.parallel.mesh import shard_map_compat
-    shmapped = jax.jit(shard_map_compat(
-        local, mesh,
-        in_specs=(P(axis, None), P(axis), P()),
-        out_specs=(P(), P(), P())))
+            state = (c_init, jnp.asarray(jnp.inf, jnp.float32),
+                     jnp.asarray(0, jnp.int32),
+                     jnp.asarray(jnp.inf, jnp.float32))
+            c, inertia, n_iter, _ = lax.while_loop(cond, body, state)
+            return c, inertia, n_iter
+
+        return jax.jit(shard_map_compat(
+            local, mesh,
+            in_specs=(P(axis, None), P(axis), P()),
+            out_specs=(P(), P(), P())))
+
+    from raft_tpu.parallel.ivf import _shmap_plan
+    shmapped = _shmap_plan(
+        ("kmeans_fit", mesh, axis, k, int(params.max_iter),
+         float(params.tol)), build)
     xs = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
     vs = jax.device_put(valid, NamedSharding(mesh, P(axis)))
     cr = jax.device_put(c0, NamedSharding(mesh, P()))

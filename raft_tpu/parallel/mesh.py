@@ -55,3 +55,17 @@ def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
     from jax.experimental.shard_map import shard_map as _shard_map
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False)
+
+
+def pcast_varying_compat(x, axes):
+    """``lax.pcast(x, axes, to='varying')`` when the primitive exists
+    (current jax: casts a replicated value so the varying-manual-axes
+    checker accepts it in a varying position). On older jax the
+    :func:`shard_map_compat` path already runs with ``check_rep=False``
+    — there is no replication tracking to satisfy — so the cast is the
+    identity. Lets bodies written for the new checker (the distributed
+    knn scan inits) run on old-jax CPU meshes too."""
+    from jax import lax
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return x

@@ -70,6 +70,12 @@ class SearchServer:
     :meth:`from_index` (real plans) or directly from a
     :class:`PlanLadder` (tests inject fakes)."""
 
+    # static race detector contract (tools/graftlint GL003): these
+    # fields sit on the caller-thread/dispatcher-thread boundary and
+    # must only be touched under `with self._cond` or inside a
+    # `_locked`-suffix method
+    GUARDED_BY = ("_q", "_rows_queued", "_closed", "_shed_times")
+
     def __init__(self, ladder: PlanLadder,
                  config: Optional[ServeConfig] = None,
                  start: bool = True):
@@ -133,7 +139,9 @@ class SearchServer:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        # benign racy read: a bool snapshot for status endpoints; the
+        # admission decision re-checks under the lock in submit()
+        return self._closed  # graftlint: disable=GL003
 
     @property
     def ladder(self) -> PlanLadder:
@@ -177,10 +185,10 @@ class SearchServer:
         obs.counter("raft.serve.queries.total").inc(nq)
         with self._cond:
             if self._closed:
-                self._shed(req, "closed")
+                self._shed_locked(req, "closed")
                 return req.future
             if len(self._q) >= self._cfg.max_queue:
-                self._shed(req, "queue_full")
+                self._shed_locked(req, "queue_full")
                 return req.future
             self._q.append(req)
             self._rows_queued += nq
@@ -196,7 +204,7 @@ class SearchServer:
         return self.submit(queries, k, deadline_ms).result(timeout)
 
     # -- internals ---------------------------------------------------------
-    def _shed(self, req: _Request, reason: str) -> None:
+    def _shed_locked(self, req: _Request, reason: str) -> None:
         """Refuse admission (called under the queue lock). Counted AND
         span-attributed — the shed decision must be visible in both
         observability planes."""

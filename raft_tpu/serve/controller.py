@@ -30,6 +30,13 @@ class LoadController:
     delay. Single-writer (the dispatcher thread); readers go through
     the exported gauges."""
 
+    # GL003 contract: no lock because there is no sharing — `level` /
+    # `_last_step` are written ONLY by the dispatcher thread
+    # (SearchServer._loop/_execute call observe()); every other thread
+    # reads through the exported gauges. Adding a field that another
+    # thread writes means adding a lock AND declaring it here.
+    GUARDED_BY = ()
+
     def __init__(self, n_rungs: int, config: ServeConfig):
         self.n_rungs = max(1, int(n_rungs))
         self.cfg = config

@@ -6,16 +6,17 @@ Three modes, one contract — every metric AND span name is
 dot-separated) and a metric name is bound to exactly ONE instrument
 kind:
 
-* **source mode** (default): scan the instrumented tree for
-  ``obs.counter("...")`` / ``obs.gauge`` / ``obs.histogram`` /
-  ``obs.timed`` / ``obs.span`` / ``spans.span`` / ``spans.spanned`` /
-  ``spans.add_child_span`` call sites with a literal first argument
-  and fail on
-  - names violating the taxonomy regex,
-  - the same name registered under conflicting kinds (``obs.timed(n)``
-    registers the histogram ``n + ".seconds"``, so a ``timed`` name
-    also conflicts with a counter/gauge of that derived name; span
-    names are a separate plane and never kind-conflict with metrics).
+* **source mode** (default): a thin shim over the graftlint registry
+  rules **GL010/GL011** (``tools/graftlint/rules/metrics.py`` owns the
+  scanning since ISSUE 6) plus the REQUIRED_NAMES /
+  REQUIRED_SPAN_NAMES coverage checks below — fail on
+  - names violating the taxonomy regex (GL010),
+  - the same name registered under conflicting kinds (GL011;
+    ``obs.timed(n)`` registers the histogram ``n + ".seconds"``, so a
+    ``timed`` name also conflicts with a counter/gauge of that derived
+    name; span names are a separate plane and never kind-conflict
+    with metrics),
+  - a contracted serving instrument/span with no call site left.
 * **text mode** (``--text FILE``, ``-`` = stdin): parse a Prometheus
   exposition dump (the ``obs.to_prometheus_text()`` output) and fail on
   - family names not matching ``raft_[a-z0-9_]+``,
@@ -44,29 +45,21 @@ import argparse
 import os
 import re
 import sys
-from typing import Dict, List, Tuple
+from typing import List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:                 # standalone / importlib loads
+    sys.path.insert(0, REPO)
 
-# the same taxonomy contract as raft_tpu.obs.registry.NAME_RE (kept
-# literal here so the lint has no import-time dependency on the tree
-# it checks)
-NAME_RE = re.compile(r"^raft\.[a-z0-9_]+(\.[a-z0-9_]+)*$")
+from tools.graftlint.rules import metrics as _metrics  # noqa: E402
+
+# the taxonomy contract, re-exported from the graftlint rule module so
+# the two gates can never diverge
+NAME_RE = _metrics.NAME_RE
+CALL_RE = _metrics.CALL_RE
+SPAN_KINDS = _metrics.SPAN_KINDS
+LITERAL_RE = _metrics.LITERAL_RE
 PROM_NAME_RE = re.compile(r"^raft_[a-z0-9_]+$")
-
-# obs.counter("raft.x.y", ...), obs.timed('raft.x.y'),
-# spans.span("raft.x.y") / obs.span(...) / spans.spanned(...) /
-# spans.add_child_span(...) — spans share the taxonomy but are their
-# own plane (no instrument-kind conflicts with metrics)
-CALL_RE = re.compile(
-    r"""\b(?:obs|spans)\.(counter|gauge|histogram|timed|span|spanned"""
-    r"""|add_child_span)\(\s*(['"])([^'"]+)\2""")
-SPAN_KINDS = ("span", "spanned", "add_child_span")
-
-# any full raft.* string literal (the attributed stage-name tables the
-# plan layer hands to spans.add_stage_spans are plain tuples, not call
-# sites) — used ONLY for REQUIRED_SPAN_NAMES coverage, never flagged
-LITERAL_RE = re.compile(r"""['"](raft\.[a-z0-9_]+(?:\.[a-z0-9_]+)+)['"]""")
 
 # trees holding instrumented call sites (bench/tools ride along so a
 # future metric added there is linted too)
@@ -147,54 +140,32 @@ def iter_source_files() -> List[str]:
 
 
 def lint_source(files: List[str] = None) -> List[str]:
-    """Scan call sites → list of violation strings. The REQUIRED_NAMES
+    """Scan call sites → list of violation strings (the GL010/GL011
+    registry checks, legacy message format). The REQUIRED_NAMES
     coverage check only applies to full-tree scans (``files=None``) —
     an explicit file list (unit tests, partial lints) cannot be
     expected to contain the serving instruments."""
     full_scan = files is None
     files = files if files is not None else iter_source_files()
     self_path = os.path.abspath(__file__)
+    graft_dir = os.path.join(os.path.dirname(self_path), "graftlint")
     violations: List[str] = []
-    # name -> (kind, first definition site)
-    seen: Dict[str, Tuple[str, str]] = {}
-    span_seen: Dict[str, str] = {}      # span name -> first site
-    literals: Dict[str, str] = {}       # any full raft.* literal
+    seen: dict = {}
+    span_seen: dict = {}
+    literals: dict = {}
     for path in files:
-        if os.path.abspath(path) == self_path:
-            continue  # this file's docstring examples are not call sites
+        apath = os.path.abspath(path)
+        if apath == self_path or apath.startswith(graft_dir + os.sep):
+            continue  # docstring examples / the rule sources themselves
         rel = os.path.relpath(path, REPO)
         try:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
         except OSError:
             continue
-        for m in CALL_RE.finditer(text):
-            kind, name = m.group(1), m.group(3)
-            line = text.count("\n", 0, m.start()) + 1
-            site = f"{rel}:{line}"
-            if not NAME_RE.match(name):
-                violations.append(
-                    f"{site}: {name!r} violates the raft.<module>.<op> "
-                    f"taxonomy")
-                continue
-            if kind in SPAN_KINDS:
-                # spans share the taxonomy but not the instrument
-                # registry — record for coverage, no kind conflicts
-                span_seen.setdefault(name, site)
-                continue
-            # timed registers <name>.seconds as a histogram
-            reg_name = name + ".seconds" if kind == "timed" else name
-            reg_kind = "histogram" if kind == "timed" else kind
-            prev = seen.get(reg_name)
-            if prev is None:
-                seen[reg_name] = (reg_kind, site)
-            elif prev[0] != reg_kind:
-                violations.append(
-                    f"{site}: {reg_name!r} registered as {reg_kind} but "
-                    f"already a {prev[0]} at {prev[1]}")
-        for m in LITERAL_RE.finditer(text):
-            if NAME_RE.match(m.group(1)):
-                literals.setdefault(m.group(1), rel)
+        for line, _code, msg in _metrics.check_events(
+                rel, text, seen, span_seen, literals):
+            violations.append(f"{rel}:{line}: {msg}")
     if full_scan:
         for name in REQUIRED_NAMES:
             if name not in seen:
@@ -212,7 +183,7 @@ def lint_source(files: List[str] = None) -> List[str]:
 def lint_prometheus_text(text: str) -> List[str]:
     """Validate a Prometheus exposition dump."""
     violations: List[str] = []
-    typed: Dict[str, str] = {}
+    typed: dict = {}
     for ln, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line:

@@ -1,0 +1,63 @@
+"""graftlint — JAX/TPU-aware static analysis for the raft_tpu tree.
+
+Three of the first five PRs burned most of their effort profiling bug
+*classes* that are mechanically detectable at the AST level: a hidden
+per-call host sync plus a ``shard_map`` closure re-traced on every call
+(PR 2's serving fixed cost), and a precision kwarg silently dropped on
+the training einsums (PR 3's satellite).  Production stacks gate on
+analyzers, not on heroic profiling — this package is that gate,
+stdlib-``ast`` only, no new dependencies.
+
+Pieces:
+
+* :mod:`tools.graftlint.core` — ``Finding``/``FileContext``/``Rule``
+  plus the rule registry and per-line suppression parsing
+  (``# graftlint: disable=GL001[,GL003]`` or ``disable=all``).
+* :mod:`tools.graftlint.engine` — file iteration, baseline
+  (strict-on-new-code) gate, text/JSON output, the CLI behind
+  ``python -m tools.graftlint``.
+* :mod:`tools.graftlint.rules` — the rules this codebase already paid
+  for the hard way (GL001 host-sync-in-jit, GL002 retrace hazards,
+  GL003 lock discipline, GL004 precision, GL005 monotonic clock,
+  GL010/GL011 metric-name taxonomy).
+
+``docs/static_analysis.md`` has the rule catalog, the real PR 2/3/5
+bug each rule would have caught, and the suppression + baseline
+workflow.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    get_rule,
+    register,
+)
+from tools.graftlint.engine import (  # noqa: F401
+    DEFAULT_BASELINE,
+    SCAN_ROOTS,
+    iter_source_files,
+    load_baseline,
+    run,
+    split_new,
+    to_json,
+    write_baseline,
+)
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "get_rule",
+    "register",
+    "DEFAULT_BASELINE",
+    "SCAN_ROOTS",
+    "iter_source_files",
+    "load_baseline",
+    "run",
+    "split_new",
+    "to_json",
+    "write_baseline",
+]

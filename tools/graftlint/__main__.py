@@ -1,0 +1,17 @@
+"""``python -m tools.graftlint`` entry point."""
+
+import os
+import sys
+
+# allow invocation from anywhere: the package resolves imports through
+# the repo root (python -m from the root needs nothing; a direct
+# ``python tools/graftlint/__main__.py`` gets the root prepended)
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.graftlint.engine import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Core types for graftlint: findings, file context, rules, registry.
+
+A rule is a class with a ``code`` (``GLxxx``), a path scope (repo-
+relative prefixes it applies to), and a ``check(ctx)`` generator run
+once per in-scope file; cross-file rules keep state on the instance
+(one instance per run, files visited in sorted order) and may emit
+more findings from ``finalize()``.  Everything is stdlib-only.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Type
+
+# per-line suppression: ``# graftlint: disable=GL001`` /
+# ``disable=GL001,GL003`` / ``disable=all`` on the finding's first line
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``context`` is the stripped source line — the
+    baseline matches on (rule, file, context) so findings survive line
+    drift without being re-grandfathered onto new code."""
+
+    rule: str
+    file: str           # repo-relative, '/'-separated
+    line: int           # 1-based
+    col: int            # 0-based
+    message: str
+    context: str = ""
+
+    def key(self):
+        return (self.rule, self.file, self.context)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+class FileContext:
+    """One source file as the rules see it: raw text, split lines and
+    (when it parses) the AST."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace("\\", "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:       # surfaced by the engine as GL000
+            self.parse_error = e
+
+    def source_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        """Build a Finding anchored at an AST node (or an int line)."""
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, file=self.rel, line=line, col=col,
+                       message=message,
+                       context=self.source_line(line))
+
+    def suppressed(self, finding: Finding) -> bool:
+        """True when the finding's first physical line carries a
+        ``# graftlint: disable=`` pragma naming its rule (or ``all``)."""
+        m = SUPPRESS_RE.search(self.source_line(finding.line))
+        if not m:
+            return False
+        codes = {c.strip() for c in m.group(1).split(",")}
+        return "all" in codes or finding.rule in codes
+
+
+class Rule:
+    """Base class. Subclass, set the class attributes, implement
+    ``check``; decorate with :func:`register`."""
+
+    code: str = "GL000"
+    name: str = ""
+    description: str = ""
+    # repo-relative path prefixes this rule applies to; () = every
+    # scanned file
+    paths: tuple = ()
+    # repo-relative prefixes always skipped (own sources, shims, ...)
+    excludes: tuple = ()
+
+    def applies_to(self, rel: str, explicit: bool = False) -> bool:
+        """``explicit`` = the file was named on the command line /
+        in the ``files`` argument — path *scoping* is bypassed (you
+        pointed at it, it gets linted), excludes still hold."""
+        rel = rel.replace("\\", "/")
+        for ex in self.excludes:
+            if rel == ex or rel.startswith(ex.rstrip("/") + "/"):
+                return False
+        if explicit or not self.paths:
+            return True
+        for p in self.paths:
+            if rel == p or rel.startswith(p.rstrip("/") + "/"):
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> Iterable[Finding]:
+        """Cross-file findings, emitted after every file was checked."""
+        return ()
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the registry (unique code)."""
+    if cls.code in _REGISTRY and _REGISTRY[cls.code] is not cls:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    """code -> rule class, rule modules imported on first use."""
+    import tools.graftlint.rules  # noqa: F401  (registers on import)
+    return dict(sorted(_REGISTRY.items()))
+
+
+def get_rule(code: str) -> Type[Rule]:
+    rules = all_rules()
+    try:
+        return rules[code]
+    except KeyError:
+        raise KeyError(
+            f"unknown rule {code!r}; known: {', '.join(rules)}") from None
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers (used by several rules)
+# --------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.experimental.shard_map.shard_map`` for nested Attributes,
+    ``jit`` for a bare Name; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_keywords(node: ast.Call) -> Dict[str, ast.AST]:
+    return {kw.arg: kw.value for kw in node.keywords if kw.arg}
+
+
+def str_tuple(node: ast.AST) -> tuple:
+    """Constant-fold a tuple/list of string constants (else ())."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return ()

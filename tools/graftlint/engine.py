@@ -1,0 +1,245 @@
+"""graftlint engine: file iteration, baseline gate, output, CLI.
+
+The gate is **strict on new code**: findings matching an entry in the
+checked-in baseline (``tools/graftlint_baseline.json``) are
+grandfathered; anything else fails the run.  Baseline entries match on
+``(rule, file, stripped-source-line)`` with a count, so findings
+survive unrelated line drift but a *new* instance of the same pattern
+in the same file is still caught.  Regenerate with
+``--write-baseline`` (code review owns the diff of the baseline file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graftlint.core import FileContext, Finding, all_rules
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# mirrors tools/check_metric_names.py SCAN_ROOTS: the instrumented tree
+# plus the tooling that rides along
+SCAN_ROOTS = ("raft_tpu", "tests", "tools", "bench_suite.py", "bench.py")
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
+
+BASELINE_VERSION = 1
+JSON_VERSION = 1
+
+
+def iter_source_files(root: str,
+                      paths: Optional[Sequence[str]] = None) -> List[str]:
+    """Sorted .py files under ``paths`` (default: SCAN_ROOTS) in
+    ``root``; ``paths`` entries may be files or directories."""
+    out: List[str] = []
+    for p in (paths if paths else SCAN_ROOTS):
+        path = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def run(root: str = REPO, files: Optional[Sequence[str]] = None,
+        select: Optional[Iterable[str]] = None,
+        ) -> Tuple[List[Finding], List[Finding]]:
+    """Run the (selected) rules over ``files`` (default: the scan
+    roots) → ``(findings, suppressed)``, both sorted.  Suppressed
+    findings carried a ``# graftlint: disable=`` pragma on their line;
+    they are returned separately so the CLI can report the count."""
+    codes = set(select) if select else None
+    rules = [cls() for code, cls in all_rules().items()
+             if codes is None or code in codes]
+    if codes:
+        unknown = codes - set(all_rules())
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    paths = [os.path.abspath(f) for f in files] if files else None
+    explicit = paths is not None
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    contexts: Dict[str, FileContext] = {}
+    for path in iter_source_files(root, paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        ctx = FileContext(path, rel, text)
+        contexts[rel] = ctx
+        if ctx.parse_error is not None:
+            findings.append(ctx.finding(
+                "GL000", ctx.parse_error.lineno or 1,
+                f"syntax error: {ctx.parse_error.msg}"))
+            continue
+        for rule in rules:
+            if not rule.applies_to(rel, explicit=explicit):
+                continue
+            for f in rule.check(ctx):
+                (suppressed if ctx.suppressed(f) else findings).append(f)
+    for rule in rules:
+        for f in rule.finalize():
+            ctx = contexts.get(f.file)
+            if ctx is not None and ctx.suppressed(f):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    order = (lambda f: (f.file, f.line, f.col, f.rule))
+    return sorted(findings, key=order), sorted(suppressed, key=order)
+
+
+# --------------------------------------------------------------------------
+# baseline (strict-on-new-code gate)
+# --------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file → Counter of (rule, file, context) allowances."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or "findings" not in obj:
+        raise ValueError(f"{path}: not a graftlint baseline")
+    allow: Counter = Counter()
+    for e in obj["findings"]:
+        allow[(e["rule"], e["file"], e.get("context", ""))] += \
+            int(e.get("count", 1))
+    return allow
+
+
+def split_new(findings: Sequence[Finding], allow: Counter,
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """→ (new, grandfathered). Each baseline allowance absorbs at most
+    ``count`` findings with its key; extras are new."""
+    budget = Counter(allow)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> dict:
+    counts: Counter = Counter(f.key() for f in findings)
+    entries = [
+        {"rule": rule, "file": file, "context": context, "count": n}
+        for (rule, file, context), n in sorted(counts.items())
+    ]
+    obj = {
+        "version": BASELINE_VERSION,
+        "comment": ("grandfathered graftlint findings — strict on new "
+                    "code; regenerate with "
+                    "`python -m tools.graftlint --write-baseline`"),
+        "findings": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return obj
+
+
+# --------------------------------------------------------------------------
+# output + CLI
+# --------------------------------------------------------------------------
+
+def to_json(new: Sequence[Finding], grandfathered: Sequence[Finding],
+            suppressed: Sequence[Finding]) -> dict:
+    """The ``--json`` schema (checked by tests/test_graftlint.py)."""
+    return {
+        "version": JSON_VERSION,
+        "findings": [
+            {"rule": f.rule, "file": f.file, "line": f.line,
+             "col": f.col, "message": f.message, "context": f.context}
+            for f in new
+        ],
+        "counts": dict(Counter(f.rule for f in new)),
+        "grandfathered": len(grandfathered),
+        "suppressed": len(suppressed),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description=("JAX/TPU-aware static analysis "
+                     "(docs/static_analysis.md)"))
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the scan roots)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule codes to run (e.g. "
+                         "GL001,GL003); default: all")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         f"when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather the current findings into the "
+                         "baseline file and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, cls in all_rules().items():
+            scope = ", ".join(cls.paths) if cls.paths else "all files"
+            print(f"{code}  {cls.name}  [{scope}]")
+            if cls.description:
+                print(f"       {cls.description}")
+        return 0
+
+    select = ([c.strip() for c in args.select.split(",") if c.strip()]
+              if args.select else None)
+    try:
+        findings, suppressed = run(REPO, files=args.paths or None,
+                                   select=select)
+    except KeyError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(REPO, DEFAULT_BASELINE)
+    if args.baseline is None and not os.path.exists(baseline_path):
+        baseline_path = None
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.write_baseline:
+        path = args.baseline or os.path.join(REPO, DEFAULT_BASELINE)
+        write_baseline(path, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    allow = load_baseline(baseline_path) if baseline_path else Counter()
+    new, grandfathered = split_new(findings, allow)
+
+    if args.as_json:
+        print(json.dumps(to_json(new, grandfathered, suppressed),
+                         indent=2))
+    else:
+        for f in new:
+            print(f.render())
+    if new:
+        print(f"graftlint: {len(new)} new finding(s) "
+              f"({len(grandfathered)} grandfathered, "
+              f"{len(suppressed)} suppressed)", file=sys.stderr)
+        return 1
+    if not args.as_json:
+        print(f"graftlint: clean ({len(grandfathered)} grandfathered, "
+              f"{len(suppressed)} suppressed)", file=sys.stderr)
+    return 0

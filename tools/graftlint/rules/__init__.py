@@ -1,0 +1,8 @@
+"""Rule modules — importing this package registers every rule."""
+
+from tools.graftlint.rules import clock  # noqa: F401
+from tools.graftlint.rules import host_sync  # noqa: F401
+from tools.graftlint.rules import locks  # noqa: F401
+from tools.graftlint.rules import metrics  # noqa: F401
+from tools.graftlint.rules import precision  # noqa: F401
+from tools.graftlint.rules import retrace  # noqa: F401

@@ -1,0 +1,173 @@
+"""GL003 — lock discipline for the serving/comms thread boundary.
+
+PR 5 introduced a real multithreaded hot path: caller threads submit
+into a queue that ONE dispatcher thread drains (``serve/batcher.py``).
+The convention this rule enforces statically (a lightweight
+Clang-``GUARDED_BY`` for Python):
+
+* a method whose name ends in ``_locked`` asserts "caller holds the
+  lock" — calling one outside a ``with self._lock/_cond:`` block (or
+  outside another ``_locked`` method) is a race;
+* a class may declare ``GUARDED_BY = ("_field", ...)`` — every
+  ``self._field`` load/store must then happen under the lock, inside a
+  ``_locked`` method, or in ``__init__``/``__del__`` (the object is
+  not shared yet/any more).
+
+Recognized lock objects: ``self.X``/bare ``X`` where X is ``_lock``,
+``_cond``, ``_mu``, ``_mutex`` (any case) or ends in ``_lock`` /
+``_cond``.  A benign racy read stays allowed via an explicit
+``# graftlint: disable=GL003`` with a justification — the point is
+that every unlocked touch of shared state is a *decision*, not an
+accident.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.graftlint.core import (FileContext, Finding, Rule, register,
+                                  str_tuple)
+
+LOCK_NAMES = {"_lock", "lock", "_cond", "cond", "_mu", "_mutex"}
+EXEMPT_METHODS = {"__init__", "__del__", "__enter__"}
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return False
+    low = name.lower()
+    return (low in LOCK_NAMES or low.endswith("_lock")
+            or low.endswith("_cond"))
+
+
+def _with_locks(node: ast.With) -> bool:
+    return any(_is_lock_expr(item.context_expr) for item in node.items)
+
+
+class _LockVisitor(ast.NodeVisitor):
+    """Walk one method body tracking lexical `with <lock>` nesting.
+    Nested function defs reset the held-lock state (their body runs
+    whenever they are *called*, not where they are defined)."""
+
+    def __init__(self, rule: "LockDiscipline", ctx: FileContext,
+                 guarded: Set[str], method: str, exempt: bool):
+        self.rule = rule
+        self.ctx = ctx
+        self.guarded = guarded
+        self.method = method
+        self.exempt = exempt          # _locked method / __init__
+        self.depth = 0
+        self.findings: List[Finding] = []
+
+    def _held(self) -> bool:
+        return self.exempt or self.depth > 0
+
+    def visit_With(self, node: ast.With):
+        locked = _with_locks(node)
+        if locked:
+            self.depth += 1
+        for item in node.items:
+            self.visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        if locked:
+            self.depth -= 1
+
+    def _visit_nested(self, node, name: Optional[str]):
+        saved, saved_ex = self.depth, self.exempt
+        self.depth = 0
+        self.exempt = bool(name and name.endswith("_locked"))
+        for stmt in node.body if isinstance(node.body, list) \
+                else [node.body]:
+            self.visit(stmt)
+        self.depth, self.exempt = saved, saved_ex
+
+    def visit_FunctionDef(self, node):
+        self._visit_nested(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._visit_nested(node, None)
+
+    def visit_Call(self, node: ast.Call):
+        name = None
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+        if name and name.endswith("_locked") and not self._held():
+            self.findings.append(self.ctx.finding(
+                self.rule.code, node,
+                f"`{name}()` called without holding the lock "
+                f"(in `{self.method}`) — the _locked suffix asserts "
+                f"the caller holds self._lock/_cond"))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in self.guarded
+                and not self._held()):
+            verb = ("written" if isinstance(node.ctx,
+                                            (ast.Store, ast.Del))
+                    else "read")
+            self.findings.append(self.ctx.finding(
+                self.rule.code, node,
+                f"GUARDED_BY field `self.{node.attr}` {verb} outside "
+                f"the lock (in `{self.method}`) — dispatcher/caller "
+                f"thread race"))
+        self.generic_visit(node)
+
+
+@register
+class LockDiscipline(Rule):
+    code = "GL003"
+    name = "lock-discipline"
+    description = ("_locked-suffix methods called without the lock and "
+                   "GUARDED_BY fields touched outside `with "
+                   "self._lock/_cond` (static race detector for the "
+                   "PR 5 dispatcher/caller thread boundary)")
+    paths = ("raft_tpu/serve", "raft_tpu/comms")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+        # module-level functions: _locked call discipline only (module
+        # globals guard via module-level locks, same lexical rule)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                v = _LockVisitor(self, ctx, set(), node.name,
+                                 node.name.endswith("_locked"))
+                for stmt in node.body:
+                    v.visit(stmt)
+                yield from v.findings
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        guarded: Set[str] = set()
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id == "GUARDED_BY":
+                        guarded |= set(str_tuple(stmt.value))
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            exempt = (stmt.name.endswith("_locked")
+                      or stmt.name in EXEMPT_METHODS)
+            v = _LockVisitor(self, ctx, guarded, stmt.name, exempt)
+            for s in stmt.body:
+                v.visit(s)
+            yield from v.findings

@@ -1,0 +1,72 @@
+"""GL004 — MXU matmuls without an explicit ``precision=``.
+
+The PR 3 bug class: ``ivf_pq`` accepted a ``kmeans_kernel_precision``
+kwarg and silently ``del``'d it — the training einsums ran at the
+process default while the caller believed they had pinned bf16x3.  On
+TPU an f32 ``dot``/``einsum`` without ``precision=`` defaults to
+single-pass bf16 (~5e-4 relative error), which is catastrophic for
+expanded distance forms (see ``core/precision.py``).  In the distance-
+critical trees every contraction must therefore *state* its precision
+(usually ``precision=matmul_precision()`` or the threaded per-call
+kernel precision) so the policy is visible and greppable at the call
+site.
+
+Scope: ``raft_tpu/distance``, ``raft_tpu/linalg``,
+``raft_tpu/neighbors`` — the MXU paths whose accuracy contracts the
+recall gates measure.  ``@``-operator matmuls on XLA-managed solver
+internals are out of scope (no kwarg to carry).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import (FileContext, Finding, Rule,
+                                  call_keywords, dotted_name, register)
+
+# module-qualified contraction entry points that accept precision=
+CONTRACTIONS = {
+    ("jnp", "einsum"), ("jnp", "matmul"), ("jnp", "dot"),
+    ("jnp", "tensordot"), ("jnp", "vdot"), ("jnp", "inner"),
+    ("lax", "dot"), ("lax", "dot_general"),
+}
+
+
+@register
+class ExplicitPrecision(Rule):
+    code = "GL004"
+    name = "explicit-matmul-precision"
+    description = ("jnp.einsum/matmul/dot & lax.dot_general in the "
+                   "distance-critical trees without an explicit "
+                   "precision= (the PR 3 dropped-kwarg bug class)")
+    paths = ("raft_tpu/distance", "raft_tpu/linalg",
+             "raft_tpu/neighbors")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name or "." not in name:
+                continue
+            parts = name.split(".")
+            mod, func = parts[-2], parts[-1]
+            # jax.numpy.einsum / jax.lax.dot_general spellings too
+            if mod == "numpy" and len(parts) >= 3 and \
+                    parts[-3] == "jax":
+                mod = "jnp"
+            if (mod, func) not in CONTRACTIONS:
+                continue
+            if "precision" in call_keywords(node):
+                continue
+            yield ctx.finding(
+                self.code, node,
+                f"{name}() without an explicit precision= — on TPU "
+                f"this silently takes the single-pass bf16 MXU tier; "
+                f"thread precision=matmul_precision() (or the "
+                f"per-call kernel precision) so the accuracy policy "
+                f"is stated at the call site")

@@ -1,0 +1,179 @@
+"""GL002 — retrace hazards at jit/shard_map call sites.
+
+The PR 2 ``_shmap_plan`` bug class: every distributed search built a
+fresh ``local`` closure and called
+``jax.jit(jax.shard_map(local, ...))(...)`` — a new callable identity
+per request, so jax re-traced (and, without a persistent compile
+cache, re-compiled) the whole program on EVERY call.  The fix was a
+keyed plan cache whose *builder thunk* only runs on a miss.
+
+Flagged shapes (inside a function body — module scope traces once and
+is fine):
+
+* a ``lambda`` passed to ``jax.jit`` / ``shard_map`` — fresh closure
+  identity every execution, the jit cache can never hit;
+* a function *defined in the enclosing function* passed to jit — same
+  fresh-identity problem;
+* ``jax.jit(...)(...)`` immediately invoked — the wrapper (which owns
+  the trace cache) is discarded after one call;
+* a traced local closure capturing an ndarray built in the enclosing
+  function (``np.array``/``jnp.zeros``/...) — the constant is baked
+  into the trace and its identity is invisible to any cache key.
+
+Exemption (the plan-cache idiom): a **zero-argument builder function
+nested inside another function** may construct fresh closures — it
+only runs on a cache miss (``_shmap_plan(key, build)``,
+``plan.build_plan``).  Builders that are actually called per request
+still show up through GL001 or the ``raft.plan.cache`` counters.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.graftlint.core import (FileContext, Finding, Rule,
+                                  dotted_name, register)
+from tools.graftlint.rules.host_sync import _is_jit_call, _jit_target
+
+ARRAY_MODULES = {"np", "numpy", "onp", "jnp"}
+ARRAY_CTORS = {"array", "asarray", "zeros", "ones", "arange", "full",
+               "empty", "linspace", "eye"}
+
+
+def _parent_chain(tree: ast.AST) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing_functions(node: ast.AST, parents: dict) -> List[ast.AST]:
+    """Innermost-first chain of FunctionDef/Lambda containing node."""
+    out = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def _is_builder(fn: ast.AST, parents: dict) -> bool:
+    """Zero-arg function nested inside another function — the
+    cache-miss builder-thunk idiom."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    a = fn.args
+    if (a.args or a.posonlyargs or a.kwonlyargs or a.vararg or a.kwarg):
+        return False
+    return bool(_enclosing_functions(fn, parents))
+
+
+def _local_array_names(fn: ast.AST) -> Set[str]:
+    """Names assigned (anywhere in fn, nested scopes included — cheap
+    over-approximation) from an np/jnp array constructor."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr in ARRAY_CTORS):
+            continue
+        root = (dotted_name(v.func) or "").split(".")[0]
+        if root not in ARRAY_MODULES:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+@register
+class RetraceHazard(Rule):
+    code = "GL002"
+    name = "retrace-hazard"
+    description = ("fresh lambdas/closures handed to jax.jit/shard_map "
+                   "per call, immediately-invoked jit wrappers, and "
+                   "jitted closures capturing local ndarray constants "
+                   "(the PR 2 _shmap_plan bug class)")
+    paths = ("raft_tpu",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        parents = _parent_chain(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                continue
+            # only the OUTERMOST wrapper of a nest is diagnosed
+            # (jax.jit(jax.shard_map(f)) is one hazard, not two)
+            p = parents.get(node)
+            if isinstance(p, ast.Call) and _is_jit_call(p) and \
+                    p.args and p.args[0] is node:
+                continue
+            enclosing = _enclosing_functions(node, parents)
+            if not enclosing:
+                continue               # module scope: traced once
+            wrapper = (dotted_name(node.func) or "jit").split(".")[-1]
+            invoked = (isinstance(p, ast.Call) and p.func is node)
+            target = _jit_target(node)
+            in_builder = any(_is_builder(fn, parents)
+                             for fn in enclosing[:1])
+            fresh: Optional[str] = None
+            local_def: Optional[ast.AST] = None
+            if isinstance(target, ast.Lambda):
+                fresh = "a lambda"
+            elif isinstance(target, ast.Name):
+                for fn in enclosing:
+                    for stmt in ast.walk(fn):
+                        if isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)) \
+                                and stmt.name == target.id \
+                                and stmt is not fn:
+                            fresh = f"locally-defined `{target.id}`"
+                            local_def = stmt
+                            break
+                    if fresh:
+                        break
+            if fresh and not in_builder:
+                extra = (" and is immediately invoked — a full "
+                         "retrace on every call" if invoked else
+                         " — a fresh callable identity defeats the "
+                         "jit cache; hoist to module scope or cache "
+                         "the wrapped callable (plan-cache idiom)")
+                yield ctx.finding(
+                    self.code, node,
+                    f"{fresh} is passed to {wrapper}() inside a "
+                    f"function body{extra}")
+            elif invoked and not in_builder and fresh is None:
+                yield ctx.finding(
+                    self.code, node,
+                    f"{wrapper}(...) immediately invoked inside a "
+                    f"function body — the wrapper (and its trace "
+                    f"cache) is discarded after this call; hoist or "
+                    f"cache the wrapped callable")
+            # ndarray-constant capture: applies even to builders — the
+            # baked-in constant's identity is invisible to cache keys
+            if local_def is not None:
+                captured = set()
+                for fn in enclosing:
+                    captured |= _local_array_names(fn)
+                captured -= _local_array_names(local_def)
+                used = {n.id for n in ast.walk(local_def)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)}
+                hit = sorted(captured & used)
+                if hit:
+                    yield ctx.finding(
+                        self.code, node,
+                        f"jitted closure `{target.id}` captures "
+                        f"ndarray constant(s) {', '.join(hit)} from "
+                        f"the enclosing function — baked into the "
+                        f"trace, invisible to cache keys; pass as an "
+                        f"argument instead")

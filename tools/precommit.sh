@@ -20,6 +20,15 @@ else
     python -m compileall -q raft_tpu || fail=1
 fi
 
+# graftlint (ISSUE 6): the JAX/TPU-aware static-analysis gate — host
+# syncs in jit, retrace hazards, serve/comms lock discipline, missing
+# matmul precision, wall-clock misuse, metric-name taxonomy. Strict on
+# new code: only findings grandfathered in the checked-in baseline
+# pass (docs/static_analysis.md has the suppression/baseline workflow).
+echo "precommit: graftlint static analysis"
+python -m tools.graftlint --baseline tools/graftlint_baseline.json \
+    || fail=1
+
 echo "precommit: metric + span name taxonomy lint"
 python tools/check_metric_names.py || fail=1
 
