@@ -252,6 +252,11 @@ def child_main():
                     out[f"{fam}_fixed_cost_ms"] = r["fixed_cost_ms"]
                 if "plan_qps" in r:
                     out[f"{fam}_plan_qps"] = r["plan_qps"]
+                # the marginal-vs-end-to-end gap (ROADMAP item 2 /
+                # ISSUE 7): marginal_qps / plan_qps — the next green
+                # round reports it per family directly
+                if "marginal_gap" in r:
+                    out[f"{fam}_marginal_gap"] = r["marginal_gap"]
                 out[f"{fam}_recall"] = r.get("recall")
                 if "recall_estimator" in r:  # pq: rescored headline +
                     out[f"{fam}_recall_estimator"] = \

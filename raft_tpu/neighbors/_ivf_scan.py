@@ -221,6 +221,20 @@ def coarse_probes(queries, centers, n_probes: int, kind: str = "l2",
     return lax.top_k(-coarse, n_probes)[1]
 
 
+def count_coarse_fallback(n_probes: int, use_pallas: bool) -> None:
+    """Telemetry for the coarse-selection cliff: ``coarse_probes`` with
+    ``use_pallas=True`` but ``n_probes > 256`` silently falls back to
+    the full ``lax.top_k`` variadic sort (the Pallas ``select_k``
+    kernel's k ≤ 256 bound — tens of ms at serving widths, see
+    docs/performance.md "The coarse n_probes cliff"). Host-side only:
+    called once per search / plan build from the routing layers, never
+    from inside a trace (a traced increment would count per COMPILE,
+    not per call)."""
+    if use_pallas and n_probes > 256:
+        from raft_tpu import obs
+        obs.counter("raft.ivf_scan.coarse.fallback").inc()
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "cap", "chunk", "bins", "sqrt"))
 def inverted_scan(queries, data, norms, ids, probes, k: int, cap: int,
@@ -377,12 +391,13 @@ def gather_mode() -> str:
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
                                              "bins", "sqrt", "kind",
                                              "use_pallas", "gather",
-                                             "internal_dtype", "lc"))
+                                             "internal_dtype", "lc",
+                                             "fused"))
 def fused_list_search(queries, centers, data, norms, ids, scale, *,
                       k: int, n_probes: int, cap: int, bins: int,
                       sqrt: bool, kind: str, use_pallas: bool,
                       gather: str = "rows", internal_dtype=None,
-                      lc: int = 0):
+                      lc: int = 0, fused: bool = False):
     """Single-dispatch list-major IVF-Flat search: coarse probe GEMM +
     top-k, probe inversion, query gather, the list scan (Pallas kernel or
     XLA tier) and the candidate merge — ONE jitted computation. The
@@ -391,7 +406,11 @@ def fused_list_search(queries, centers, data, norms, ids, scale, *,
     platform each avoided dispatch saves ~22 ms, which is why the fused
     form, not the kernel, was the round-3 QPS lever. ``lc`` (static):
     kernel lists-per-grid-cell, 0 = auto — resolved by callers via
-    ``pallas_ivf_scan.lc_mode()`` outside jit so the cache keys on it."""
+    ``pallas_ivf_scan.lc_mode()`` outside jit so the cache keys on it.
+    ``fused`` (static, ``pallas_ivf_scan.fused_mode()`` resolved by
+    callers likewise): route the fine phase through the single-
+    pallas_call scan+select kernel — the top-k state stays resident in
+    VMEM and the scan → gather → select_k chain disappears (ISSUE 7)."""
     probes = coarse_probes(queries, centers, n_probes, kind=kind,
                            use_pallas=use_pallas)
     if use_pallas:
@@ -401,7 +420,7 @@ def fused_list_search(queries, centers, data, norms, ids, scale, *,
                                     sqrt=sqrt, metric=kind,
                                     gather=gather,
                                     internal_dtype=internal_dtype,
-                                    lc=lc)
+                                    lc=lc, fused=fused)
     # XLA tier scores the l2 core only; search() gates routing
     chunk = _chunk_size(ids.shape[0], cap, ids.shape[1])
     return inverted_scan(queries, data, norms, ids, probes, k, cap,

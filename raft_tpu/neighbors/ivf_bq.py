@@ -402,18 +402,20 @@ def extend(index: Index, new_vectors, new_indices=None, res=None
 
 @functools.partial(jax.jit, static_argnames=("kk", "bins", "n_probes",
                                              "cap", "gather", "kind",
-                                             "lc"))
+                                             "lc", "fused"))
 def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
                             norms2, scales, ids, *, kk: int, bins: int,
                             n_probes: int, cap: int,
                             gather: str = "rows", kind: str = "l2",
-                            lc: int = 0):
+                            lc: int = 0, fused: bool = False):
     """Kernel-tier single-dispatch device phase: the in-VMEM unpack
     scan (``pallas_ivf_scan.ivf_bq_scan_pallas``) reads the 1-bit codes
     straight from HBM — 8× less scan bandwidth than the XLA tier's
     materialized decode tiles. ``gather`` is the RAFT_TPU_GATHER
     strategy resolved OUTSIDE jit (the _ivf_scan contract); ``lc``
-    likewise (``pallas_ivf_scan.lc_mode``), 0 = auto."""
+    likewise (``pallas_ivf_scan.lc_mode``), 0 = auto; ``fused``
+    (``pallas_ivf_scan.fused_mode``) routes the fine phase through the
+    single-pallas_call scan+select kernel (ISSUE 7)."""
     from raft_tpu.neighbors import _ivf_scan as S
     from raft_tpu.ops.pallas_ivf_scan import ivf_bq_scan_pallas
     probes = S.coarse_probes(queries, centers, n_probes, kind=kind,
@@ -421,7 +423,8 @@ def _fused_bq_search_pallas(queries, centers, centers_rot, rot, bits,
     q_rot = queries @ rot.T
     return ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                               ids, probes, kk, cap, bins=bins,
-                              gather=gather, metric=kind, lc=lc)
+                              gather=gather, metric=kind, lc=lc,
+                              fused=fused)
 
 
 def _resolve(index: Index, queries, params: SearchParams,
@@ -622,23 +625,34 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
     obs.histogram("raft.ivf_bq.search.n_probes",
                   buckets=obs.SIZE_BUCKETS).observe(n_probes)
     sp.set_attrs(n_probes=n_probes, rescore=rescore)
+    from raft_tpu.neighbors._ivf_scan import count_coarse_fallback
+    count_coarse_fallback(n_probes, use_pallas)
     with obs.timed("raft.ivf_bq.search"):
         from raft_tpu.ops.compile_budget import run_tiers
-        from raft_tpu.ops.pallas_ivf_scan import lc_mode
+        from raft_tpu.ops.pallas_ivf_scan import fused_mode, lc_mode
 
-        def pallas_tier(lc):
+        def pallas_tier(lc, fz: bool = False):
             from raft_tpu.neighbors._ivf_scan import gather_mode
             return lambda: _fused_bq_search_pallas(
                 q, index.centers, index.centers_rot,
                 index.rotation_matrix, index.bits, index.norms2,
                 index.scales, index.lists_indices, kk=kk, bins=bins,
                 n_probes=n_probes, cap=cap, gather=gather_mode(),
-                kind=kind, lc=lc)
+                kind=kind, lc=lc, fused=fz)
 
-        # compile-budget ladder (ops/compile_budget.py): Pallas unpack
-        # scan → Pallas grid-per-list → the XLA decode-tile
+        # compile-budget ladder (ops/compile_budget.py): fused
+        # scan+select (ONE pallas_call fine phase, ISSUE 7) → Pallas
+        # unpack scan → Pallas grid-per-list → the XLA decode-tile
         # formulation (proven-compilable tail)
         tiers = []
+        fused_on = use_pallas and fused_mode() and kk <= 256
+        if fused_on:
+            obs.counter("raft.ivf_scan.fused.total",
+                        family="ivf_bq").inc()
+            obs.counter("raft.ivf_scan.fused.queries").inc(q.shape[0])
+            lc0f = lc_mode()
+            tiers.append((f"pallas_fused_lc{lc0f or 'auto'}",
+                          pallas_tier(lc0f, True)))
         if use_pallas:
             from raft_tpu.ops.pallas_ivf_scan import _pick_lc
             lc0 = lc_mode()
@@ -659,7 +673,8 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
         from raft_tpu.neighbors._ivf_scan import gather_mode
         shape_key = (f"ivf_bq[{q.shape[0]}x{index.dim},kk={kk},"
                      f"p={n_probes},cap={cap},L={index.n_lists},"
-                     f"bins={bins},{kind},g={gather_mode()}]")
+                     f"bins={bins},{kind},g={gather_mode()},"
+                     f"fz={fused_on}]")
         d_est, ids = run_tiers(shape_key, tiers)
         raw_dev = (resolve_raw_device(index, params.rescore_on_device)
                    if rescore else None)

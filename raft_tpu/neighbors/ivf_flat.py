@@ -467,14 +467,15 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
         if use_list:
             from raft_tpu.neighbors import _ivf_scan
             from raft_tpu.ops.compile_budget import run_tiers
-            from raft_tpu.ops.pallas_ivf_scan import lc_mode
+            from raft_tpu.ops.pallas_ivf_scan import fused_mode, lc_mode
             use_pallas = pallas_enabled()
+            _ivf_scan.count_coarse_fallback(n_probes, use_pallas)
             cap = _ivf_scan.resolve_cap(index.cap_cache, q,
                                         index.centers, params, n_probes,
                                         index.n_lists, kind=kind,
                                         use_pallas=use_pallas)
 
-            def fused(pallas: bool, lc: int = 0):
+            def fused(pallas: bool, lc: int = 0, fz: bool = False):
                 return lambda: _ivf_scan.fused_list_search(
                     q, index.centers, index.lists_data,
                     index.lists_norms, index.lists_indices,
@@ -483,15 +484,26 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
                     kind=kind, use_pallas=pallas,
                     gather=_ivf_scan.gather_mode(),
                     internal_dtype=params.internal_distance_dtype,
-                    lc=lc)
+                    lc=lc, fused=fz)
 
             # compile-budget ladder, structurally simplest LAST (see
-            # ops/compile_budget.py): Pallas kernel (auto or env lc) →
+            # ops/compile_budget.py): fused scan+select (ONE pallas_call
+            # fine phase, ISSUE 7) → Pallas kernel (auto or env lc) →
             # Pallas grid-per-list (loop-free body) → XLA inverted scan
             # (l2 core only) → probe-major eager scan (always
             # compiles — small per-probe programs)
             lc0 = lc_mode()
             tiers = []
+            # the resident state keeps k on sublanes; past the select_k
+            # bound the merge rounds stop paying for themselves — the
+            # unfused tiers cover large k
+            fused_on = use_pallas and fused_mode() and k <= 256
+            if fused_on:
+                obs.counter("raft.ivf_scan.fused.total",
+                            family="ivf_flat").inc()
+                obs.counter("raft.ivf_scan.fused.queries").inc(nq)
+                tiers.append((f"pallas_fused_lc{lc0 or 'auto'}",
+                              fused(True, lc0, True)))
             if use_pallas:
                 from raft_tpu.ops.pallas_ivf_scan import _pick_lc
                 tiers.append((f"pallas_lc{lc0 or 'auto'}",
@@ -523,7 +535,8 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
                          f"{kind},sqrt={sqrt},b={params.scan_bins},"
                          f"g={_ivf_scan.gather_mode()},"
                          f"idt={jnp.dtype(params.internal_distance_dtype).name},"
-                         f"dt={index.lists_data.dtype.name}]")
+                         f"dt={index.lists_data.dtype.name},"
+                         f"fz={fused_on}]")
             d, i = run_tiers(shape_key, tiers)
         else:
             d, i = _search_impl(q, index.centers, index.lists_data,

@@ -910,12 +910,14 @@ def _search_impl(queries, centers, centers_rot, rot, pq_centers, codes,
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "cap",
                                              "bins", "sqrt", "kind",
                                              "lut_dtype", "internal_dtype",
-                                             "per_cluster", "gather"))
+                                             "per_cluster", "gather",
+                                             "fused"))
 def _fused_code_search(q, centers, centers_rot, rot, pq_centers, codes,
                        code_norms, lists_indices, *, k: int,
                        n_probes: int, cap: int, bins: int, sqrt: bool,
                        kind: str, lut_dtype, internal_dtype,
-                       per_cluster: bool, gather: str = "rows"):
+                       per_cluster: bool, gather: str = "rows",
+                       fused: bool = False):
     """Single-dispatch code-resident search: coarse select_clusters,
     query rotation, the Pallas code scan and the candidate merge in ONE
     jitted computation (the reference search worker is likewise one
@@ -931,7 +933,7 @@ def _fused_code_search(q, centers, centers_rot, rot, pq_centers, codes,
         q_rot, centers_rot, pq_centers, codes, code_norms, lists_indices,
         probes, k, cap, bins=bins, sqrt=sqrt, lut_dtype=lut_dtype,
         internal_distance_dtype=internal_dtype, metric=kind,
-        per_cluster=per_cluster, gather=gather)
+        per_cluster=per_cluster, gather=gather, fused=fused)
 
 
 # guards the lazy reconstruction-cache materialization: ladder
@@ -1126,6 +1128,8 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
     if scan_mode == "codes":
         from raft_tpu.neighbors import _ivf_scan
         from raft_tpu.ops.compile_budget import run_tiers
+        from raft_tpu.ops.pallas_ivf_scan import fused_mode
+        _ivf_scan.count_coarse_fallback(n_probes, True)
         # RAII scope (reference nvtx range in search, ivf_pq_search.cuh:
         # 1263), exception-safe; obs.timed opens the trace range AND the
         # wall-time histogram under one taxonomy name
@@ -1137,8 +1141,8 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
             code_norms = _ensure_code_norms(index, params, per_cluster,
                                             kind)
 
-            def codes_tier():
-                return _fused_code_search(
+            def codes_tier(fz: bool = False):
+                return lambda: _fused_code_search(
                     q, index.centers, index.centers_rot,
                     index.rotation_matrix, index.pq_centers, index.codes,
                     code_norms, index.lists_indices, k=kk,
@@ -1146,14 +1150,24 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
                     sqrt=dev_sqrt, kind=kind, lut_dtype=params.lut_dtype,
                     internal_dtype=params.internal_distance_dtype,
                     per_cluster=per_cluster,
-                    gather=_ivf_scan.gather_mode())
+                    gather=_ivf_scan.gather_mode(), fused=fz)
 
-            # compile-budget ladder (ops/compile_budget.py): the Pallas
-            # code scan, then the reconstruct-cache XLA formulations
-            # (which trade the codes' memory footprint for a proven
-            # program shape). NOTE the fallbacks score bf16
-            # reconstructions — same recall class, not bit-identical.
-            tiers = [("pallas_codes", codes_tier)]
+            # compile-budget ladder (ops/compile_budget.py): the fused
+            # scan+select code kernel (ONE pallas_call fine phase,
+            # ISSUE 7), the unfused Pallas code scan, then the
+            # reconstruct-cache XLA formulations (which trade the
+            # codes' memory footprint for a proven program shape).
+            # NOTE the fallbacks score bf16 reconstructions — same
+            # recall class, not bit-identical.
+            fused_on = fused_mode() and kk <= 256
+            tiers = []
+            if fused_on:
+                obs.counter("raft.ivf_scan.fused.total",
+                            family="ivf_pq").inc()
+                obs.counter("raft.ivf_scan.fused.queries").inc(
+                    q.shape[0])
+                tiers.append(("pallas_fused_codes", codes_tier(True)))
+            tiers.append(("pallas_codes", codes_tier()))
             if kind == "l2":
                 tiers.append(("xla_reconstruct_list", _recon_list))
             tiers.append(("reconstruct_probe_major", _recon_probe))
@@ -1166,7 +1180,8 @@ def _search_spanned(index: Index, queries, k: int, params, res, sp
                          f"lut={jnp.dtype(params.lut_dtype).name},"
                          f"idt={jnp.dtype(params.internal_distance_dtype).name},"
                          f"pc={per_cluster},"
-                         f"g={_ivf_scan.gather_mode()}]")
+                         f"g={_ivf_scan.gather_mode()},"
+                         f"fz={fused_on}]")
             d, i = run_tiers(shape_key, tiers)
         return _epilogue(d, i)
     if scan_mode == "reconstruct":

@@ -199,7 +199,7 @@ def _flat_builder(index, k: int, params):
     from raft_tpu.neighbors.ivf_flat import (_metric_kind, _postprocess,
                                              _search_impl)
     from raft_tpu.ops.dispatch import pallas_enabled
-    from raft_tpu.ops.pallas_ivf_scan import lc_mode
+    from raft_tpu.ops.pallas_ivf_scan import fused_mode, lc_mode
 
     n_probes = min(params.n_probes, index.n_lists)
     kind = _metric_kind(index.metric)
@@ -215,6 +215,13 @@ def _flat_builder(index, k: int, params):
                                                  index.n_lists))))
         gather = _ivf_scan.gather_mode()
         lc = lc_mode()
+        # fused scan+select tier (ISSUE 7): the plan compiles the ONE-
+        # pallas_call fine phase — zero new steady-state compiles, the
+        # ladder machinery rides the same build_plan path unchanged
+        use_fused = use_pallas and fused_mode() and k <= 256
+        if use_list and use_fused:
+            obs.counter("raft.ivf_scan.fused.total",
+                        family="ivf_flat").inc()
 
         def fn(q, centers, data, norms, ids, scale):
             if index.metric == DistanceType.CosineExpanded:
@@ -227,7 +234,7 @@ def _flat_builder(index, k: int, params):
                     sqrt=sqrt, kind=kind, use_pallas=use_pallas,
                     gather=gather,
                     internal_dtype=params.internal_distance_dtype,
-                    lc=lc)
+                    lc=lc, fused=use_fused)
             else:
                 d, i = _search_impl(q, centers, data, ids, norms, scale,
                                     k, n_probes, sqrt, kind=kind)
@@ -235,7 +242,8 @@ def _flat_builder(index, k: int, params):
 
         operands = (index.centers, index.lists_data, index.lists_norms,
                     index.lists_indices, jnp.float32(index.scale))
-        key_bits = (use_list, use_pallas, gather, lc, params.scan_bins,
+        key_bits = (use_list, use_pallas, use_fused, gather, lc,
+                    params.scan_bins,
                     jnp.dtype(params.internal_distance_dtype).name,
                     index.lists_data.dtype.name)
         return fn, operands, None, key_bits
@@ -290,9 +298,14 @@ def _pq_builder(index, k: int, params):
     def make(nq: int, cap: int):
         host_epilogue = None
         if scan_mode == "codes":
+            from raft_tpu.ops.pallas_ivf_scan import fused_mode
             code_norms = ivf_pq._ensure_code_norms(index, params,
                                                    per_cluster, kind)
             gather = _ivf_scan.gather_mode()
+            use_fused = fused_mode() and kk <= 256
+            if use_fused:
+                obs.counter("raft.ivf_scan.fused.total",
+                            family="ivf_pq").inc()
 
             def device_phase(q, centers, centers_rot, rot, books, codes,
                              norms, ids):
@@ -302,12 +315,13 @@ def _pq_builder(index, k: int, params):
                     sqrt=dev_sqrt, kind=kind,
                     lut_dtype=params.lut_dtype,
                     internal_dtype=params.internal_distance_dtype,
-                    per_cluster=per_cluster, gather=gather)
+                    per_cluster=per_cluster, gather=gather,
+                    fused=use_fused)
 
             operands = [index.centers, index.centers_rot,
                         index.rotation_matrix, index.pq_centers,
                         index.codes, code_norms, index.lists_indices]
-            key_bits = ("codes", gather,
+            key_bits = ("codes", gather, use_fused,
                         jnp.dtype(params.lut_dtype).name,
                         jnp.dtype(params.internal_distance_dtype).name,
                         bins, kk, rescoring, raw_dev is not None)
@@ -387,6 +401,7 @@ def _bq_builder(index, k: int, params):
                if rescoring else None)
 
     def make(nq: int, cap: int):
+        from raft_tpu.ops.pallas_ivf_scan import fused_mode
         bins = min(params.scan_bins
                    or max(128, (32 * kk) // max(n_probes, 1)), max_list)
         chunk = min(
@@ -396,6 +411,10 @@ def _bq_builder(index, k: int, params):
                 max(1, (64 << 20) // max(1, max_list * index.dim * 2))))
         gather = _ivf_scan.gather_mode()
         lc = lc_mode()
+        use_fused = use_pallas and fused_mode() and kk <= 256
+        if use_fused:
+            obs.counter("raft.ivf_scan.fused.total",
+                        family="ivf_bq").inc()
 
         def device_phase(q, centers, centers_rot, rot, bits, norms2,
                          scales, ids):
@@ -403,7 +422,7 @@ def _bq_builder(index, k: int, params):
                 return ivf_bq._fused_bq_search_pallas(
                     q, centers, centers_rot, rot, bits, norms2, scales,
                     ids, kk=kk, bins=bins, n_probes=n_probes, cap=cap,
-                    gather=gather, kind=kind, lc=lc)
+                    gather=gather, kind=kind, lc=lc, fused=use_fused)
             return ivf_bq._fused_bq_search(
                 q, centers, centers_rot, rot, bits, norms2, scales,
                 ids, kk=kk, bins=bins, n_probes=n_probes, cap=cap,
@@ -435,8 +454,8 @@ def _bq_builder(index, k: int, params):
 
         if raw_dev is not None:
             operands.append(raw_dev)
-        key_bits = (use_pallas, gather, lc, bins, chunk, kk, rescoring,
-                    raw_dev is not None)
+        key_bits = (use_pallas, use_fused, gather, lc, bins, chunk, kk,
+                    rescoring, raw_dev is not None)
         return fn, tuple(operands), host_epilogue, key_bits
 
     return make, n_probes, kind, use_pallas
@@ -507,6 +526,7 @@ def build_plan(index, queries, k: int, params=None,
             q.shape)
     nq = q.shape[0]
     make, n_probes, kind, use_pallas_coarse = builder(index, k, params)
+    _ivf_scan.count_coarse_fallback(n_probes, use_pallas_coarse)
     with spans.span("raft.plan.build", family=family, nq=nq,
                     k=k) as bsp, \
             obs.timed("raft.plan.build", family=family):

@@ -25,6 +25,16 @@ Each list's rows are read from HBM exactly once per query batch; the
 (max_list, cap) score block lives and dies in VMEM — the property the
 reference's fused kernel has on GPU. Candidates are gathered back
 per (query, probe) and merged with the exact Pallas ``select_k``.
+
+The FUSED tier (``fused=True`` / ``RAFT_TPU_IVF_FUSED``, ISSUE 7) goes
+one step further: the per-query top-k state stays resident in VMEM
+across the list grid (the ``_select_kernel`` output-block-revisiting
+trick, filtered-merge early-skip included), so the candidate tensor
+never reaches HBM and the whole fine phase — scan, scatter, select —
+is ONE ``pallas_call`` where the unfused path needs three dispatches
+(scan kernel → XLA gather → select_k kernel). This is the in-kernel
+``block_sort`` of the reference's ``interleaved_scan_kernel``
+(``ivf_flat_search.cuh:665``) rebuilt for the list-major TPU geometry.
 """
 
 from __future__ import annotations
@@ -43,53 +53,64 @@ from raft_tpu.ops._util import (BIG_I32 as _BIG_I32,
 from raft_tpu.core.precision import kernel_matmul_mode
 
 
+def _flat_list_candidates(scale, q, y, norms_l, ids, *, bins: int,
+                          metric: str, precision):
+    """One IVF-Flat list's binned candidates — the shared per-list body
+    of the unfused scan kernel (which writes the blocks to HBM for a
+    separate merge dispatch) and the fused scan+select kernel (which
+    merges them straight into the VMEM-resident top-k state).
+
+    ``q`` (cap, dim) probing queries, ``y`` (ML, dim) list rows,
+    ``norms_l``/``ids`` (ML,) → ``(cd (bins, cap), ci (bins, cap))``.
+    """
+    ml = y.shape[0]
+    cap = q.shape[0]
+    if y.dtype == jnp.bfloat16:
+        ip = jax.lax.dot_general(
+            y, q.astype(jnp.bfloat16), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    elif y.dtype == jnp.int8:
+        # int8 rides the MXU as bf16 (exact for |v| ≤ 127); the
+        # kDivisor-style scale folds into the accumulated product
+        ip = scale * jax.lax.dot_general(
+            y.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        ip = dot_nt_f32(y, q, precision)             # (ML, cap)
+    ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
+    if metric == "ip":
+        # similarity → negate: smaller-is-better uniformly (the
+        # reference's max-heap IP routing, fused_l2_knn.cuh:947)
+        d = jnp.where(ids_b >= 0, -ip, jnp.inf)
+    else:
+        qq = jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32),
+                     axis=1)[None, :]                # (1, cap)
+        d = norms_l[:, None] + qq - 2.0 * ip
+        d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
+
+    # STRIDED bins (row r → bin r % B): bucketized rows follow
+    # dataset order, so a query's true neighbors sit in adjacent
+    # rows — contiguous bins would collide them (measured 0.87 vs
+    # 0.99+ recall on clustered data); striding decorrelates free
+    w = ml // bins
+    db_ = d.reshape(w, bins, cap)
+    cd = jnp.min(db_, axis=0)                        # (B, cap)
+    rb = ids_b.reshape(w, bins, cap)
+    ci = jnp.min(jnp.where(db_ == cd[None, :, :], rb, _BIG_I32),
+                 axis=0)
+    return cd, jnp.where(ci == _BIG_I32, -1, ci)
+
+
 def _list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref, ids_ref,
                       cd_ref, ci_ref, *, lc: int, bins: int, metric: str,
                       precision):
     scale = scale_ref[0, 0]
 
     def one_list(l):
-        q = qsub_ref[l]                                  # (cap, dim)
-        y = data_ref[l]                                  # (ML, dim)
-        ml = y.shape[0]
-        cap = q.shape[0]
-        norms_l = norms_ref[l, 0]                        # (ML,)
-        ids = ids_ref[l, 0]                              # (ML,) int32
-        if y.dtype == jnp.bfloat16:
-            ip = jax.lax.dot_general(
-                y, q.astype(jnp.bfloat16), (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        elif y.dtype == jnp.int8:
-            # int8 rides the MXU as bf16 (exact for |v| ≤ 127); the
-            # kDivisor-style scale folds into the accumulated product
-            ip = scale * jax.lax.dot_general(
-                y.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        else:
-            ip = dot_nt_f32(y, q, precision)             # (ML, cap)
-        ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
-        if metric == "ip":
-            # similarity → negate: smaller-is-better uniformly (the
-            # reference's max-heap IP routing, fused_l2_knn.cuh:947)
-            d = jnp.where(ids_b >= 0, -ip, jnp.inf)
-        else:
-            qq = jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32),
-                         axis=1)[None, :]                # (1, cap)
-            d = norms_l[:, None] + qq - 2.0 * ip
-            d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
-
-        # STRIDED bins (row r → bin r % B): bucketized rows follow
-        # dataset order, so a query's true neighbors sit in adjacent
-        # rows — contiguous bins would collide them (measured 0.87 vs
-        # 0.99+ recall on clustered data); striding decorrelates free
-        w = ml // bins
-        db_ = d.reshape(w, bins, cap)
-        cd = jnp.min(db_, axis=0)                        # (B, cap)
-        rb = ids_b.reshape(w, bins, cap)
-        ci = jnp.min(jnp.where(db_ == cd[None, :, :], rb, _BIG_I32),
-                     axis=0)
-        ci = jnp.where(ci == _BIG_I32, -1, ci)
+        cd, ci = _flat_list_candidates(
+            scale, qsub_ref[l], data_ref[l], norms_ref[l, 0],
+            ids_ref[l, 0], bins=bins, metric=metric, precision=precision)
         cd_ref[l] = cd.astype(cd_ref.dtype)
         ci_ref[l] = ci
 
@@ -191,6 +212,373 @@ def _pick_lc(n_lists: int, max_list: int, cap: int, dim: int,
     return lc
 
 
+# ---------------------------------------------------------------------------
+# Fused scan + select-k (ISSUE 7): the list scan keeps a running
+# per-query top-k state RESIDENT IN VMEM across the list-chunk grid
+# dimension — the same output-block-revisiting trick `_select_kernel`
+# uses across candidate tiles, including its filtered-merge early-skip —
+# so the (n_lists, bins, cap) candidate tensor never reaches HBM and
+# the scan → gather → select_k chain collapses from three dispatches
+# (two pallas_calls + an XLA gather) to ONE pallas_call.
+# ---------------------------------------------------------------------------
+
+# finite stand-in for +inf through the scatter matmul (inf · 0 = NaN
+# would poison the one-hot accumulation); far above any real distance
+_BIG_F32 = 3.0e38
+
+
+def fused_mode() -> bool:
+    """Resolve the ``RAFT_TPU_IVF_FUSED`` routing flag OUTSIDE jit (the
+    ``lc_mode()``/``gather_mode()`` contract): callers thread it through
+    the fused searches as a static argument so the jit cache keys on it.
+    Default ON — the unfused Pallas / XLA tiers stay in the
+    compile-budget ladder as fallbacks."""
+    import os
+    return os.environ.get("RAFT_TPU_IVF_FUSED", "1").lower() \
+        not in ("0", "never", "off")
+
+
+def _merge_state(od_ref, oi_ref, cd, ci, qm, *, k: int, cap_axis: int):
+    """Scatter one list's candidate block onto the per-query running
+    top-k state resident in the revisited ``(kp, nqp)`` output block,
+    then an exact filtered merge.
+
+    ``cd``/``ci`` carry the probing-slot axis at ``cap_axis`` (flat/bq
+    bin-major ``(bins, cap)`` → 1; pq slot-major ``(cap, bins)`` → 0);
+    ``qm`` (cap,) holds the list's probing-query ids (−1 pad). The
+    scatter rides the MXU as one-hot × candidates: each list's slot →
+    query map is injective (a query probes a list at most once), so
+    every output lane receives EXACTLY one slot's value and, at
+    ``Precision.HIGHEST``, the permutation is exact (products with 1.0,
+    single nonzero per accumulation — even the 3×bf16 decomposition
+    reconstructs f32 exactly). Ids split into f32-exact halves
+    (``id >> 12`` and ``id & 0xFFF`` are both < 2^24 for id < 2^31;
+    the −1 sentinel round-trips: (−1)·4096 + 4095 = −1). Lanes no slot
+    maps to read ``_BIG_F32``/−1 and lose every merge; callers mask
+    id < 0 → +inf after the final grid step.
+
+    The merge is the ``_select_kernel`` filtered merge verbatim: if no
+    scattered candidate beats any lane's current k-th best, the list is
+    skipped after one vectorized compare; otherwise k rounds of
+    (min, argmin-by-row, invalidate) over the concatenated
+    [state; candidates] block re-sort the state in place.
+    """
+    nqp = od_ref.shape[1]
+    cap = qm.shape[0]
+    iq = jax.lax.broadcasted_iota(jnp.int32, (cap, nqp), 1)
+    oh = ((qm[:, None] == iq) & (qm[:, None] >= 0)).astype(jnp.float32)
+    mapped = jnp.max(oh, axis=0, keepdims=True) > 0.0    # (1, nqp)
+    cn = (((cap_axis,), (0,)), ((), ()))
+    hp = jax.lax.Precision.HIGHEST
+    cdf = jnp.minimum(cd.astype(jnp.float32), _BIG_F32)
+    sd = jax.lax.dot_general(cdf, oh, cn, precision=hp,
+                             preferred_element_type=jnp.float32)
+    hi = jax.lax.dot_general((ci >> 12).astype(jnp.float32), oh, cn,
+                             precision=hp,
+                             preferred_element_type=jnp.float32)
+    lo = jax.lax.dot_general((ci & 0xFFF).astype(jnp.float32), oh, cn,
+                             precision=hp,
+                             preferred_element_type=jnp.float32)
+    si = hi.astype(jnp.int32) * 4096 + lo.astype(jnp.int32)
+    sd = jnp.where(mapped, sd, _BIG_F32)                 # (B, nqp)
+    si = jnp.where(mapped, si, -1)
+    b = sd.shape[0]
+
+    kth = od_ref[k - 1:k, :]                             # (1, nqp)
+
+    @pl.when(jnp.any(sd < kth))
+    def _():
+        c_d = jnp.concatenate([od_ref[0:k, :], sd], axis=0)
+        c_i = jnp.concatenate([oi_ref[0:k, :], si], axis=0)
+        ri = jax.lax.broadcasted_iota(jnp.int32, (k + b, nqp), 0)
+
+        def round_(r, carry):
+            cdd, cii = carry
+            m_ = jnp.min(cdd, axis=0, keepdims=True)     # (1, nqp)
+            first = jnp.min(jnp.where(cdd == m_, ri, _BIG_I32), axis=0,
+                            keepdims=True)
+            sel = ri == first                            # one-hot/lane
+            idx = jnp.sum(jnp.where(sel, cii, 0), axis=0, keepdims=True)
+            od_ref[pl.dslice(r, 1), :] = m_
+            oi_ref[pl.dslice(r, 1), :] = idx
+            return jnp.where(sel, jnp.inf, cdd), cii
+
+        jax.lax.fori_loop(0, k, round_, (c_d, c_i), unroll=False)
+
+
+def _init_state(od_ref, oi_ref):
+    """First-grid-step init of the revisited top-k state block."""
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        od_ref[...] = jnp.full(od_ref.shape, jnp.inf, od_ref.dtype)
+        oi_ref[...] = jnp.full(oi_ref.shape, -1, jnp.int32)
+
+
+def _finish_fused(od, oi, nq: int, k: int, sqrt: bool):
+    """Tail of the fused scan+select calls: slice the resident state
+    back to (nq, k) and apply the ``merge_candidates`` output
+    conventions (id −1 ⇒ +inf distance, optional sqrt)."""
+    d = od[:k, :nq].T
+    i = oi[:k, :nq].T
+    d = jnp.where(i >= 0, d, jnp.inf)
+    if sqrt:
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+    return d, i
+
+
+def _pick_lc_fused(n_lists: int, max_list: int, cap: int, dim: int,
+                   itemsize: int, k: int, nq: int, bins: int,
+                   override: int = 0) -> int:
+    """``_pick_lc`` with the fused kernel's extra VMEM residents: the
+    (kp, nqp) state blocks (revisited outputs — live the whole grid)
+    and the per-list scatter/merge temporaries (one-hot, scattered
+    halves, merge concat). The temporaries don't scale with lc (the
+    fori body reuses them) but they shrink the per-list budget."""
+    if override > 0:
+        lc = min(override, n_lists)
+        while n_lists % lc:
+            lc -= 1
+        return lc
+    kp = _round_up(k, 8)
+    nqp = _round_up(nq, 128)
+    fixed = (2 * kp * nqp * 8          # d+id state blocks
+             + cap * nqp * 4           # one-hot
+             + 3 * bins * nqp * 4      # scattered d / id halves
+             + (k + bins) * nqp * 8)   # merge concat block
+    per_list = (max_list * dim * itemsize
+                + cap * dim * 4
+                + max_list * cap * 4
+                + max_list * (4 + 4))
+    budget = max((_VMEM_LIMIT // 3) - fixed, 0)
+    lc = max(1, min(8, budget // max(per_list, 1)))
+    while n_lists % lc:
+        lc -= 1
+    return lc
+
+
+def _fused_list_scan_kernel(scale_ref, qsub_ref, data_ref, norms_ref,
+                            ids_ref, qmap_ref, od_ref, oi_ref, *,
+                            lc: int, bins: int, k: int, metric: str,
+                            precision):
+    """IVF-Flat fine phase as ONE program: per list, the shared scoring
+    + binned-candidate body, merged straight into the resident state."""
+    scale = scale_ref[0, 0]
+    _init_state(od_ref, oi_ref)
+
+    def one_list(l):
+        cd, ci = _flat_list_candidates(
+            scale, qsub_ref[l], data_ref[l], norms_ref[l, 0],
+            ids_ref[l, 0], bins=bins, metric=metric, precision=precision)
+        _merge_state(od_ref, oi_ref, cd, ci, qmap_ref[l, 0], k=k,
+                     cap_axis=1)
+
+    if lc == 1:
+        one_list(0)
+    else:
+        jax.lax.fori_loop(0, lc, lambda l, c: (one_list(l), c)[1], 0)
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "lc", "k", "nq",
+                                             "metric", "interpret"))
+def _fused_list_scan_call(qsub, data, norms, ids, qmap, bins: int,
+                          lc: int, k: int, nq: int, scale,
+                          interpret: bool, metric: str = "l2"):
+    n_lists, cap, dim = qsub.shape
+    max_list = data.shape[1]
+    gc = n_lists // lc
+    kp = _round_up(k, 8)
+    nqp = _round_up(nq, 128)
+    kern = functools.partial(
+        _fused_list_scan_kernel, lc=lc, bins=bins, k=k, metric=metric,
+        precision=kernel_matmul_mode(interpret))
+    scale_arr = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    norms3 = norms[:, None, :]
+    ids3 = ids[:, None, :]
+    qmap3 = qmap[:, None, :]
+    od, oi = pl.pallas_call(
+        kern,
+        grid=(gc,),
+        in_specs=[pl.BlockSpec((1, 1), lambda g: (0, 0)),
+                  pl.BlockSpec((lc, cap, dim), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, max_list, dim), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, cap), lambda g: (g, 0, 0))],
+        # the whole (kp, nqp) state is ONE block revisited by every
+        # grid step (constant index map) — it stays in VMEM across the
+        # list grid and is written back once
+        out_specs=[pl.BlockSpec((kp, nqp), lambda g: (0, 0)),
+                   pl.BlockSpec((kp, nqp), lambda g: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((kp, nqp), jnp.float32),
+                   jax.ShapeDtypeStruct((kp, nqp), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_lists * max_list * cap * dim
+            + 6 * n_lists * bins * cap * nqp,
+            bytes_accessed=(data.dtype.itemsize * n_lists * max_list * dim
+                            + 4 * n_lists * cap * dim + 8 * kp * nqp),
+            transcendentals=0),
+        interpret=interpret,
+    )(scale_arr, qsub, data, norms3, ids3, qmap3)
+    return od, oi
+
+
+def _fused_bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref,
+                          ids_ref, qmap_ref, cent_ref, od_ref, oi_ref, *,
+                          lc: int, bins: int, dim: int, k: int,
+                          metric: str):
+    _init_state(od_ref, oi_ref)
+
+    def one_list(l):
+        cd, ci = _bq_list_candidates(
+            qsub_ref[l], bits_ref[l], norms2_ref[l, 0], scales_ref[l, 0],
+            ids_ref[l, 0], bins=bins, dim=dim, metric=metric)
+        if metric == "ip":
+            # the per-(list, slot) center term −q·c_l — the unfused
+            # tier's post-scan rank-1 correction applied in-kernel:
+            # constant per slot, so it commutes with the binned min
+            corr = jnp.sum(qsub_ref[l] * cent_ref[l, 0][None, :], axis=1)
+            cd = cd - corr[None, :]
+        _merge_state(od_ref, oi_ref, cd, ci, qmap_ref[l, 0], k=k,
+                     cap_axis=1)
+
+    if lc == 1:
+        one_list(0)
+    else:
+        jax.lax.fori_loop(0, lc, lambda l, c: (one_list(l), c)[1], 0)
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "lc", "dim", "k",
+                                             "nq", "interpret", "metric"))
+def _fused_bq_scan_call(qsub, bits_i32, norms2, scales, ids, qmap,
+                        centers_rot, bins: int, lc: int, dim: int,
+                        k: int, nq: int, interpret: bool,
+                        metric: str = "l2"):
+    n_lists, cap, _ = qsub.shape
+    max_list = bits_i32.shape[1]
+    w = bits_i32.shape[2]
+    gc = n_lists // lc
+    kp = _round_up(k, 8)
+    nqp = _round_up(nq, 128)
+    kern = functools.partial(_fused_bq_scan_kernel, lc=lc, bins=bins,
+                             dim=dim, k=k, metric=metric)
+    norms3 = norms2[:, None, :]
+    scales3 = scales[:, None, :]
+    ids3 = ids[:, None, :]
+    qmap3 = qmap[:, None, :]
+    cent3 = centers_rot[:, None, :]
+    od, oi = pl.pallas_call(
+        kern,
+        grid=(gc,),
+        in_specs=[pl.BlockSpec((lc, cap, dim), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, max_list, w), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, cap), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((lc, 1, dim), lambda g: (g, 0, 0))],
+        out_specs=[pl.BlockSpec((kp, nqp), lambda g: (0, 0)),
+                   pl.BlockSpec((kp, nqp), lambda g: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((kp, nqp), jnp.float32),
+                   jax.ShapeDtypeStruct((kp, nqp), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_lists * max_list * cap * dim
+            + 6 * n_lists * bins * cap * nqp,
+            bytes_accessed=(4 * n_lists * max_list * w
+                            + 4 * n_lists * cap * dim + 8 * kp * nqp),
+            transcendentals=0),
+        interpret=interpret,
+    )(qsub, bits_i32, norms3, scales3, ids3, qmap3, cent3)
+    return od, oi
+
+
+def _fused_pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref,
+                          books_ref, qmap_ref, cent_ref, od_ref, oi_ref,
+                          *, bins: int, k: int, metric: str, pq_dim: int,
+                          pq_len: int, n_codes: int, lut_dtype,
+                          per_cluster: bool):
+    _init_state(od_ref, oi_ref)
+    cd, ci = _pq_cell_candidates(
+        qsub_ref[0], codes_ref[0], norms_ref[0, 0], ids_ref[0, 0],
+        books_ref, bins=bins, metric=metric, pq_dim=pq_dim,
+        pq_len=pq_len, n_codes=n_codes, lut_dtype=lut_dtype,
+        per_cluster=per_cluster)
+    if metric == "ip":
+        # −q·c_l in-kernel (see _fused_bq_scan_kernel); for PER_CLUSTER
+        # both operands arrive p-major permuted — the dot is invariant
+        corr = jnp.sum(qsub_ref[0] * cent_ref[0, 0][None, :], axis=1)
+        cd = cd - corr[:, None]
+    _merge_state(od_ref, oi_ref, cd, ci, qmap_ref[0, 0], k=k, cap_axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "k", "nq", "metric",
+                                             "lut_dtype", "interpret",
+                                             "split", "per_cluster"))
+def _fused_pq_scan_call(qsub, codes_t, norms, ids, books, qmap,
+                        centers_rot, bins: int, k: int, nq: int,
+                        interpret: bool, metric: str, lut_dtype,
+                        split: int = 1, per_cluster: bool = False):
+    """The fused tail of the code scan: same grid/operands as
+    ``_pq_scan_call`` (incl. the ``split`` sub-cell sharing of a list's
+    query/qmap blocks via ``g // split``) plus the qmap and rotated
+    centers, with the candidate blocks replaced by the revisited
+    state."""
+    n_lists, cap, rot_dim = qsub.shape
+    n_cells, pq_dim, max_list = codes_t.shape
+    kp = _round_up(k, 8)
+    nqp = _round_up(nq, 128)
+    if per_cluster:
+        n_codes, pq_len = books.shape[1], books.shape[2]
+        books_spec = pl.BlockSpec((1, n_codes, pq_len),
+                                  lambda g: (g // split, 0, 0))
+    else:
+        n_codes = books.shape[1] // pq_dim
+        pq_len = rot_dim // pq_dim
+        books_spec = pl.BlockSpec((rot_dim, pq_dim * n_codes),
+                                  lambda g: (0, 0))
+    kern = functools.partial(
+        _fused_pq_scan_kernel, bins=bins, k=k, metric=metric,
+        pq_dim=pq_dim, pq_len=pq_len, n_codes=n_codes,
+        lut_dtype=jnp.dtype(lut_dtype), per_cluster=per_cluster)
+    norms3 = norms[:, None, :]
+    ids3 = ids[:, None, :]
+    qmap3 = qmap[:, None, :]
+    cent3 = centers_rot[:, None, :]
+    od, oi = pl.pallas_call(
+        kern,
+        grid=(n_cells,),
+        in_specs=[pl.BlockSpec((1, cap, rot_dim),
+                               lambda g: (g // split, 0, 0)),
+                  pl.BlockSpec((1, pq_dim, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((1, 1, max_list), lambda g: (g, 0, 0)),
+                  pl.BlockSpec((1, 1, max_list), lambda g: (g, 0, 0)),
+                  books_spec,
+                  pl.BlockSpec((1, 1, cap), lambda g: (g // split, 0, 0)),
+                  pl.BlockSpec((1, 1, rot_dim),
+                               lambda g: (g // split, 0, 0))],
+        out_specs=[pl.BlockSpec((kp, nqp), lambda g: (0, 0)),
+                   pl.BlockSpec((kp, nqp), lambda g: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((kp, nqp), jnp.float32),
+                   jax.ShapeDtypeStruct((kp, nqp), jnp.int32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_cells * max_list * rot_dim * pq_dim * n_codes
+            + 2 * n_cells * max_list * cap * rot_dim
+            + 6 * n_cells * bins * cap * nqp,
+            bytes_accessed=(n_cells * max_list * pq_dim
+                            + 4 * n_lists * cap * rot_dim + 8 * kp * nqp),
+            transcendentals=0),
+        interpret=interpret,
+    )(qsub, jax.lax.bitcast_convert_type(codes_t, jnp.int8), norms3,
+      ids3, books, qmap3, cent3)
+    return od, oi
+
+
 class _Layout:
     """Shared prologue of both list-major scans: bins resolution, probe
     inversion, list-axis padding to a bins multiple, lane-aligned
@@ -246,7 +634,8 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
                          probes, k: int, cap: int, scale=1.0,
                          bins: int = 0, sqrt: bool = False,
                          metric: str = "l2", gather: str = "",
-                         internal_dtype=None, lc: int = 0):
+                         internal_dtype=None, lc: int = 0,
+                         fused: bool = False):
     """Fused list-major IVF-Flat fine scan + merge.
 
     ``queries`` (nq, dim) f32; ``lists_data`` (n_lists, max_list, dim)
@@ -255,8 +644,10 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     ``metric``: "l2" (squared, ``sqrt`` optional) or "ip" (returns
     NEGATED similarities, ascending — callers postprocess). ``lc``:
     lists per grid cell, 0 = auto (callers resolve ``lc_mode()``
-    outside jit). Returns (dists (nq, k), ids (nq, k)) sorted
-    best-first.
+    outside jit). ``fused``: keep the top-k state resident in the scan
+    kernel (ONE pallas_call — no candidate tensor, no gather, no
+    select_k dispatch; callers resolve ``fused_mode()`` outside jit).
+    Returns (dists (nq, k), ids (nq, k)) sorted best-first.
     """
     nq, dim = queries.shape
     n_lists, max_list = lists_indices.shape
@@ -271,6 +662,15 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
     # callers pass it resolved (``gather``) so the env isn't trace-frozen
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
     qsub = gather_query_rows(queries, lay.padded_qmap(), mode=gather)
+    if fused:
+        lc = _pick_lc_fused(n_lists, lay.mlp, lay.capp, dim,
+                            lists_data.dtype.itemsize, k, nq, lay.bins,
+                            override=lc)
+        od, oi = _fused_list_scan_call(
+            qsub, lists_data, lists_norms, lists_indices,
+            lay.padded_qmap(), lay.bins, lc, k, nq, scale,
+            pallas_interpret(), metric=metric)
+        return _finish_fused(od, oi, nq, k, sqrt)
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim,
                   lists_data.dtype.itemsize, override=lc)
     # internal_dtype: candidate-block dtype carried to the merge (the
@@ -281,6 +681,57 @@ def ivf_list_scan_pallas(queries, lists_data, lists_norms, lists_indices,
                              metric=metric,
                              out_dtype=internal_dtype or jnp.float32)
     return lay.merge(cd, ci, probes, k, sqrt)
+
+
+def _bq_list_candidates(q, words, n2_l, sc_l, ids, *, bins: int,
+                        dim: int, metric: str):
+    """One BQ list's binned estimator candidates (the shared per-list
+    body — see ``_flat_list_candidates``). ``q`` (cap, dim) f32 probing
+    queries (center-offset for the l2 core), ``words`` (ML, w) int32
+    bit payload → ``(cd (bins, cap), ci (bins, cap))``."""
+    ml = words.shape[0]
+    cap = q.shape[0]
+    w = words.shape[1]
+    cols = []
+    for j in range(w):
+        wj = words[:, j:j + 1]                       # (ML, 1)
+        sh = jax.lax.broadcasted_iota(jnp.int32, (1, 32), 1)
+        # (x >> s) & 1 extracts bit s for any int32 x, arithmetic
+        # shift included — only bit 0 of the shifted value is read
+        cols.append((jax.lax.shift_right_logical(
+            jnp.broadcast_to(wj, (ml, 32)),
+            jnp.broadcast_to(sh, (ml, 32))) & 1))
+    bits = jnp.concatenate(cols, axis=1)[:, :dim]    # (ML, dim) 0/1
+    pm1 = (2 * bits - 1).astype(jnp.bfloat16)        # ±1
+    ip = jax.lax.dot_general(
+        pm1, q.astype(jnp.bfloat16), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (ML, cap)
+    qq = jnp.sum(q * q, axis=1)[None, :]             # (1, cap)
+    n2 = n2_l[:, None]                               # (ML, 1)
+    sc = sc_l[:, None]                               # (ML, 1)
+    ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
+    if metric == "ip":
+        # estimator core −s·⟨q, dec⟩; the per-(list, query) center
+        # term −q·c_l is a rank-1 correction applied to the
+        # candidate blocks AFTER the scan (the ivf_pq ip pattern;
+        # the fused kernel applies it in-kernel — constant per slot,
+        # so it commutes with the binned min)
+        d = -(sc * ip)
+    else:
+        d = n2 + qq - 2.0 * sc * ip
+    # NO maximum(d, 0) clamp here: the 1-bit estimator legitimately
+    # goes negative when it overshoots near a true neighbor, and
+    # clamping would collapse exactly the strongest candidates into
+    # id-order ties (unlike the exact-distance kernels, where the
+    # clamp only removes fp noise). The XLA tier matches.
+    d = jnp.where(ids_b >= 0, d, jnp.inf)
+    wb = ml // bins
+    db_ = d.reshape(wb, bins, cap)                   # strided bins
+    cd = jnp.min(db_, axis=0)
+    rb = ids_b.reshape(wb, bins, cap)
+    ci = jnp.min(jnp.where(db_ == cd[None, :, :], rb, _BIG_I32),
+                 axis=0)
+    return cd, jnp.where(ci == _BIG_I32, -1, ci)
 
 
 def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
@@ -299,50 +750,9 @@ def _bq_scan_kernel(qsub_ref, bits_ref, norms2_ref, scales_ref, ids_ref,
     must not scale with lc).
     """
     def one_list(l):
-        q = qsub_ref[l]                                  # (cap, dim) f32
-        words = bits_ref[l]                              # (ML, w) int32
-        ml = words.shape[0]
-        cap = q.shape[0]
-        w = words.shape[1]
-        cols = []
-        for j in range(w):
-            wj = words[:, j:j + 1]                       # (ML, 1)
-            sh = jax.lax.broadcasted_iota(jnp.int32, (1, 32), 1)
-            # (x >> s) & 1 extracts bit s for any int32 x, arithmetic
-            # shift included — only bit 0 of the shifted value is read
-            cols.append((jax.lax.shift_right_logical(
-                jnp.broadcast_to(wj, (ml, 32)),
-                jnp.broadcast_to(sh, (ml, 32))) & 1))
-        bits = jnp.concatenate(cols, axis=1)[:, :dim]    # (ML, dim) 0/1
-        pm1 = (2 * bits - 1).astype(jnp.bfloat16)        # ±1
-        ip = jax.lax.dot_general(
-            pm1, q.astype(jnp.bfloat16), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (ML, cap)
-        qq = jnp.sum(q * q, axis=1)[None, :]             # (1, cap)
-        n2 = norms2_ref[l, 0][:, None]                   # (ML, 1)
-        sc = scales_ref[l, 0][:, None]                   # (ML, 1)
-        ids = ids_ref[l, 0]                              # (ML,)
-        ids_b = jnp.broadcast_to(ids[:, None], (ml, cap))
-        if metric == "ip":
-            # estimator core −s·⟨q, dec⟩; the per-(list, query) center
-            # term −q·c_l is a rank-1 correction applied to the
-            # candidate blocks AFTER the scan (the ivf_pq ip pattern)
-            d = -(sc * ip)
-        else:
-            d = n2 + qq - 2.0 * sc * ip
-        # NO maximum(d, 0) clamp here: the 1-bit estimator legitimately
-        # goes negative when it overshoots near a true neighbor, and
-        # clamping would collapse exactly the strongest candidates into
-        # id-order ties (unlike the exact-distance kernels, where the
-        # clamp only removes fp noise). The XLA tier matches.
-        d = jnp.where(ids_b >= 0, d, jnp.inf)
-        wb = ml // bins
-        db_ = d.reshape(wb, bins, cap)                   # strided bins
-        cd = jnp.min(db_, axis=0)
-        rb = ids_b.reshape(wb, bins, cap)
-        ci = jnp.min(jnp.where(db_ == cd[None, :, :], rb, _BIG_I32),
-                     axis=0)
-        ci = jnp.where(ci == _BIG_I32, -1, ci)
+        cd, ci = _bq_list_candidates(
+            qsub_ref[l], bits_ref[l], norms2_ref[l, 0], scales_ref[l, 0],
+            ids_ref[l, 0], bins=bins, dim=dim, metric=metric)
         cd_ref[l] = cd.astype(cd_ref.dtype)
         ci_ref[l] = ci
 
@@ -396,12 +806,14 @@ def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
                        lists_indices, probes, k: int, cap: int,
                        bins: int = 0, sqrt: bool = False,
                        gather: str = "", metric: str = "l2",
-                       lc: int = 0):
+                       lc: int = 0, fused: bool = False):
     """Fused Pallas fine phase for ivf_bq: probe inversion + per-list
     query gather (rotated; center-offset for the l2 core) + the in-VMEM
     unpack scan + the shared candidate merge. Mirrors
-    ``ivf_list_scan_pallas``; ``metric`` "ip" scores negated
-    similarities with the center term applied post-scan."""
+    ``ivf_list_scan_pallas`` (incl. ``fused`` — the single-pallas_call
+    scan+select tier, with the ip center term applied in-kernel);
+    unfused ``metric`` "ip" scores negated similarities with the center
+    term applied post-scan."""
     nq, dim = q_rot.shape
     n_lists, max_list = lists_indices.shape
     lay = _Layout(probes, n_lists, max_list, cap, bins, k)
@@ -413,6 +825,14 @@ def ivf_bq_scan_pallas(q_rot, centers_rot, bits, norms2, scales,
     from raft_tpu.neighbors._ivf_scan import gather_query_rows
     qg = gather_query_rows(q_rot, lay.padded_qmap(), mode=gather)
     qsub = qg if metric == "ip" else qg - centers_rot[:, None, :]
+    if fused:
+        lc = _pick_lc_fused(n_lists, lay.mlp, lay.capp, dim, 2, k, nq,
+                            lay.bins, override=lc)
+        od, oi = _fused_bq_scan_call(
+            qsub, bits_i32, norms2, scales, lists_indices,
+            lay.padded_qmap(), centers_rot, lay.bins, lc, dim, k, nq,
+            pallas_interpret(), metric=metric)
+        return _finish_fused(od, oi, nq, k, sqrt)
     # VMEM: the unpacked (ML, dim) bf16 tile + (ML, cap) scores dominate
     lc = _pick_lc(n_lists, lay.mlp, lay.capp, dim, 2, override=lc)
     cd, ci = _bq_scan_call(qsub, bits_i32, norms2, scales,
@@ -460,10 +880,27 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
     matching p-major column order (``_PER_CLUSTER_PERM``), so scoring
     needs no in-kernel transpose.
     """
-    q = qsub_ref[0]                                  # (cap, rot_dim)
+    cd, ci = _pq_cell_candidates(
+        qsub_ref[0], codes_ref[0], norms_ref[0, 0], ids_ref[0, 0],
+        books_ref, bins=bins, metric=metric, pq_dim=pq_dim,
+        pq_len=pq_len, n_codes=n_codes, lut_dtype=lut_dtype,
+        per_cluster=per_cluster)
+    cd_ref[0] = cd.astype(cd_ref.dtype)
+    ci_ref[0] = ci
+
+
+def _pq_cell_candidates(q, codes_i8, norms_l, ids, books_ref, *,
+                        bins: int, metric: str, pq_dim: int, pq_len: int,
+                        n_codes: int, lut_dtype, per_cluster: bool):
+    """One PQ cell's binned candidates scored straight from its u8
+    codes (the shared per-cell body — see ``_flat_list_candidates``;
+    ``books_ref`` stays a ref because PER_CLUSTER reads a per-cell
+    block while PER_SUBSPACE reads the shared decode matrix).
+    Returns ``(cd (cap, bins), ci (cap, bins))`` — slot-major, unlike
+    the flat/bq helpers."""
     # codes arrive as i8 bitcast of the u8 store (1 B/code of HBM
     # traffic), pre-transposed; recover 0..255 with a mask
-    codes_t = codes_ref[0].astype(jnp.int32) & 0xFF  # (pq_dim, ML)
+    codes_t = codes_i8.astype(jnp.int32) & 0xFF      # (pq_dim, ML)
     ml = codes_t.shape[1]
     cap = q.shape[0]
     # bf16 LUT = single MXU pass (the reference's fp16-LUT speed tier);
@@ -500,13 +937,12 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
         q.astype(operand), dec_t.astype(operand),
         (((1,), (0,)), ((), ())), precision=prec,
         preferred_element_type=jnp.float32)          # (cap, ML)
-    ids = ids_ref[0, 0]                              # (ML,)
     ids_b = jnp.broadcast_to(ids[None, :], (cap, ml))
     if metric == "ip":
         d = jnp.where(ids_b >= 0, -ip, jnp.inf)
     else:
         rr = jnp.sum(q * q, axis=1)[:, None]             # (cap, 1)
-        d = rr + norms_ref[0, 0][None, :] - 2.0 * ip
+        d = rr + norms_l[None, :] - 2.0 * ip
         d = jnp.where(ids_b >= 0, jnp.maximum(d, 0.0), jnp.inf)
 
     # strided bins along the row axis (row r → bin r % B), row-major
@@ -516,9 +952,7 @@ def _pq_scan_kernel(qsub_ref, codes_ref, norms_ref, ids_ref, books_ref,
     cd = jnp.min(db_, axis=1)                            # (cap, B)
     rb = ids_b.reshape(cap, w, bins)
     ci = jnp.min(jnp.where(db_ == cd[:, None, :], rb, _BIG_I32), axis=1)
-    ci = jnp.where(ci == _BIG_I32, -1, ci)
-    cd_ref[0] = cd.astype(cd_ref.dtype)
-    ci_ref[0] = ci
+    return cd, jnp.where(ci == _BIG_I32, -1, ci)
 
 
 @functools.partial(jax.jit, static_argnames=("bins", "metric", "out_dtype",
@@ -594,7 +1028,7 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
                             internal_distance_dtype=jnp.float32,
                             metric: str = "l2",
                             per_cluster: bool = False,
-                            gather: str = ""):
+                            gather: str = "", fused: bool = False):
     """IVF-PQ fine scan directly over the compressed codes.
 
     Reference ``ivf_pq_search.cuh:593`` scans the bit-packed
@@ -696,6 +1130,22 @@ def ivf_pq_code_scan_pallas(q_rot, centers_rot, pq_centers, codes,
         qsub_k = qsub
 
     codes_t = jnp.swapaxes(as_sub(codes), 1, 2)   # (cells, pq_dim, sub_ml)
+    if fused:
+        # the single-pallas_call tier replaces merge_cap_major's tail
+        # outright: candidates merge into the resident state in-kernel,
+        # the split sub-cells sharing their list's qmap/query blocks;
+        # the ip center correction moves in-kernel too (constant per
+        # slot — commutes with the binned min). PER_CLUSTER permutes
+        # the centers like the queries so the in-kernel dot is the
+        # same q·c_l (permutation-invariant).
+        cent_k = (centers_rot[..., perm]
+                  if (per_cluster and metric == "ip") else centers_rot)
+        od, oi = _fused_pq_scan_call(
+            qsub_k, codes_t, as_sub(code_norms), as_sub(lists_indices),
+            books_in, lay.padded_qmap(), cent_k, lay.bins, k, nq,
+            pallas_interpret(), metric=metric, lut_dtype=lut_dtype,
+            split=split, per_cluster=per_cluster)
+        return _finish_fused(od, oi, nq, k, sqrt)
     cd, ci = _pq_scan_call(qsub_k, codes_t, as_sub(code_norms),
                            as_sub(lists_indices), books_in, lay.bins,
                            pallas_interpret(), metric=metric,

@@ -369,16 +369,23 @@ def _label_and_agree_width(xs, ids_s, centers, mesh, axis, n_lists: int,
     ``n_lists``, excluded from the counts)."""
     from raft_tpu.neighbors.ivf_flat import _coarse_scores
 
-    def count_local(x_loc, ids_loc, c):
-        lbl = jnp.argmin(_coarse_scores(x_loc, c, kind), axis=1)
-        lbl = jnp.where(ids_loc >= 0, lbl, n_lists)
-        cnt = jax.ops.segment_sum(jnp.ones_like(lbl, jnp.int32), lbl,
-                                  num_segments=n_lists + 1)[:n_lists]
-        return lbl.astype(jnp.int32), cnt
+    def build():
+        def count_local(x_loc, ids_loc, c):
+            lbl = jnp.argmin(_coarse_scores(x_loc, c, kind), axis=1)
+            lbl = jnp.where(ids_loc >= 0, lbl, n_lists)
+            cnt = jax.ops.segment_sum(jnp.ones_like(lbl, jnp.int32), lbl,
+                                      num_segments=n_lists + 1)[:n_lists]
+            return lbl.astype(jnp.int32), cnt
 
-    counted = jax.jit(shard_map_compat(
-        count_local, mesh, in_specs=(P(axis, None), P(axis), P()),
-        out_specs=(P(axis), P(axis))))
+        return jax.jit(shard_map_compat(
+            count_local, mesh, in_specs=(P(axis, None), P(axis), P()),
+            out_specs=(P(axis), P(axis))))
+
+    # keyed on everything the closure bakes in (GL002: a fresh callable
+    # per build re-traced the shard_map every call; amortized ≠ free —
+    # repeated builds on one mesh now reuse ONE compiled program)
+    counted = _shmap_plan(("count_agree", mesh, axis, n_lists, kind),
+                          build)
     c_rep = jax.device_put(centers, NamedSharding(mesh, P()))
     labels_s, counts = counted(xs, ids_s, c_rep)
     ml = int(jax.device_get(jnp.max(counts.reshape(
@@ -432,20 +439,24 @@ def distributed_ivf_flat_build(
                                              axis, n_lists, kind)
 
     # 3) per-shard bucketize with global ids (static shapes everywhere)
-    def bucket_local(x_loc, lbl_loc, ids_loc):
-        # overflow label n_lists went to pads; fold them to list 0 with
-        # id -1 (dropped by the id mask at search)
-        lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
-        safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
-        data, idx, norms, _ = _bucketize_static(
-            x_loc, lbl, safe_ids, n_lists, ml)
-        return data[None], idx[None], norms[None]
+    def build_bucketed():
+        def bucket_local(x_loc, lbl_loc, ids_loc):
+            # overflow label n_lists went to pads; fold them to list 0
+            # with id -1 (dropped by the id mask at search)
+            lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
+            safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
+            data, idx, norms, _ = _bucketize_static(
+                x_loc, lbl, safe_ids, n_lists, ml)
+            return data[None], idx[None], norms[None]
 
-    bucketed = jax.jit(shard_map_compat(
-        bucket_local, mesh,
-        in_specs=(P(axis, None), P(axis), P(axis)),
-        out_specs=(P(axis, None, None, None), P(axis, None, None),
-                   P(axis, None, None))))
+        return jax.jit(shard_map_compat(
+            bucket_local, mesh,
+            in_specs=(P(axis, None), P(axis), P(axis)),
+            out_specs=(P(axis, None, None, None), P(axis, None, None),
+                       P(axis, None, None))))
+
+    bucketed = _shmap_plan(("flat_dbucket", mesh, axis, n_lists, ml),
+                           build_bucketed)
     pdata, pidx, pnorms = bucketed(xs, labels_s, ids_s)
     return DistributedIvfFlat(
         centers=centers, parts_data=pdata, parts_indices=pidx,
@@ -622,24 +633,28 @@ def distributed_ivf_pq_build(
                                                  kind)
 
     # 4) per-shard encode + bucketize the CODES (u8) with global ids
-    def encode_local(x_loc, lbl_loc, ids_loc, c, r, books):
-        from raft_tpu.neighbors.ivf_pq import _code_norms
-        lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
-        safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
-        resid_rot = jnp.matmul(x_loc - c[lbl], r.T,
-                               precision=matmul_precision())
-        codes = _encode(resid_rot, books).astype(jnp.float32)
-        data, idx, _, _ = _bucketize_static(codes, lbl, safe_ids,
-                                            n_lists, ml)
-        codes_b = data.astype(jnp.uint8)
-        norms = _code_norms(codes_b, books, idx)
-        return codes_b[None], idx[None], norms[None]
+    def build_encoded():
+        def encode_local(x_loc, lbl_loc, ids_loc, c, r, books):
+            from raft_tpu.neighbors.ivf_pq import _code_norms
+            lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
+            safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
+            resid_rot = jnp.matmul(x_loc - c[lbl], r.T,
+                                   precision=matmul_precision())
+            codes = _encode(resid_rot, books).astype(jnp.float32)
+            data, idx, _, _ = _bucketize_static(codes, lbl, safe_ids,
+                                                n_lists, ml)
+            codes_b = data.astype(jnp.uint8)
+            norms = _code_norms(codes_b, books, idx)
+            return codes_b[None], idx[None], norms[None]
 
-    encoded = jax.jit(shard_map_compat(
-        encode_local, mesh,
-        in_specs=(P(axis, None), P(axis), P(axis), P(), P(), P()),
-        out_specs=(P(axis, None, None, None), P(axis, None, None),
-                   P(axis, None, None))))
+        return jax.jit(shard_map_compat(
+            encode_local, mesh,
+            in_specs=(P(axis, None), P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(axis, None, None, None), P(axis, None, None),
+                       P(axis, None, None))))
+
+    encoded = _shmap_plan(("pq_dencode", mesh, axis, n_lists, ml),
+                          build_encoded)
     rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
     pcodes, pidx, pnorms = encoded(xs, labels_s, ids_s, c_rep,
                                    rep(rot), rep(pq_centers))
@@ -825,31 +840,35 @@ def distributed_ivf_bq_build(
     rot_rep = jax.device_put(rot, NamedSharding(mesh, P()))
     w = -(-dim // 32)
 
-    def encode_local(x_loc, lbl_loc, ids_loc, c, rt):
-        lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
-        safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
-        # full-precision rotation, like ivf_bq.build: default-precision
-        # TPU matmul flips signs of near-zero rotated components
-        r = jnp.matmul(x_loc - c[lbl], rt.T,
-                       precision=matmul_precision())
-        # int32 payload (see ivf_bq.build): bit words must not ride as
-        # f32 bitcasts — NaN-pattern canonicalization hazard
-        payload = jnp.concatenate(
-            [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
-             lax.bitcast_convert_type(
-                 jnp.sum(r * r, axis=1)[:, None], jnp.int32),
-             lax.bitcast_convert_type(
-                 jnp.mean(jnp.abs(r), axis=1)[:, None], jnp.int32)],
-            axis=1)
-        data, idx, _, _ = _bucketize_static(payload, lbl, safe_ids,
-                                            n_lists, ml,
-                                            compute_norms=False)
-        return data[None], idx[None]
+    def build_enc():
+        def encode_local(x_loc, lbl_loc, ids_loc, c, rt):
+            lbl = jnp.where(lbl_loc < n_lists, lbl_loc, 0)
+            safe_ids = jnp.where(lbl_loc < n_lists, ids_loc, -1)
+            # full-precision rotation, like ivf_bq.build: default-
+            # precision TPU matmul flips signs of near-zero rotated
+            # components
+            r = jnp.matmul(x_loc - c[lbl], rt.T,
+                           precision=matmul_precision())
+            # int32 payload (see ivf_bq.build): bit words must not ride
+            # as f32 bitcasts — NaN-pattern canonicalization hazard
+            payload = jnp.concatenate(
+                [lax.bitcast_convert_type(_pack_bits(r), jnp.int32),
+                 lax.bitcast_convert_type(
+                     jnp.sum(r * r, axis=1)[:, None], jnp.int32),
+                 lax.bitcast_convert_type(
+                     jnp.mean(jnp.abs(r), axis=1)[:, None], jnp.int32)],
+                axis=1)
+            data, idx, _, _ = _bucketize_static(payload, lbl, safe_ids,
+                                                n_lists, ml,
+                                                compute_norms=False)
+            return data[None], idx[None]
 
-    enc = jax.jit(shard_map_compat(
-        encode_local, mesh,
-        in_specs=(P(axis, None), P(axis), P(axis), P(), P()),
-        out_specs=(P(axis, None, None, None), P(axis, None, None))))
+        return jax.jit(shard_map_compat(
+            encode_local, mesh,
+            in_specs=(P(axis, None), P(axis), P(axis), P(), P()),
+            out_specs=(P(axis, None, None, None), P(axis, None, None))))
+
+    enc = _shmap_plan(("bq_dencode", mesh, axis, n_lists, ml), build_enc)
     payload, pidx = enc(xs, labels_s, ids_s, c_rep, rot_rep)
     bits = lax.bitcast_convert_type(payload[..., :w], jnp.uint32)
     raw = None
@@ -995,16 +1014,20 @@ def _label_and_widths(xs, ids_s, centers, mesh, axis, n_lists: int,
     per-list totals (the index's ``list_sizes``)."""
     from raft_tpu.neighbors.ivf_flat import _coarse_scores
 
-    def count_local(x_loc, ids_loc, c):
-        lbl = jnp.argmin(_coarse_scores(x_loc, c, kind), axis=1)
-        lbl = jnp.where(ids_loc >= 0, lbl, n_lists)
-        cnt = jax.ops.segment_sum(jnp.ones_like(lbl, jnp.int32), lbl,
-                                  num_segments=n_lists + 1)[:n_lists]
-        return lbl.astype(jnp.int32), cnt
+    def build():
+        def count_local(x_loc, ids_loc, c):
+            lbl = jnp.argmin(_coarse_scores(x_loc, c, kind), axis=1)
+            lbl = jnp.where(ids_loc >= 0, lbl, n_lists)
+            cnt = jax.ops.segment_sum(jnp.ones_like(lbl, jnp.int32), lbl,
+                                      num_segments=n_lists + 1)[:n_lists]
+            return lbl.astype(jnp.int32), cnt
 
-    counted = jax.jit(shard_map_compat(
-        count_local, mesh, in_specs=(P(axis, None), P(axis), P()),
-        out_specs=(P(axis), P(axis))))
+        return jax.jit(shard_map_compat(
+            count_local, mesh, in_specs=(P(axis, None), P(axis), P()),
+            out_specs=(P(axis), P(axis))))
+
+    counted = _shmap_plan(("count_widths", mesh, axis, n_lists, kind),
+                          build)
     c_rep = jax.device_put(centers, NamedSharding(mesh, P()))
     labels_s, counts = counted(xs, ids_s, c_rep)
     c = np.asarray(jax.device_get(counts)).reshape(mesh.shape[axis],

@@ -19,6 +19,9 @@ class TestPerfGates:
                  "value": 90_000.0},
                 {"metric": f"ivf_flat_search_500kx128_q1000_k32_p{fp}_qps",
                  "value": 50_000.0, "recall": 0.93},
+                {"metric": f"ivf_flat_search_100kx128_q1000_k32_p{fp}_qps",
+                 "value": 60_000.0, "recall": 0.93,
+                 "marginal_gap": 1.4},
                 {"metric": f"ivf_pq_search_500kx128_q1000_k32_p{ip}_qps",
                  "value": 50_000.0, "recall": 0.92},
                 {"metric": f"ivf_pq4_search_500kx128_q1000_k32_p{ip}_qps",
@@ -94,6 +97,42 @@ class TestPerfGates:
         fails2 = bench_suite.check_gates(rows2, require_all=True)
         assert any(f["kind"] == "missing" and f["metric"] == metric
                    for f in fails2)
+
+
+class TestGapGate:
+    """GAP_GATES (ISSUE 7): marginal_qps / plan_qps ceilings — the
+    marginal-vs-end-to-end gap as a first-class regression signal."""
+
+    def _rows(self, **kw):
+        return TestPerfGates()._rows(**kw)
+
+    def _flat100k(self):
+        import bench_suite
+        return (f"ivf_flat_search_100kx128_q1000_k32"
+                f"_p{bench_suite.FLAT_PROBES}_qps")
+
+    def test_gap_ceiling_trips(self):
+        import bench_suite
+        rows = self._rows()
+        for r in rows:
+            if r["metric"] == self._flat100k():
+                r["marginal_gap"] = 5.3   # the round-5 class of gap
+        fails = bench_suite.check_gates(rows)
+        assert [f["kind"] for f in fails] == ["marginal_gap"]
+        assert fails[0]["metric"] == self._flat100k()
+        assert fails[0]["gate"] == 2.0
+
+    def test_gap_gate_never_passes_by_not_running(self):
+        import bench_suite
+        rows = self._rows()
+        for r in rows:
+            if r["metric"] == self._flat100k():
+                del r["marginal_gap"]
+        fails = bench_suite.check_gates(rows, require_all=True)
+        assert any(f["kind"] == "missing"
+                   and f["metric"] == self._flat100k() for f in fails)
+        # case-filtered runs don't charge unselected gap gates
+        assert bench_suite.check_gates(rows, require_all=False) == []
 
 
 class TestUnknownCase:

@@ -1,0 +1,431 @@
+"""Fused IVF scan + select-k Pallas kernel (ISSUE 7).
+
+The fused tier keeps the per-query top-k state resident in VMEM across
+the list grid (``pallas_ivf_scan._merge_state`` — the ``_select_kernel``
+output-block-revisiting trick), so the fine phase is ONE pallas_call
+where the unfused path dispatches scan → gather → select_k. These run
+under the Pallas interpreter on the CPU test mesh (the TPU relay may be
+down — the kernel-logic contract is what's validated here, like
+tests/test_ops_pallas.py).
+
+Coverage per the issue checklist: interpret-mode parity vs the exact
+XLA ``inverted_scan`` tier (``bins == max_list`` ⇒ bit-exact ids)
+across l2/ip metrics, f32/bf16/int8 storage tiers, ragged list sizes
+(the blob fixture's lists are naturally uneven) and the cap-overflow
+mask path; a dispatch-count test asserting the fused route compiles to
+one ``pallas_call``; plan/ladder routing with zero steady-state
+compiles; the coarse-selection fallback counter.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.neighbors import _ivf_scan, ivf_bq, ivf_flat, ivf_pq, plan
+from raft_tpu.random import make_blobs
+
+
+def _cdiff(before, after, name):
+    return (after["counters"].get(name, 0.0)
+            - before["counters"].get(name, 0.0))
+
+
+def _recall(got, want, k):
+    return np.mean([
+        len(set(np.asarray(got[r])) & set(np.asarray(want[r]))) / k
+        for r in range(got.shape[0])])
+
+
+def _count_pallas_calls(closed):
+    """Count pallas_call primitives recursively through a jaxpr
+    (pjit/scan/cond sub-jaxprs included) — the dispatch-count oracle."""
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    def subjaxprs(v):
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                yield from subjaxprs(item)
+
+    def walk(jaxpr):
+        n = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                n += 1
+                continue  # the kernel body holds no nested pallas_call
+            for p in eqn.params.values():
+                for sub in subjaxprs(p):
+                    n += walk(sub)
+        return n
+
+    return walk(closed.jaxpr if isinstance(closed, ClosedJaxpr)
+                else closed)
+
+
+@pytest.fixture(scope="module")
+def flat_data():
+    x, _ = make_blobs(n_samples=6000, n_features=24, centers=40,
+                      cluster_std=3.0, seed=0)
+    q, _ = make_blobs(n_samples=80, n_features=24, centers=40,
+                      cluster_std=3.0, seed=1)
+    return jnp.asarray(np.asarray(x)), jnp.asarray(np.asarray(q))
+
+
+@pytest.fixture(scope="module")
+def flat_index(flat_data):
+    x, _ = flat_data
+    return ivf_flat.build(x, ivf_flat.IndexParams(n_lists=32,
+                                                  kmeans_n_iters=4))
+
+
+class TestFusedFlat:
+    """IVF-Flat: the fused kernel vs the exact XLA tier and the unfused
+    Pallas tier. The blob fixture's list sizes are RAGGED (cluster_std
+    3.0 over 40 centers into 32 lists), so the id −1 pad-row masking is
+    always exercised."""
+
+    def test_exact_bins_ids_bit_identical_to_xla_tier(self, flat_index,
+                                                      flat_data,
+                                                      monkeypatch):
+        """bins == max_list ⇒ both tiers select the exact global top-k
+        of the same f32 scores: ids must be BIT-IDENTICAL (the issue
+        acceptance contract)."""
+        _, q = flat_data
+        k, ml = 8, int(flat_index.lists_indices.shape[1])
+        sp = ivf_flat.SearchParams(n_probes=16, scan_order="list",
+                                   scan_bins=ml)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        d_f, i_f = ivf_flat.search(flat_index, q, k, sp)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "never")  # → xla_inverted
+        d_x, i_x = ivf_flat.search(flat_index, q, k, sp)
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_x))
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_x),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("storage", ["float32", "bfloat16", "int8"])
+    def test_exact_bins_matches_unfused_pallas_storage_tiers(
+            self, flat_data, storage, monkeypatch):
+        """Across the narrow-storage tiers the fused kernel shares the
+        unfused kernel's scoring body verbatim — exact bins ⇒ identical
+        candidates ⇒ identical ids."""
+        x, q = flat_data
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4, storage_dtype=storage))
+        k, ml = 8, int(idx.lists_indices.shape[1])
+        sp = ivf_flat.SearchParams(n_probes=8, scan_order="list",
+                                   scan_bins=ml)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        d_f, i_f = ivf_flat.search(idx, q, k, sp)
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "0")
+        d_u, i_u = ivf_flat.search(idx, q, k, sp)
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_u))
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_u),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_ip_metric_matches_probe_major_exact(self, flat_data,
+                                                 monkeypatch):
+        """ip core: the exact reference is the probe-major scan (the
+        XLA list tier is l2-only); with exact bins the fused kernel's
+        negated-similarity ranking must reproduce it."""
+        from raft_tpu.distance.distance_types import DistanceType
+        x, q = flat_data
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=32, kmeans_n_iters=4,
+            metric=DistanceType.InnerProduct))
+        k, ml = 8, int(idx.lists_indices.shape[1])
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        d_f, i_f = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=8, scan_order="list", scan_bins=ml))
+        d_p, i_p = ivf_flat.search(idx, q, k, ivf_flat.SearchParams(
+            n_probes=8, scan_order="probe"))
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_p))
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_p),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_cap_overflow_mask_path(self, flat_index, flat_data,
+                                    monkeypatch):
+        """A pinned cap smaller than the drop-free width sheds the
+        highest-rank probes; the fused kernel's qmap simply never holds
+        the shed pairs — same drops, same ids as the unfused merge's
+        inv_pos ≥ cap mask."""
+        _, q = flat_data
+        k, ml = 8, int(flat_index.lists_indices.shape[1])
+        sp = ivf_flat.SearchParams(n_probes=16, scan_order="list",
+                                   scan_bins=ml, probe_cap=8)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        d_f, i_f = ivf_flat.search(flat_index, q, k, sp)
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "0")
+        d_u, i_u = ivf_flat.search(flat_index, q, k, sp)
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_u))
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_u),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_default_bins_recall_within_0005_of_unfused(self, flat_index,
+                                                        flat_data,
+                                                        monkeypatch):
+        """At the default (binned) operating point the fused and
+        unfused tiers share the identical binned candidate sets — the
+        acceptance bound is recall within 0.005 of the unfused tier."""
+        x, q = flat_data
+        k = 8
+        sp = ivf_flat.SearchParams(n_probes=8, scan_order="list")
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        _, i_f = ivf_flat.search(flat_index, q, k, sp)
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "0")
+        _, i_u = ivf_flat.search(flat_index, q, k, sp)
+        xn, qn = np.asarray(x), np.asarray(q)
+        d2 = ((xn ** 2).sum(1)[None, :] + (qn ** 2).sum(1)[:, None]
+              - 2 * qn @ xn.T)
+        exact = np.argsort(d2, axis=1)[:, :k]
+        rec_f = _recall(np.asarray(i_f), exact, k)
+        rec_u = _recall(np.asarray(i_u), exact, k)
+        assert rec_f >= rec_u - 0.005, (rec_f, rec_u)
+
+
+class TestDispatchCount:
+    """The headline structural claim: ONE compiled fine-phase dispatch
+    where there were three (scan pallas_call → XLA gather → select_k
+    pallas_call)."""
+
+    def _probes_cap(self, flat_index, q, n_probes):
+        probes = _ivf_scan.coarse_probes(q, flat_index.centers, n_probes)
+        cap = _ivf_scan.probe_cap(probes, flat_index.n_lists)
+        return probes, cap
+
+    def test_fused_fine_phase_is_one_pallas_call(self, flat_index,
+                                                 flat_data):
+        from raft_tpu.ops.pallas_ivf_scan import ivf_list_scan_pallas
+        _, q = flat_data
+        k = 8
+        probes, cap = self._probes_cap(flat_index, q, 8)
+
+        def fine(fused):
+            return jax.make_jaxpr(functools.partial(
+                ivf_list_scan_pallas, k=k, cap=cap, fused=fused))(
+                    q, flat_index.lists_data, flat_index.lists_norms,
+                    flat_index.lists_indices, probes)
+
+        assert _count_pallas_calls(fine(True)) == 1
+        # the unfused fine phase: scan kernel + select_k kernel
+        assert _count_pallas_calls(fine(False)) == 2
+
+    def test_full_search_collapses_three_to_one(self, flat_index,
+                                                flat_data):
+        """End-to-end fused_list_search: coarse select_k + fine phase.
+        Unfused = 3 pallas_calls (coarse, scan, merge select_k); fused
+        = 2 (coarse, fused scan+select) — the fine phase collapsed."""
+        _, q = flat_data
+        k = 8
+        _, cap = self._probes_cap(flat_index, q, 8)
+
+        def full(fused):
+            fn = functools.partial(
+                _ivf_scan.fused_list_search, k=k, n_probes=8, cap=cap,
+                bins=0, sqrt=False, kind="l2", use_pallas=True,
+                gather="rows", fused=fused)
+            return jax.make_jaxpr(fn)(
+                q, flat_index.centers, flat_index.lists_data,
+                flat_index.lists_norms, flat_index.lists_indices,
+                jnp.float32(1.0))
+
+        assert _count_pallas_calls(full(False)) == 3
+        assert _count_pallas_calls(full(True)) == 2
+
+
+class TestFusedBq:
+    @pytest.fixture(scope="class")
+    def bq_data(self):
+        x, _ = make_blobs(n_samples=6000, n_features=64, centers=40,
+                          cluster_std=3.0, seed=0)
+        q, _ = make_blobs(n_samples=80, n_features=64, centers=40,
+                          cluster_std=3.0, seed=1)
+        return jnp.asarray(np.asarray(x)), jnp.asarray(np.asarray(q))
+
+    @pytest.mark.parametrize("metric", ["l2", "ip"])
+    def test_exact_bins_matches_unfused(self, bq_data, metric,
+                                        monkeypatch):
+        """Exact bins ⇒ identical estimator candidates (shared scoring
+        body; the ip center term moves in-kernel but commutes with the
+        binned min) ⇒ identical rescored output."""
+        from raft_tpu.distance.distance_types import DistanceType
+        x, q = bq_data
+        m = (DistanceType.InnerProduct if metric == "ip"
+             else DistanceType.L2Expanded)
+        idx = ivf_bq.build(x, ivf_bq.IndexParams(n_lists=32,
+                                                 kmeans_n_iters=4,
+                                                 metric=m))
+        ml = int(idx.lists_indices.shape[1])
+        sp = ivf_bq.SearchParams(n_probes=16, scan_bins=ml)
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        d_f, i_f = ivf_bq.search(idx, q, 8, sp)
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "0")
+        d_u, i_u = ivf_bq.search(idx, q, 8, sp)
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_u))
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_u),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFusedPq:
+    @pytest.fixture(scope="class")
+    def pq_setup(self):
+        x, _ = make_blobs(n_samples=6000, n_features=32, centers=40,
+                          cluster_std=3.0, seed=0)
+        q, _ = make_blobs(n_samples=80, n_features=32, centers=40,
+                          cluster_std=3.0, seed=1)
+        x = jnp.asarray(np.asarray(x))
+        q = jnp.asarray(np.asarray(q))
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(n_lists=32,
+                                                 kmeans_n_iters=4,
+                                                 pq_dim=8))
+        return idx, x, q
+
+    @pytest.mark.parametrize("metric", ["l2", "ip"])
+    def test_wrapper_exact_bins_ids_match_unfused(self, pq_setup,
+                                                  metric):
+        """Direct wrapper parity (replacing merge_cap_major's tail):
+        exact bins, same candidates, same ids."""
+        from raft_tpu.ops.pallas_ivf_scan import ivf_pq_code_scan_pallas
+        idx, x, q = pq_setup
+        k, ml = 8, int(idx.codes.shape[1])
+        probes = _ivf_scan.coarse_probes(q, idx.centers, 8, kind=metric)
+        cap = _ivf_scan.probe_cap(probes, idx.n_lists)
+        q_rot = q @ idx.rotation_matrix.T
+        norms = ivf_pq._code_norms(idx.codes, idx.pq_centers,
+                                   idx.lists_indices)
+        kw = dict(bins=ml, metric=metric)
+        d_u, i_u = ivf_pq_code_scan_pallas(
+            q_rot, idx.centers_rot, idx.pq_centers, idx.codes, norms,
+            idx.lists_indices, probes, k, cap, **kw)
+        d_f, i_f = ivf_pq_code_scan_pallas(
+            q_rot, idx.centers_rot, idx.pq_centers, idx.codes, norms,
+            idx.lists_indices, probes, k, cap, fused=True, **kw)
+        np.testing.assert_array_equal(np.asarray(i_f), np.asarray(i_u))
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_u),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_vmem_split_path_agrees(self, pq_setup, monkeypatch):
+        """A tiny VMEM budget forces split > 1 (sub-cells sharing their
+        list's qmap/query blocks via g // split): the resident-state
+        merge must land the same neighbors."""
+        from raft_tpu.ops import pallas_ivf_scan as pis
+        idx, x, q = pq_setup
+        k = 8
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="codes")
+        d0, i0 = ivf_pq.search(idx, q, k, sp)
+        monkeypatch.setattr(pis, "_VMEM_LIMIT", 1 << 18)  # force split
+        d1, i1 = ivf_pq.search(idx, q, k, sp)
+        assert _recall(np.asarray(i1), np.asarray(i0), k) >= 0.95
+        np.testing.assert_allclose(np.asarray(d1)[:, :k // 2],
+                                   np.asarray(d0)[:, :k // 2],
+                                   rtol=0.05, atol=0.5)
+
+    def test_codes_search_recall_vs_unfused(self, pq_setup, monkeypatch):
+        """Public route at default bins: same binned candidate sets —
+        recall within 0.005 of the unfused code scan."""
+        idx, x, q = pq_setup
+        k = 8
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="codes")
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        _, i_f = ivf_pq.search(idx, q, k, sp)
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "0")
+        _, i_u = ivf_pq.search(idx, q, k, sp)
+        xn, qn = np.asarray(x), np.asarray(q)
+        d2 = ((xn ** 2).sum(1)[None, :] + (qn ** 2).sum(1)[:, None]
+              - 2 * qn @ xn.T)
+        exact = np.argsort(d2, axis=1)[:, :k]
+        rec_f = _recall(np.asarray(i_f), exact, k)
+        rec_u = _recall(np.asarray(i_u), exact, k)
+        assert rec_f >= rec_u - 0.005, (rec_f, rec_u)
+
+
+class TestPlanRoutesFused:
+    """Acceptance: SearchPlan / PlanLadder route through the fused
+    kernel with zero steady-state compiles — asserted from the
+    raft.plan.cache counters, as in test_serve."""
+
+    def test_plan_key_carries_fused_and_zero_steady_state(
+            self, flat_index, flat_data, monkeypatch):
+        if not obs.enabled():
+            pytest.skip("metrics disabled (RAFT_TPU_METRICS=0)")
+        _, q = flat_data
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        sp = ivf_flat.SearchParams(n_probes=8, scan_order="list")
+        before = obs.snapshot()
+        p = plan.warmup(flat_index, q, 8, sp)
+        mid = obs.snapshot()
+        # the plan build recorded its fused routing decision
+        assert _cdiff(before, mid,
+                      "raft.ivf_scan.fused.total{family=ivf_flat}") >= 1
+        for _ in range(3):
+            p.search(q, block=True)
+        after = obs.snapshot()
+        assert _cdiff(mid, after, "raft.plan.cache.misses") == 0
+        assert _cdiff(mid, after, "raft.plan.build.total") == 0
+        assert _cdiff(mid, after,
+                      "raft.ivf_scan.resolve_cap.syncs") == 0
+        # value parity with the cold fused route
+        d0, i0 = ivf_flat.search(flat_index, q, 8, sp)
+        d1, i1 = p.search(q, block=True)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_plan_ladder_zero_steady_state(self, flat_index, flat_data,
+                                           monkeypatch):
+        if not obs.enabled():
+            pytest.skip("metrics disabled (RAFT_TPU_METRICS=0)")
+        from raft_tpu.serve.ladder import PlanLadder
+        _, q = flat_data
+        monkeypatch.setenv("RAFT_TPU_PALLAS", "always")
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        sp = ivf_flat.SearchParams(n_probes=8, scan_order="list")
+        ladder = PlanLadder.build(flat_index, q, 8, sp, shapes=(16, 80))
+        before = obs.snapshot()
+        for rows in (5, 16, 80):
+            _, pl_ = ladder.plan_for(rows, 0)
+            pl_.search(q[:pl_.nq], block=True)
+        after = obs.snapshot()
+        assert _cdiff(before, after, "raft.plan.cache.misses") == 0
+        assert _cdiff(before, after, "raft.plan.build.total") == 0
+        assert _cdiff(before, after,
+                      "raft.ivf_scan.resolve_cap.syncs") == 0
+
+
+class TestCoarseFallbackCounter:
+    def test_counts_only_past_the_selectk_bound(self):
+        if not obs.enabled():
+            pytest.skip("metrics disabled (RAFT_TPU_METRICS=0)")
+        before = obs.snapshot()
+        _ivf_scan.count_coarse_fallback(300, use_pallas=True)
+        _ivf_scan.count_coarse_fallback(300, use_pallas=False)
+        _ivf_scan.count_coarse_fallback(64, use_pallas=True)
+        after = obs.snapshot()
+        assert _cdiff(before, after,
+                      "raft.ivf_scan.coarse.fallback") == 1
+
+
+class TestFusedModeKnob:
+    def test_env_spellings(self, monkeypatch):
+        from raft_tpu.ops.pallas_ivf_scan import fused_mode
+        monkeypatch.delenv("RAFT_TPU_IVF_FUSED", raising=False)
+        assert fused_mode()                       # default ON
+        for off in ("0", "never", "off"):
+            monkeypatch.setenv("RAFT_TPU_IVF_FUSED", off)
+            assert not fused_mode()
+        monkeypatch.setenv("RAFT_TPU_IVF_FUSED", "1")
+        assert fused_mode()

@@ -449,6 +449,21 @@ class TestBaselineContract:
             REPO, files=[os.path.join(REPO, "raft_tpu", "serve")])
         assert findings == []
 
+    def test_no_grandfathered_findings_in_parallel(self):
+        """ISSUE 7 satellite: the per-build shard_map sites in
+        parallel/ now ride the keyed _shmap_plan cache — their GL002
+        grandfather entries were DELETED, not carried. A new retrace
+        hazard in parallel/ fails the lint outright."""
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        assert not [k for k in allow
+                    if k[1].startswith("raft_tpu/parallel/")]
+
+    def test_real_parallel_tree_has_no_gl002(self):
+        findings, _ = engine.run(
+            REPO, files=[os.path.join(REPO, "raft_tpu", "parallel")])
+        assert [f for f in findings if f.rule == "GL002"] == []
+
 
 class TestShimDelegation:
     def test_check_metric_names_uses_registry_scanner(self, tmp_path):

@@ -77,6 +77,12 @@ REQUIRED_NAMES = (
     "raft.ivf_scan.resolve_cap.syncs",
     "raft.ivf_scan.resolve_cap.cache_hits",
     "raft.ann.batched_search.sub_batches",
+    # fused scan+select routing (ISSUE 7): per-family fused-route
+    # decisions + query volume, and the coarse-selection cliff counter
+    # (n_probes > 256 silently drops to the lax.top_k variadic sort)
+    "raft.ivf_scan.fused.total",
+    "raft.ivf_scan.fused.queries",
+    "raft.ivf_scan.coarse.fallback",
     # sharded/streaming build instruments (ISSUE 4): per-family sharded
     # build counters and the streaming ingestion counters — the
     # sharded_build_s bench rows and the build dashboards key on these

@@ -54,6 +54,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_sharded_build.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# fused scan+select kernel parity (ISSUE 7): the single-pallas_call
+# fine phase must stay bit-identical to the exact XLA tier at exact
+# bins and keep the one-dispatch structural contract (interpret mode —
+# the same kernel logic the TPU compiles).
+echo "precommit: fused scan+select parity tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_fused_scan.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # serving-runtime contract next (ISSUE 5 satellite): micro-batching
 # correctness (no pad-row leakage), backpressure/deadline/degradation
 # semantics, and the healthz/search endpoint integration.
