@@ -307,6 +307,38 @@ def child_main():
         except Exception as e:
             out["serve_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # distributed serving row (ISSUE 8): the mesh-wide tier —
+        # dist_serve_qps vs the single-device server, the quantized
+        # cross-shard merge compression, and the zero-compile contract,
+        # all same-round with the serve_* keys above
+        try:
+            rows = []
+            bench_suite.bench_serve_sharded(rows, n=n_ivf,
+                                            nlists=nlists)
+            for r in rows:
+                if "dist_serve_qps" in r:
+                    out["dist_serve_qps"] = r["dist_serve_qps"]
+                    out["dist_single_serve_qps"] = r["single_serve_qps"]
+                    out["dist_speedup_vs_single"] = \
+                        r.get("speedup_vs_single")
+                    out["dist_p99_ms"] = r["dist_p99_ms"]
+                    out["dist_merge_bytes_ratio"] = \
+                        r["merge_bytes_ratio"]
+                    out["dist_steady_state_compiles"] = \
+                        r["steady_state_compiles"]
+                    out["dist_n_shards"] = r["n_shards"]
+                    out["dist_recall"] = r.get("recall")
+                    out["dist_recall_f32_merge"] = \
+                        r.get("recall_f32_merge")
+                elif "p99_under_2x_watermark" in r:
+                    out["dist_overload_p99_ms"] = r["dist_p99_ms"]
+                    out["dist_overload_p99_bounded"] = \
+                        r["p99_under_2x_watermark"]
+                elif "error" in r:
+                    out.setdefault("dist_serve_error", r["error"])
+        except Exception as e:
+            out["dist_serve_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

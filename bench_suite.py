@@ -733,6 +733,181 @@ def bench_serve(results, n=500_000, nlists=1024, n_probes=None):
         server.close()
 
 
+def bench_serve_sharded(results, n=None, nlists=1024, n_probes=None):
+    """Distributed serving bench (ISSUE 8): closed-loop clients against
+    the mesh-wide ``DistributedSearchServer`` (list-sharded index over
+    every local device, int8 quantized cross-shard merge) vs the
+    single-device ``SearchServer`` at the same flat operating point —
+    the ``dist_serve_qps`` / ``merge_bytes_ratio`` /
+    ``steady_state_compiles`` acceptance row, plus an overload row
+    (2x the measured rate through the degradation ladder, p99 vs the
+    watermark). Knobs: ``BENCH_DIST_N`` (rows, default 500k),
+    ``BENCH_SERVE_CLIENTS`` / ``BENCH_SERVE_SECONDS`` as bench_serve.
+
+    On a 1-device host the mesh degenerates to one shard (the merge
+    moves no wire bytes; the row still reports, ratio None) — the
+    multi-chip TPU rounds and the 8-way CPU test mesh are where the
+    compression figure is real."""
+    import threading
+    from raft_tpu import obs, serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import shard_ivf_flat
+    from raft_tpu.parallel import ivf as pivf
+    from raft_tpu.parallel.mesh import make_mesh
+    n = n or int(os.environ.get("BENCH_DIST_N", 500_000))
+    if n_probes is None:
+        n_probes = FLAT_PROBES
+    mesh = make_mesh()
+    n_shards = mesh.shape["data"]
+    if nlists % n_shards:
+        nlists = max(n_shards, nlists // n_shards * n_shards)
+    n_probes = min(n_probes, nlists)
+    d, nq_pool, k = 128, 256, 32
+    db, q = _ann_dataset(n, d, nq_pool)
+    q_np = np.asarray(q)
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=nlists,
+                                                    kmeans_n_iters=10))
+    sindex = shard_ivf_flat(index, mesh)
+    # per-shard probes: each shard probes its own lists, so the ladder
+    # scales the SINGLE-device probe budget down by the mesh (total
+    # probed lists stay comparable — the parallel/ivf contract)
+    p_shard = max(1, min(n_probes // n_shards, nlists // n_shards))
+    sp = ivf_flat.SearchParams(n_probes=p_shard)
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 2.0))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 16))
+    ladder = tuple(dict.fromkeys(
+        (p_shard, max(1, p_shard // 2), max(1, p_shard // 4))))
+    cfg = serve.ServeConfig(batch_sizes=(1, 8, 32, 128), max_queue=512,
+                            max_wait_ms=2.0, probes_ladder=ladder,
+                            degrade_watermark_ms=200.0)
+
+    # single-device baseline server at the matched operating point
+    single = serve.SearchServer.from_index(
+        index, q_np[:128], k, params=ivf_flat.SearchParams(
+            n_probes=min(n_probes, nlists)),
+        config=serve.ServeConfig(batch_sizes=(1, 8, 32, 128),
+                                 max_queue=512, max_wait_ms=2.0))
+    dist = serve.DistributedSearchServer.from_sharded_index(
+        sindex, q_np[:128], k, params=sp, mesh=mesh, config=cfg)
+    metric = (f"dist_serve_{n//1000}kx{d}_q1_k{k}_p{p_shard}"
+              f"x{n_shards}_qps")
+    try:
+        # recall THROUGH the distributed batcher (pad + scatter + int8
+        # merge included) and the f32-merge reference, both vs brute
+        dist_ids = np.concatenate(
+            [np.asarray(dist.search(q_np[s:s + 1])[1])
+             for s in range(nq_pool)])
+        rec_dist = _ivf_recall(dist_ids, db, q, k)
+        f32_ids = np.asarray(pivf.distributed_ivf_flat_search(
+            sindex, q_np, k, sp, mesh=mesh, merge="f32")[1])
+        rec_f32 = _ivf_recall(f32_ids, db, q, k)
+
+        def closed_loop(server):
+            lats, counts = [], []
+            lock = threading.Lock()
+            stop = time.perf_counter() + seconds
+
+            def client(tid):
+                my = []
+                i = tid
+                while time.perf_counter() < stop:
+                    t1 = time.perf_counter()
+                    server.search(q_np[i % nq_pool:i % nq_pool + 1])
+                    my.append(time.perf_counter() - t1)
+                    i += clients
+                with lock:
+                    lats.extend(my)
+                    counts.append(len(my))
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            lats.sort()
+
+            def pct(p):
+                return (lats[min(len(lats) - 1,
+                                 int(p / 100 * (len(lats) - 1)))] * 1e3
+                        if lats else float("nan"))
+
+            return sum(counts) / wall, pct(50), pct(99)
+
+        single_qps, _, _ = closed_loop(single)
+        before = obs.snapshot()
+        dist_qps, p50, p99 = closed_loop(dist)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+
+        def csum(name):
+            return sum(v for k_, v in cnt.items()
+                       if k_ == name or k_.startswith(name + "{"))
+
+        compiles = (csum("raft.parallel.plan.misses")
+                    + csum("raft.plan.cache.misses")
+                    + csum("raft.plan.build.total"))
+        bpre = csum("raft.serve.dist.merge.bytes_pre")
+        bpost = csum("raft.serve.dist.merge.bytes_post")
+        results.append({
+            "metric": metric,
+            "value": round(dist_qps, 1), "unit": "queries/s",
+            "dist_serve_qps": round(dist_qps, 1),
+            "single_serve_qps": round(single_qps, 1),
+            "speedup_vs_single": (round(dist_qps / single_qps, 2)
+                                  if single_qps else None),
+            "dist_p50_ms": round(p50, 3),
+            "dist_p99_ms": round(p99, 3),
+            "merge_bytes_ratio": (round(bpost / bpre, 4) if bpre
+                                  else None),
+            "steady_state_compiles": int(compiles),
+            "n_shards": n_shards,
+            "clients": clients,
+            "recall": round(rec_dist, 4),
+            "recall_f32_merge": round(rec_f32, 4)})
+
+        # overload row: open-loop Poisson at 2x the measured closed-
+        # loop rate — bounded p99 via the inherited degradation ladder
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "raft_loadgen",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "loadgen.py"))
+            loadgen = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(loadgen)
+            before = obs.snapshot()
+            rep = loadgen.run_open_loop(
+                dist, q_np, rate_qps=max(10.0, 2.0 * dist_qps),
+                duration_s=min(seconds, 2.0), nq=1,
+                deadline_ms=2 * cfg.degrade_watermark_ms, seed=0)
+            diff2 = obs.snapshot_diff(before, obs.snapshot())
+            results.append({
+                "metric": f"dist_serve_overload_{n//1000}kx{d}"
+                          f"_x{n_shards}_qps",
+                "value": rep["achieved_qps"], "unit": "queries/s",
+                "offered_qps": rep["offered_qps"],
+                "dist_p99_ms": rep["p99_ms"],
+                "watermark_ms": cfg.degrade_watermark_ms,
+                "p99_under_2x_watermark": (
+                    rep["p99_ms"] <= 2 * cfg.degrade_watermark_ms),
+                "shed": rep["shed"],
+                "deadline_expired": rep["deadline_expired"],
+                "merge_bytes_per_rung": loadgen.merge_bytes_by_rung(
+                    diff2.get("counters", {}))})
+        except Exception as e:
+            results.append({
+                "metric": f"dist_serve_overload_{n//1000}kx{d}"
+                          f"_x{n_shards}_qps", "error": repr(e)[:200]})
+    except Exception as e:
+        results.append({"metric": metric, "error": repr(e)[:200]})
+    finally:
+        dist.close()
+        single.close()
+
+
 def _big_enabled() -> bool:
     """Reference-scale shapes (cpp/bench/neighbors/knn.cuh:380-389:
     2M/10M×128, 10k×8192) — hours on the CPU mesh, so opt-in via
@@ -886,7 +1061,8 @@ def bench_host_ivf(results):
 _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_flat, bench_ivf_flat_100k, bench_ivf_pq,
           bench_ivf_pq4,
-          bench_ivf_bq, bench_serve, bench_sharded_build,
+          bench_ivf_bq, bench_serve, bench_serve_sharded,
+          bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,
           bench_ivf_flat_int8, bench_linalg_random, bench_ball_cover,

@@ -103,6 +103,9 @@ class HealthMonitor:
         # next seq to probe per peer in sequence-key fallback mode
         self._peer_next_seq: Dict[int, int] = {}
         self.last_suspects: List[int] = []
+        # ranks whose suspect_rank gauge is currently raised (so a
+        # recovered peer's flag is cleared, not left stale)
+        self._gauged_suspects: Dict[int, bool] = {}
 
     # -- publishing --------------------------------------------------------
     def _key(self, rank: int, seq: Optional[int] = None) -> str:
@@ -255,6 +258,21 @@ class HealthMonitor:
                   session=self.session).set(len(out))
         obs.gauge("raft.comms.health.max_staleness_seconds",
                   session=self.session).set(max_staleness)
+        # per-RANK suspect flags (ISSUE 8): the distributed serving
+        # tier's /healthz folds these into its `dist` section so an
+        # operator sees WHICH shard is failing, not only a count.
+        # Cardinality is bounded by the clique size; previously-suspect
+        # ranks are explicitly cleared so a recovered peer stops
+        # showing degraded
+        for r, was in list(self._gauged_suspects.items()):
+            if was and r not in out:
+                obs.gauge("raft.comms.health.suspect_rank",
+                          session=self.session, rank=r).set(0)
+                self._gauged_suspects[r] = False
+        for r in out:
+            obs.gauge("raft.comms.health.suspect_rank",
+                      session=self.session, rank=r).set(1)
+            self._gauged_suspects[r] = True
         if out:
             obs.counter("raft.comms.health.suspect_events",
                         session=self.session).inc()

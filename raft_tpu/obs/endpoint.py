@@ -92,6 +92,27 @@ def _health_body(snapshot: dict) -> dict:
             "shed_rate_per_s": shed_rate,
             "degrade_level": _gsum("raft.serve.degrade.level"),
         }
+    # distributed serving tier (ISSUE 8): when a mesh-wide server is
+    # active (shards gauge set), surface the mesh shape, the merge
+    # compression it runs at, and — folding the per-shard comms-health
+    # plane — exactly WHICH ranks look failed, so a degraded verdict
+    # names the shard, not only a suspect count
+    dist_shards = _gsum("raft.serve.dist.shards")
+    if dist_shards:
+        raw_ranks = {
+            lbl.split("rank=")[1].rstrip("}").split(",")[0]
+            for lbl, v in gauges.items()
+            if lbl.startswith("raft.comms.health.suspect_rank{")
+            and "rank=" in lbl and v > 0}
+        try:
+            suspect_ranks = sorted(int(r) for r in raw_ranks)
+        except ValueError:
+            suspect_ranks = sorted(raw_ranks)
+        body.setdefault("serve", {})["dist"] = {
+            "shards": dist_shards,
+            "merge_ratio": _gsum("raft.serve.dist.merge.ratio"),
+            "suspect_ranks": suspect_ranks,
+        }
     return body
 
 

@@ -11,6 +11,7 @@ from raft_tpu.parallel.mesh import (make_mesh, shard_rows, replicate,
 from raft_tpu.parallel.knn import distributed_knn
 from raft_tpu.parallel.kmeans import distributed_kmeans_fit, distributed_kmeans_step
 from raft_tpu.parallel.ivf import (
+    get_comms,
     shard_ivf_flat,
     shard_ivf_pq,
     distributed_ivf_flat_search,
@@ -30,6 +31,7 @@ from raft_tpu.parallel.ivf import (
 
 __all__ = [
     "make_mesh", "shard_rows", "replicate", "shard_map_compat",
+    "get_comms",
     "distributed_knn",
     "distributed_kmeans_fit", "distributed_kmeans_step",
     "shard_ivf_flat", "shard_ivf_pq",

@@ -34,6 +34,7 @@ from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.precision import matmul_precision
 from raft_tpu.comms.comms import build_comms
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.parallel.mesh import pcast_varying_compat, shard_map_compat
 from raft_tpu.util.host_sample import sample_rows
 
 
@@ -61,6 +62,25 @@ def _shmap_plan(key, builder):
         obs.counter("raft.parallel.plan.hits").inc()
         spans.current_span().set_attr("shmap_plan", "hit")
     return fn
+
+
+# communicator cache (ISSUE 8 satellite): one Comms per (mesh, axis).
+# build_comms re-runs its axis/bootstrap checks on every call — cheap
+# once, not per serving batch. Ladder-cached serving paths (and every
+# distributed search below) reuse ONE frozen handle per mesh axis;
+# callers holding a custom handle (split comms, non-default timeouts)
+# pass it via the searches' `comms=` parameter instead.
+_COMMS_CACHE: dict = {}
+
+
+def get_comms(mesh: jax.sharding.Mesh, axis: str = "data"):
+    """Cached :class:`~raft_tpu.comms.comms.Comms` over ``mesh[axis]``
+    (the ``build_comms`` result, built once per mesh axis)."""
+    key = (mesh, axis)
+    c = _COMMS_CACHE.get(key)
+    if c is None:
+        c = _COMMS_CACHE[key] = build_comms(mesh, axis)
+    return c
 
 
 def _rank_spans(n_shards: int, t0: float, dt: float) -> None:
@@ -158,10 +178,10 @@ def _fine_scan(queries, get_probe, k: int, n_probes: int, axis: str):
         nd, sel = lax.top_k(-cat_d, k)
         return (-nd, jnp.take_along_axis(cat_i, sel, axis=1)), None
 
-    init = (lax.pcast(jnp.full((nq, k), jnp.inf, jnp.float32),
-                      (axis,), to="varying"),
-            lax.pcast(jnp.full((nq, k), -1, jnp.int32),
-                      (axis,), to="varying"))
+    init = (pcast_varying_compat(jnp.full((nq, k), jnp.inf, jnp.float32),
+                                 (axis,)),
+            pcast_varying_compat(jnp.full((nq, k), -1, jnp.int32),
+                                 (axis,)))
     (d, i), _ = lax.scan(probe_step, init, jnp.arange(n_probes))
     return d, i
 
@@ -177,32 +197,40 @@ def _global_merge(comms, axis, d, i, k):
     return lax.pmax(fd, axis), lax.pmax(fi, axis)
 
 
-def distributed_ivf_flat_search(
-    index, queries, k: int, params=None,
-    mesh: jax.sharding.Mesh = None, axis: str = "data",
-) -> Tuple[jax.Array, jax.Array]:
-    """Search a list-sharded IVF-Flat index (see :func:`shard_ivf_flat`)."""
-    from raft_tpu.neighbors.ivf_flat import SearchParams
-    params = params or SearchParams()
-    expects(mesh is not None, "distributed ivf_flat: mesh is required")
-    from raft_tpu.neighbors.ivf_flat import (_coarse_scores, _metric_kind,
-                                             _postprocess, _score_probe)
-    q = as_array(queries).astype(jnp.float32)
-    expects(q.shape[1] == index.dim, "distributed ivf_flat: dim mismatch")
-    if index.metric == DistanceType.CosineExpanded:
-        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
-                            1e-30)
-    n_shards = mesh.shape[axis]
-    nl_local = index.n_lists // n_shards
-    n_probes = min(params.n_probes, nl_local)
-    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
-                            DistanceType.L2SqrtUnexpanded)
-    kind = _metric_kind(index.metric)
-    scale = float(index.scale)
+def _merge_topk(comms, axis, d, i, k, merge: str, size: int):
+    """Cross-shard top-k merge at the selected wire format: the exact
+    f32 allgather, or the int8 two-stage compressed merge
+    (``serve/merge.py`` — EQuARX-style quantized collective; the
+    ``RAFT_TPU_DIST_MERGE`` story lives there)."""
+    if merge == "int8":
+        from raft_tpu.serve.merge import compressed_merge
+        return compressed_merge(comms, d, i, k, size)
+    return _global_merge(comms, axis, d, i, k)
+
+
+def _resolve_merge(merge):
+    """Library-function default for the cross-shard merge wire format:
+    exact f32 unless ``RAFT_TPU_DIST_MERGE`` (or the caller) opts into
+    the int8 compressed merge. The serving tier (``serve/dist.py``)
+    resolves its own default (int8) — see ``serve/merge.merge_mode``."""
+    if merge is None:
+        from raft_tpu.serve.merge import merge_mode
+        merge = merge_mode(default="f32")
+    expects(merge in ("f32", "int8"),
+            "distributed search: merge must be 'f32' or 'int8', got %r",
+            merge)
+    return merge
+
+
+def _flat_list_plan(mesh, axis: str, k: int, n_probes: int, kind: str,
+                    sqrt: bool, scale: float, merge: str, size: int,
+                    comms):
+    """Cached shard_map program for the list-sharded IVF-Flat search —
+    shared by :func:`distributed_ivf_flat_search` and the serving
+    tier's pre-warmed distributed plan ladder (``serve/dist.py``)."""
+    from raft_tpu.neighbors.ivf_flat import _coarse_scores, _score_probe
 
     def build():
-        comms = build_comms(mesh, axis)
-
         def local(centers, lists_data, lists_indices, lists_norms,
                   q_rep):
             qq = jnp.sum(q_rep * q_rep, axis=1)
@@ -217,20 +245,55 @@ def distributed_ivf_flat_search(
             d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
             if sqrt:
                 d = jnp.sqrt(jnp.maximum(d, 0.0))
-            return _global_merge(comms, axis, d, i, k)
+            return _merge_topk(comms, axis, d, i, k, merge, size)
 
-        return jax.jit(jax.shard_map(
-            local, mesh=mesh,
+        return jax.jit(shard_map_compat(
+            local, mesh,
             in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
                       P(axis, None), P()),
             out_specs=(P(), P())))
 
+    return _shmap_plan(
+        ("flat_list", mesh, axis, k, n_probes, kind, sqrt, scale, merge,
+         size, comms), build)
+
+
+def distributed_ivf_flat_search(
+    index, queries, k: int, params=None,
+    mesh: jax.sharding.Mesh = None, axis: str = "data",
+    comms=None, merge: str = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search a list-sharded IVF-Flat index (see :func:`shard_ivf_flat`).
+
+    ``comms`` — a pre-built communicator handle (default: the cached
+    :func:`get_comms` handle, so repeated serving calls never re-run
+    the bootstrap checks). ``merge`` — cross-shard merge wire format
+    (``f32`` exact | ``int8`` compressed; default f32 unless
+    ``RAFT_TPU_DIST_MERGE`` says otherwise)."""
+    from raft_tpu.neighbors.ivf_flat import SearchParams
+    params = params or SearchParams()
+    expects(mesh is not None, "distributed ivf_flat: mesh is required")
+    from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == index.dim, "distributed ivf_flat: dim mismatch")
+    if index.metric == DistanceType.CosineExpanded:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                            1e-30)
+    n_shards = mesh.shape[axis]
+    nl_local = index.n_lists // n_shards
+    n_probes = min(params.n_probes, nl_local)
+    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
+                            DistanceType.L2SqrtUnexpanded)
+    kind = _metric_kind(index.metric)
+    scale = float(index.scale)
+    merge = _resolve_merge(merge)
+    comms = comms if comms is not None else get_comms(mesh, axis)
+
     with spans.span("raft.parallel.ivf.search", family="ivf_flat",
                     nq=int(q.shape[0]), k=k, n_probes=n_probes,
-                    axis=axis, n_shards=n_shards):
-        shmapped = _shmap_plan(
-            ("flat_list", mesh, axis, k, n_probes, kind, sqrt, scale),
-            build)
+                    axis=axis, n_shards=n_shards, merge=merge):
+        shmapped = _flat_list_plan(mesh, axis, k, n_probes, kind, sqrt,
+                                   scale, merge, int(index.size), comms)
         q_rep = jax.device_put(q, NamedSharding(mesh, P()))
         t0 = time.perf_counter()
         d, i = shmapped(index.centers, index.lists_data,
@@ -239,32 +302,15 @@ def distributed_ivf_flat_search(
     return _postprocess(d, index.metric), i
 
 
-def distributed_ivf_pq_search(
-    index, queries, k: int, params=None,
-    mesh: jax.sharding.Mesh = None, axis: str = "data",
-) -> Tuple[jax.Array, jax.Array]:
-    """Search a list-sharded IVF-PQ index (see :func:`shard_ivf_pq`) via
-    the bf16 reconstruction scan."""
-    from raft_tpu.neighbors.ivf_pq import SearchParams
-    params = params or SearchParams()
-    expects(mesh is not None, "distributed ivf_pq: mesh is required")
-    q = as_array(queries).astype(jnp.float32)
-    expects(q.shape[1] == index.dim, "distributed ivf_pq: dim mismatch")
-    expects(index.decoded is not None,
-            "distributed ivf_pq: index not sharded via shard_ivf_pq")
-    from raft_tpu.neighbors.ivf_flat import (_coarse_scores, _metric_kind,
-                                             _postprocess)
+def _pq_list_plan(mesh, axis: str, k: int, n_probes: int, kind: str,
+                  sqrt: bool, merge: str, size: int, comms):
+    """Cached shard_map program for the list-sharded IVF-PQ
+    (reconstruction-scan) search — shared by
+    :func:`distributed_ivf_pq_search` and the serving tier's ladder."""
+    from raft_tpu.neighbors.ivf_flat import _coarse_scores
     from raft_tpu.neighbors.ivf_pq import _score_probe_reconstruct
-    n_shards = mesh.shape[axis]
-    nl_local = index.n_lists // n_shards
-    n_probes = min(params.n_probes, nl_local)
-    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
-                            DistanceType.L2SqrtUnexpanded)
-    kind = _metric_kind(index.metric)
 
     def build():
-        comms = build_comms(mesh, axis)
-
         def local(centers, centers_rot, rot, decoded, decoded_norms,
                   lists_indices, q_rep):
             coarse = _coarse_scores(q_rep, centers, kind)
@@ -280,20 +326,50 @@ def distributed_ivf_pq_search(
             d, i = _fine_scan(q_rep, get_probe, k, n_probes, axis)
             if sqrt:
                 d = jnp.sqrt(jnp.maximum(d, 0.0))
-            return _global_merge(comms, axis, d, i, k)
+            return _merge_topk(comms, axis, d, i, k, merge, size)
 
-        return jax.jit(jax.shard_map(
-            local, mesh=mesh,
+        return jax.jit(shard_map_compat(
+            local, mesh,
             in_specs=(P(axis, None), P(axis, None), P(),
                       P(axis, None, None), P(axis, None), P(axis, None),
                       P()),
             out_specs=(P(), P())))
 
+    return _shmap_plan(
+        ("pq_list", mesh, axis, k, n_probes, kind, sqrt, merge, size,
+         comms), build)
+
+
+def distributed_ivf_pq_search(
+    index, queries, k: int, params=None,
+    mesh: jax.sharding.Mesh = None, axis: str = "data",
+    comms=None, merge: str = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search a list-sharded IVF-PQ index (see :func:`shard_ivf_pq`) via
+    the bf16 reconstruction scan. ``comms``/``merge`` as in
+    :func:`distributed_ivf_flat_search`."""
+    from raft_tpu.neighbors.ivf_pq import SearchParams
+    params = params or SearchParams()
+    expects(mesh is not None, "distributed ivf_pq: mesh is required")
+    q = as_array(queries).astype(jnp.float32)
+    expects(q.shape[1] == index.dim, "distributed ivf_pq: dim mismatch")
+    expects(index.decoded is not None,
+            "distributed ivf_pq: index not sharded via shard_ivf_pq")
+    from raft_tpu.neighbors.ivf_flat import _metric_kind, _postprocess
+    n_shards = mesh.shape[axis]
+    nl_local = index.n_lists // n_shards
+    n_probes = min(params.n_probes, nl_local)
+    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
+                            DistanceType.L2SqrtUnexpanded)
+    kind = _metric_kind(index.metric)
+    merge = _resolve_merge(merge)
+    comms = comms if comms is not None else get_comms(mesh, axis)
+
     with spans.span("raft.parallel.ivf.search", family="ivf_pq",
                     nq=int(q.shape[0]), k=k, n_probes=n_probes,
-                    axis=axis, n_shards=n_shards):
-        shmapped = _shmap_plan(
-            ("pq_list", mesh, axis, k, n_probes, kind, sqrt), build)
+                    axis=axis, n_shards=n_shards, merge=merge):
+        shmapped = _pq_list_plan(mesh, axis, k, n_probes, kind, sqrt,
+                                 merge, int(index.size), comms)
         q_rep = jax.device_put(q, NamedSharding(mesh, P()))
         t0 = time.perf_counter()
         d, i = shmapped(index.centers, index.centers_rot,
@@ -319,7 +395,6 @@ def distributed_ivf_pq_search(
 from dataclasses import dataclass
 
 from raft_tpu.cluster.kmeans_types import KMeansParams
-from raft_tpu.parallel.mesh import shard_map_compat
 
 
 @dataclass
@@ -466,6 +541,7 @@ def distributed_ivf_flat_build(
 
 def distributed_ivf_flat_search_parts(
     dindex: DistributedIvfFlat, queries, k: int, params=None,
+    comms=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Search a row-sharded multi-part index: every shard probes the
     same global centers, scans its partial probed lists, and the
@@ -486,9 +562,9 @@ def distributed_ivf_flat_search_parts(
     sqrt = dindex.metric in (DistanceType.L2SqrtExpanded,
                              DistanceType.L2SqrtUnexpanded)
 
-    def build():
-        comms = build_comms(mesh, axis)
+    comms = comms if comms is not None else get_comms(mesh, axis)
 
+    def build():
         def local(centers, pdata, pidx, pnorms, q_rep):
             qq = jnp.sum(q_rep * q_rep, axis=1)
             coarse = _coarse_scores(q_rep, centers, kind)
@@ -504,8 +580,8 @@ def distributed_ivf_flat_search_parts(
                 d = jnp.sqrt(jnp.maximum(d, 0.0))
             return _global_merge(comms, axis, d, i, k)
 
-        return jax.jit(jax.shard_map(
-            local, mesh=mesh,
+        return jax.jit(shard_map_compat(
+            local, mesh,
             in_specs=(P(), P(axis, None, None, None),
                       P(axis, None, None), P(axis, None, None), P()),
             out_specs=(P(), P())))
@@ -515,7 +591,8 @@ def distributed_ivf_flat_search_parts(
                     nq=int(q.shape[0]), k=k, n_probes=n_probes,
                     axis=axis, n_shards=n_shards):
         shmapped = _shmap_plan(
-            ("flat_parts", mesh, axis, k, n_probes, kind, sqrt), build)
+            ("flat_parts", mesh, axis, k, n_probes, kind, sqrt, comms),
+            build)
         q_rep = jax.device_put(q, NamedSharding(mesh, P()))
         centers_rep = jax.device_put(dindex.centers,
                                      NamedSharding(mesh, P()))
@@ -667,6 +744,7 @@ def distributed_ivf_pq_build(
 
 def distributed_ivf_pq_search_parts(
     dindex: DistributedIvfPq, queries, k: int, params=None,
+    comms=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Search a row-sharded multi-part IVF-PQ index: per shard, probed
     code blocks decode on the fly (transient, probe-major) and score
@@ -689,7 +767,7 @@ def distributed_ivf_pq_search_parts(
     n_probes = min(params.n_probes, dindex.n_lists)
     sqrt = dindex.metric in (DistanceType.L2SqrtExpanded,
                              DistanceType.L2SqrtUnexpanded)
-    comms = build_comms(mesh, axis)
+    comms = comms if comms is not None else get_comms(mesh, axis)
     pq_dim = dindex.pq_dim
     n_codes = 1 << dindex.pq_bits
     lut_dt = jnp.dtype(params.lut_dtype)
@@ -748,10 +826,9 @@ def distributed_ivf_pq_search_parts(
         return _global_merge(comms, axis, d, i, k)
 
     def build():
-        comms = build_comms(mesh, axis)
         local = functools.partial(_local, comms=comms)
-        return jax.jit(jax.shard_map(
-            local, mesh=mesh,
+        return jax.jit(shard_map_compat(
+            local, mesh,
             in_specs=(P(), P(), P(), P(), P(axis, None, None, None),
                       P(axis, None, None), P(axis, None, None), P()),
             out_specs=(P(), P())))
@@ -762,7 +839,7 @@ def distributed_ivf_pq_search_parts(
                     axis=axis, n_shards=n_shards):
         shmapped = _shmap_plan(
             ("pq_parts", mesh, axis, k, n_probes, kind, sqrt, pq_dim,
-             n_codes, lut_dt.name), build)
+             n_codes, lut_dt.name, comms), build)
         rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
         t0 = time.perf_counter()
         d, i = shmapped(rep(dindex.centers), rep(dindex.centers_rot),
@@ -888,6 +965,7 @@ def distributed_ivf_bq_build(
 
 def distributed_ivf_bq_search_parts(
     dindex: DistributedIvfBq, queries, k: int, params=None,
+    comms=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Search the row-sharded binary index: every shard scans its
     partial probed lists with the 1-bit estimator, the per-shard
@@ -903,10 +981,9 @@ def distributed_ivf_bq_search_parts(
     rescore = params.rescore_factor > 0 and dindex.raw is not None
     kk = max(params.rescore_factor, 1) * k
     dim = dindex.dim
+    comms = comms if comms is not None else get_comms(mesh, axis)
 
     def build():
-        comms = build_comms(mesh, axis)
-
         def local(centers, centers_rot, rot, pbits, pn2, psc, pidx,
                   q_rep):
             coarse = _coarse_scores(q_rep, centers, "l2")
@@ -928,8 +1005,8 @@ def distributed_ivf_bq_search_parts(
             d, i = _fine_scan(q_rep, get_probe, kk, n_probes, axis)
             return _global_merge(comms, axis, d, i, kk)
 
-        return jax.jit(jax.shard_map(
-            local, mesh=mesh,
+        return jax.jit(shard_map_compat(
+            local, mesh,
             in_specs=(P(), P(), P(), P(axis, None, None, None),
                       P(axis, None, None), P(axis, None, None),
                       P(axis, None, None), P()),
@@ -940,7 +1017,7 @@ def distributed_ivf_bq_search_parts(
                     nq=int(q.shape[0]), k=k, n_probes=n_probes,
                     axis=axis, n_shards=n_shards, rescore=rescore):
         shmapped = _shmap_plan(
-            ("bq_parts", mesh, axis, kk, n_probes, dim), build)
+            ("bq_parts", mesh, axis, kk, n_probes, dim, comms), build)
         rep = lambda a: jax.device_put(a, NamedSharding(mesh, P()))
         t0 = time.perf_counter()
         d_est, ids = shmapped(rep(dindex.centers),

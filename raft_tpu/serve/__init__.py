@@ -51,6 +51,8 @@ from raft_tpu.serve.types import (DeadlineExceeded, RejectedError,
 
 __all__ = [
     "DeadlineExceeded",
+    "DistSearchPlan",
+    "DistributedSearchServer",
     "LoadController",
     "OCCUPANCY_BUCKETS",
     "PlanLadder",
@@ -58,4 +60,19 @@ __all__ = [
     "SERVE_LATENCY_BUCKETS",
     "SearchServer",
     "ServeConfig",
+    "build_dist_ladder",
 ]
+
+# the distributed tier (serve/dist.py, ISSUE 8) pulls in jax through
+# the merge codec; resolve it lazily so importing raft_tpu.serve for
+# the error types (the obs endpoint does) stays dependency-light
+_DIST_NAMES = ("DistSearchPlan", "DistributedSearchServer",
+               "build_dist_ladder")
+
+
+def __getattr__(name):
+    if name in _DIST_NAMES:
+        from raft_tpu.serve import dist as _dist
+        return getattr(_dist, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

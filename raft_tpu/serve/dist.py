@@ -1,0 +1,237 @@
+"""Mesh-wide distributed serving tier (ISSUE 8).
+
+The raft::comms / raft-dask L7 layer rebuilt TPU-native as a *serving*
+surface: one ``DistributedSearchServer.submit()`` front door over a
+list-sharded IVF index spanning the whole mesh. It reuses the PR 5
+micro-batcher wholesale — bounded-queue admission, request coalescing,
+deadlines, the n_probes degradation ladder — and swaps the plan layer
+underneath: every (shape, rung) of the ladder is ONE cached shard_map
+program (``parallel/ivf._shmap_plan``) that fans the coalesced batch
+out across every shard's lists and merges the per-shard top-k with the
+quantized cross-shard codec (``serve/merge.py``,
+``RAFT_TPU_DIST_MERGE=f32|int8``, int8 default here — exact re-rank or
+the 0.005 recall budget absorbs the rounding).
+
+Steady-state contract (same as the single-device server, asserted from
+counters in ``tests/test_serve_dist.py`` and reported by
+``bench_serve_sharded`` as ``steady_state_compiles``): after the
+ladder prewarm, serving traffic performs ZERO compiles and zero
+retraces anywhere on the mesh — ``raft.parallel.plan.misses``,
+``raft.plan.cache.misses`` and ``raft.plan.build.total`` all stay
+flat; every dispatch is a ``raft.parallel.plan.hits`` cache hit.
+
+Observability: ``raft.serve.dist.*`` counters/gauges (batches, wire
+bytes pre/post compression per rung, per-shard rows, shard count,
+merge ratio), rank-tagged ``raft.parallel.ivf.shard`` child spans
+under the batcher's ``raft.serve.batch`` root, and a ``/healthz``
+``dist`` section folding the per-shard comms-health suspects
+(docs/serving.md "Distributed serving").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.obs import spans
+from raft_tpu.serve.batcher import SearchServer
+from raft_tpu.serve.ladder import PlanLadder
+from raft_tpu.serve.merge import merge_mode, merge_wire_bytes
+from raft_tpu.serve.types import ServeConfig
+
+__all__ = [
+    "DistSearchPlan",
+    "DistributedSearchServer",
+    "build_dist_ladder",
+]
+
+
+def _resolve_family(index) -> str:
+    """Which distributed search serves this list-sharded index."""
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    if isinstance(index, ivf_flat.Index):
+        return "ivf_flat"
+    if isinstance(index, ivf_pq.Index):
+        expects(index.decoded is not None,
+                "serve.dist: IVF-PQ index has no reconstruction cache — "
+                "shard it via shard_ivf_pq / sharded_ivf_pq_build first")
+        return "ivf_pq"
+    expects(False, "serve.dist: unsupported index type %s (want a "
+            "list-sharded ivf_flat/ivf_pq Index)", type(index).__name__)
+
+
+class DistSearchPlan:
+    """Plan-like object (the :class:`PlanLadder` contract: ``search``,
+    ``nq``, ``n_probes``) over one (nq, rung) operating point of a
+    list-sharded index: each ``search`` is ONE cached shard_map
+    dispatch over the whole mesh, merge wire format pinned at build."""
+
+    def __init__(self, family: str, index, mesh, axis: str, nq: int,
+                 k: int, params, merge: str, comms, level: int = 0):
+        self.family = family
+        self.nq = int(nq)
+        self.dim = int(index.dim)
+        self.k = int(k)
+        self.n_probes = int(min(
+            params.n_probes, index.n_lists // mesh.shape[axis]))
+        self.merge = merge
+        self.mesh = mesh
+        self.axis = axis
+        self.level = int(level)
+        self.n_shards = int(mesh.shape[axis])
+        self._index = index
+        self._params = params
+        self._comms = comms
+        # analytic per-dispatch wire accounting (serve/merge.py): the
+        # trace-time collective counters fire once per program, these
+        # fire per batch
+        self._bytes_pre, self._bytes_post = merge_wire_bytes(
+            self.nq, self.k, self.n_shards, merge, int(index.size))
+        # profitability gate: at tiny shapes (nq < n_shards) the
+        # two-stage codec's per-row metadata outweighs the f32
+        # allgather it replaces — compressing would INFLATE the wire.
+        # Those rungs serve f32; the ladder's saturated shapes carry
+        # the compression (EQuARX gates quantization the same way)
+        if merge == "int8" and 0 < self._bytes_pre <= self._bytes_post:
+            self.merge = merge = "f32"
+            self._bytes_post = self._bytes_pre
+
+    @property
+    def merge_ratio(self) -> float:
+        return (self._bytes_post / self._bytes_pre
+                if self._bytes_pre else 1.0)
+
+    def search(self, queries, block: bool = False
+               ) -> Tuple[object, object]:
+        """Serve one batch of exactly ``plan.nq`` queries across the
+        mesh → (dists, ids), both (nq, k), identical on every rank."""
+        from raft_tpu.parallel import ivf as pivf
+        q = np.asarray(queries, np.float32)
+        expects(q.shape == (self.nq, self.dim),
+                "dist plan.search: queries %s != plan shape (%d, %d)",
+                q.shape, self.nq, self.dim)
+        obs.counter("raft.serve.dist.batches", level=self.level).inc()
+        obs.counter("raft.serve.dist.queries").inc(self.nq)
+        obs.counter("raft.serve.dist.merge.bytes_pre",
+                    level=self.level).inc(self._bytes_pre)
+        obs.counter("raft.serve.dist.merge.bytes_post",
+                    level=self.level).inc(self._bytes_post)
+        # per-shard row accounting: queries replicate, so every shard
+        # scans its own lists for all nq rows (cardinality = mesh size)
+        obs.counter("raft.serve.dist.shard.rows").inc(
+            self.nq * self.n_shards)
+        with spans.span("raft.serve.dist.dispatch", family=self.family,
+                        nq=self.nq, k=self.k, n_probes=self.n_probes,
+                        n_shards=self.n_shards, merge=self.merge,
+                        level=self.level):
+            if self.family == "ivf_flat":
+                d, i = pivf.distributed_ivf_flat_search(
+                    self._index, q, self.k, self._params,
+                    mesh=self.mesh, axis=self.axis, comms=self._comms,
+                    merge=self.merge)
+            else:
+                d, i = pivf.distributed_ivf_pq_search(
+                    self._index, q, self.k, self._params,
+                    mesh=self.mesh, axis=self.axis, comms=self._comms,
+                    merge=self.merge)
+        if block:
+            import jax
+            jax.block_until_ready((d, i))
+        return d, i
+
+
+def build_dist_ladder(index, rep_queries, k: int, params=None,
+                      mesh=None, axis: str = "data",
+                      shapes: Tuple[int, ...] = (1, 8, 32, 128),
+                      probes_ladder: Tuple[int, ...] = (),
+                      prewarm: bool = True,
+                      merge: Optional[str] = None) -> PlanLadder:
+    """Pre-warm the (shape × rung) grid of distributed plans over a
+    list-sharded index → a :class:`PlanLadder` the micro-batcher serves
+    from. With ``prewarm`` every grid point executes once at build, so
+    steady-state traffic never compiles anywhere on the mesh."""
+    expects(mesh is not None, "build_dist_ladder: mesh is required")
+    from raft_tpu.neighbors import plan as plan_mod
+    from raft_tpu.parallel import ivf as pivf
+    family = _resolve_family(index)
+    if params is None:
+        params = plan_mod._default_params(family)
+    merge = merge_mode(default="int8") if merge is None else merge
+    expects(merge in ("f32", "int8"),
+            "build_dist_ladder: merge must be 'f32' or 'int8', got %r",
+            merge)
+    comms = pivf.get_comms(mesh, axis)
+    q = np.asarray(rep_queries, np.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim,
+            "build_dist_ladder: rep_queries must be (nq, dim=%d), "
+            "got %s", index.dim, q.shape)
+    nl_local = index.n_lists // mesh.shape[axis]
+    rungs = tuple(probes_ladder) or (min(params.n_probes, nl_local),)
+    plans = {}
+    for ri, n_probes in enumerate(rungs):
+        p_r = dataclasses.replace(params, n_probes=n_probes)
+        for s in shapes:
+            plan = DistSearchPlan(family, index, mesh, axis, s, k, p_r,
+                                  merge, comms, level=ri)
+            if prewarm:
+                reps = -(-s // q.shape[0])
+                plan.search(np.tile(q, (reps, 1))[:s], block=True)
+            plans[(s, ri)] = plan
+    return PlanLadder(shapes=tuple(shapes), rungs=rungs, plans=plans,
+                      dim=index.dim, k=k)
+
+
+class DistributedSearchServer(SearchServer):
+    """The mesh-wide serving front door: ``submit() -> Future`` with
+    the full single-device robustness contract (bounded queue,
+    deadlines, degradation ladder — all inherited), each coalesced
+    batch dispatched as one cached shard_map program over the
+    list-sharded index with the quantized cross-shard merge."""
+
+    # same dispatcher/caller thread boundary as the base server (GL003
+    # static race contract — redeclared because the rule is per-class):
+    # this subclass adds NO cross-thread state; add a field another
+    # thread writes and it belongs in this tuple AND under self._cond
+    GUARDED_BY = ("_q", "_rows_queued", "_closed", "_shed_times")
+
+    def __init__(self, ladder: PlanLadder,
+                 config: Optional[ServeConfig] = None,
+                 start: bool = True):
+        p0 = ladder.plan_for(ladder.shapes[0], 0)[1]
+        expects(isinstance(p0, DistSearchPlan),
+                "DistributedSearchServer: ladder must hold "
+                "DistSearchPlans (build via build_dist_ladder)")
+        # the ratio gauge reports the SATURATED operating point (the
+        # largest ladder shape) — tiny shapes ride the profitability
+        # fallback and would misstate the compression
+        p_top = ladder.plan_for(ladder.max_shape, 0)[1]
+        obs.gauge("raft.serve.dist.shards").set(p0.n_shards)
+        obs.gauge("raft.serve.dist.merge.ratio").set(
+            round(p_top.merge_ratio, 4))
+        super().__init__(ladder, config, start=start)
+
+    @property
+    def mesh(self):
+        return self.ladder.plan_for(self.ladder.shapes[0], 0)[1].mesh
+
+    @classmethod
+    def from_sharded_index(cls, index, rep_queries, k: int, params=None,
+                           mesh=None, axis: str = "data",
+                           config: Optional[ServeConfig] = None,
+                           merge: Optional[str] = None,
+                           start: bool = True
+                           ) -> "DistributedSearchServer":
+        """Build + pre-warm the distributed plan ladder for a
+        list-sharded ``index`` (``shard_ivf_*`` / ``sharded_*_build``
+        layout) and start serving the mesh."""
+        config = config if config is not None else ServeConfig()
+        ladder = build_dist_ladder(
+            index, rep_queries, k, params, mesh=mesh, axis=axis,
+            shapes=config.batch_sizes,
+            probes_ladder=config.probes_ladder,
+            prewarm=config.prewarm, merge=merge)
+        return cls(ladder, config, start=start)

@@ -449,6 +449,22 @@ class TestBaselineContract:
             REPO, files=[os.path.join(REPO, "raft_tpu", "serve")])
         assert findings == []
 
+    def test_dist_serving_tier_carries_zero_baseline(self):
+        """ISSUE 8 acceptance: the new distributed serving tier
+        (serve/dist.py + serve/merge.py) ships GL002/GL003-clean with
+        an EMPTY baseline — no grandfathered findings, and a fresh
+        lint of just those files agrees."""
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        assert not [k for k in allow
+                    if k[1] in ("raft_tpu/serve/dist.py",
+                                "raft_tpu/serve/merge.py")]
+        findings, _ = engine.run(
+            REPO, files=[
+                os.path.join(REPO, "raft_tpu", "serve", "dist.py"),
+                os.path.join(REPO, "raft_tpu", "serve", "merge.py")])
+        assert findings == []
+
     def test_no_grandfathered_findings_in_parallel(self):
         """ISSUE 7 satellite: the per-build shard_map sites in
         parallel/ now ride the keyed _shmap_plan cache — their GL002
@@ -498,6 +514,28 @@ class TestRealTreeRegressions:
         from raft_tpu.serve.batcher import SearchServer
         assert set(SearchServer.GUARDED_BY) >= {
             "_q", "_rows_queued", "_closed", "_shed_times"}
+
+    def test_dist_dispatcher_declares_guarded_fields(self):
+        """ISSUE 8 satellite: the distributed dispatcher redeclares the
+        GL003 contract (the rule is per-class — an inherited tuple
+        would not be seen statically)."""
+        import ast
+        from raft_tpu.serve.dist import DistributedSearchServer
+        assert set(DistributedSearchServer.GUARDED_BY) >= {
+            "_q", "_rows_queued", "_closed", "_shed_times"}
+        # and the declaration is a LITERAL on the class body, where
+        # the static rule reads it
+        tree = ast.parse(open(os.path.join(
+            REPO, "raft_tpu", "serve", "dist.py")).read())
+        cls = next(n for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef)
+                   and n.name == "DistributedSearchServer")
+        decls = [s for s in cls.body if isinstance(s, ast.Assign)
+                 and any(isinstance(t, ast.Name)
+                         and t.id == "GUARDED_BY"
+                         for t in s.targets)]
+        assert decls, "DistributedSearchServer must declare " \
+                      "GUARDED_BY literally"
 
     def test_controller_documents_single_writer(self):
         from raft_tpu.serve.controller import LoadController

@@ -101,6 +101,19 @@ REQUIRED_NAMES = (
     "raft.serve.queue.depth",
     "raft.serve.batch.rows",
     "raft.plan.cache.evictions",
+    # distributed serving tier (ISSUE 8): per-batch dispatch volume,
+    # the quantized cross-shard merge wire accounting the
+    # merge_bytes_ratio acceptance figure reads, the mesh-size/ratio
+    # gauges /healthz folds in, and the per-rank suspect flags the
+    # dist health section names shards from
+    "raft.serve.dist.batches",
+    "raft.serve.dist.queries",
+    "raft.serve.dist.merge.bytes_pre",
+    "raft.serve.dist.merge.bytes_post",
+    "raft.serve.dist.shard.rows",
+    "raft.serve.dist.shards",
+    "raft.serve.dist.merge.ratio",
+    "raft.comms.health.suspect_rank",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -128,6 +141,10 @@ REQUIRED_SPAN_NAMES = (
     "raft.serve.queue_wait",
     "raft.serve.execute",
     "raft.serve.batch",
+    # distributed serving tier (ISSUE 8): the per-batch mesh dispatch
+    # root under raft.serve.batch (the rank-tagged
+    # raft.parallel.ivf.shard children ride under it)
+    "raft.serve.dist.dispatch",
 )
 
 

@@ -18,6 +18,11 @@ runnable standalone:
     # (and recall) step down — and step back up as the queue drains
     python tools/loadgen.py --demo
 
+    # the same demo against the mesh-wide distributed tier (ISSUE 8):
+    # list-sharded index over every local device, quantized cross-shard
+    # merge; the overload report adds per-rung merge bytes next to p99
+    python tools/loadgen.py --server dist --demo
+
 Reports land as one JSON line: offered/completed/shed/deadline counts,
 achieved QPS, accepted-latency p50/p99, and the ``raft.serve.*``
 metrics diff of the run (batch occupancy, degrade steps, per-level
@@ -145,7 +150,8 @@ def measure_sustainable_qps(server, query_pool: np.ndarray, nq: int = 1,
 
 
 def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
-                       probes_ladder, deadline_ms: float):
+                       probes_ladder, deadline_ms: float,
+                       server: str = "single"):
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.random import make_blobs
@@ -155,18 +161,48 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
     q, _ = make_blobs(n_samples=512, n_features=dim,
                       centers=max(8, n // 200), seed=1)
     x, q = np.asarray(x), np.asarray(q)
-    index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
-                                                   kmeans_n_iters=4))
     cfg = serve.ServeConfig(
         batch_sizes=(1, 8, 32), max_queue=256, max_wait_ms=2.0,
         probes_ladder=tuple(probes_ladder),
         default_deadline_ms=deadline_ms,
         degrade_watermark_ms=200.0, upgrade_watermark_ms=20.0,
         degrade_cooldown_ms=50.0)
+    if server == "dist":
+        # the mesh-wide tier (ISSUE 8): list-shard the index over every
+        # local device, serve through the distributed plan ladder with
+        # the quantized cross-shard merge
+        from raft_tpu.parallel import shard_ivf_flat
+        from raft_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+        n_shards = mesh.shape["data"]
+        if n_lists % n_shards:
+            n_lists = max(n_shards, n_lists // n_shards * n_shards)
+        index = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=n_lists, kmeans_n_iters=4))
+        sindex = shard_ivf_flat(index, mesh)
+        params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
+        srv = serve.DistributedSearchServer.from_sharded_index(
+            sindex, q[:32], k=k, params=params, mesh=mesh, config=cfg)
+        return srv, q
+    index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
+                                                   kmeans_n_iters=4))
     params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
     srv = serve.SearchServer.from_index(index, q[:32], k=k,
                                         params=params, config=cfg)
     return srv, q
+
+
+def merge_bytes_by_rung(metrics_diff: dict) -> dict:
+    """Per-rung compressed merge-bytes out of a ``raft.serve.*``
+    counters diff (the ``raft.serve.dist.merge.bytes_post{level=r}``
+    series) — the overload demo prints these next to p99 so an
+    operator sees what each degradation rung costs on the wire."""
+    out = {}
+    for key, v in metrics_diff.items():
+        if key.startswith("raft.serve.dist.merge.bytes_post{"):
+            level = key.split("level=")[1].rstrip("}").split(",")[0]
+            out[f"rung_{level}"] = out.get(f"rung_{level}", 0) + int(v)
+    return out
 
 
 def main(argv=None) -> int:
@@ -185,6 +221,12 @@ def main(argv=None) -> int:
     ap.add_argument("--probes-ladder", type=str, default="32,16,8",
                     help="comma-separated descending n_probes rungs")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--server", choices=("single", "dist"),
+                    default="single",
+                    help="serving tier: 'single' = one-device "
+                         "SearchServer, 'dist' = DistributedSearchServer "
+                         "over a mesh of every local device (list-"
+                         "sharded index, quantized cross-shard merge)")
     ap.add_argument("--demo", action="store_true",
                     help="overload demo: offer 2x the calibrated "
                          "sustainable rate and show the ladder holding "
@@ -193,7 +235,8 @@ def main(argv=None) -> int:
 
     ladder = tuple(int(s) for s in args.probes_ladder.split(","))
     srv, q = _build_demo_server(args.n, args.dim, args.n_lists, args.k,
-                                ladder, args.deadline_ms)
+                                ladder, args.deadline_ms,
+                                server=args.server)
     try:
         if args.demo:
             from raft_tpu import obs
@@ -211,6 +254,11 @@ def main(argv=None) -> int:
             report["watermark_ms"] = srv.config.degrade_watermark_ms
             report["p99_under_watermark"] = (
                 report["p99_ms"] <= srv.config.degrade_watermark_ms)
+            if args.server == "dist":
+                # what each degradation rung cost on the wire, next to
+                # the p99 it bought (ISSUE 8 satellite)
+                report["merge_bytes_per_rung"] = merge_bytes_by_rung(
+                    report["serve_metrics"])
             print(json.dumps(report), flush=True)
             # drain: the ladder must step back up once load stops
             t0 = time.perf_counter()

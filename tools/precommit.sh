@@ -71,6 +71,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# distributed serving tier (ISSUE 8): the int8 merge codec round-trip
+# + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
+# mesh, pad-row non-leakage through the distributed scatter, and the
+# zero-steady-state-compile contract of the mesh-wide ladder.
+echo "precommit: distributed serving tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve_dist.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 echo "precommit: tier-1 pytest (ROADMAP.md)"
 set -o pipefail
 rm -f /tmp/_t1.log
