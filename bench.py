@@ -339,6 +339,35 @@ def child_main():
         except Exception as e:
             out["dist_serve_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # mutable-index row (ISSUE 9): recall parity of fold-compaction
+        # vs a from-scratch rebuild after 10k interleaved mutations,
+        # plus sustained serving QPS under a concurrent mutation stream
+        # with the zero-downtime / zero-steady-state-compile contracts
+        try:
+            rows = []
+            bench_suite.bench_mutate(rows, n=n_ivf, nlists=nlists)
+            for r in rows:
+                if "mutate_recall" in r:
+                    out["mutate_recall"] = r["mutate_recall"]
+                    out["mutate_rebuild_recall"] = r["rebuild_recall"]
+                    out["mutate_recall_gap"] = r["recall_gap"]
+                    out["mutate_apply_qps"] = r["mutate_apply_qps"]
+                    out["mutate_compact_s"] = r["compact_s"]
+                elif "mutate_serve_qps" in r:
+                    out["mutate_serve_qps"] = r["mutate_serve_qps"]
+                    out["mutate_serve_p99_ms"] = \
+                        r["mutate_serve_p99_ms"]
+                    out["mutate_steady_state_compiles"] = \
+                        r["steady_state_compiles"]
+                    out["mutate_failed_requests"] = \
+                        r["failed_requests"]
+                    out["mutate_compactions_in_window"] = \
+                        r["compactions_in_window"]
+                elif "error" in r:
+                    out.setdefault("mutate_error", r["error"])
+        except Exception as e:
+            out["mutate_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

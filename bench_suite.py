@@ -936,6 +936,202 @@ def _bench_brute(results, n, size_tag, key_seed):
         "marginal_qps": round(nq / t_marg, 1)})
 
 
+def bench_mutate(results, n=None, nlists=1024, n_probes=None):
+    """Live mutable index bench (ISSUE 9), two rows at the flat bench
+    point:
+
+    1. **recall parity** — ``BENCH_MUTATE_MUTS`` (default 10k)
+       interleaved upserts/deletes (3:1) applied through the delta
+       segment, then ONE fold compaction; recall of the compacted
+       index vs a FROM-SCRATCH rebuild of the identical live corpus,
+       both against the exact scan (acceptance: gap within 0.01).
+       ``mutate_apply_qps`` (mutation ingest rate) and
+       ``compact_s`` ride along.
+    2. **serving under a mutation stream** — closed-loop clients
+       against ``SearchServer.from_index(MutableIndex)`` while a
+       writer thread streams upsert/delete batches: sustained
+       ``mutate_serve_qps`` with ``steady_state_compiles`` asserted
+       from the plan-cache counters over the no-compaction window,
+       then one triggered compaction under load with
+       ``failed_requests`` (acceptance: 0 — zero serving downtime).
+
+    Knobs: ``BENCH_MUTATE_N`` (corpus rows, 100k),
+    ``BENCH_MUTATE_MUTS`` (mutations, 10k),
+    ``BENCH_MUTATE_SECONDS`` (serve window, 2.0),
+    ``BENCH_MUTATE_CLIENTS`` (8)."""
+    import threading
+    from raft_tpu import mutate, obs, serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.neighbors.brute_force import brute_force_knn
+    if n is None:
+        n = int(os.environ.get("BENCH_MUTATE_N", 100_000))
+    n_muts = int(os.environ.get("BENCH_MUTATE_MUTS", 10_000))
+    if n_probes is None:
+        n_probes = FLAT_PROBES
+    n_probes = min(n_probes, nlists)
+    d, nq, k = 128, 256, 32
+    n_up = (3 * n_muts) // 4              # 3:1 upsert:delete mix
+    n_del = n_muts - n_up
+    db_all, q = _ann_dataset(n + n_up, d, nq)
+    db_all, q = np.asarray(db_all), np.asarray(q)
+    db, reserve = db_all[:n], db_all[n:]
+    params = ivf_flat.IndexParams(n_lists=nlists, kmeans_n_iters=10)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    index = ivf_flat.build(db, params)
+    top = 1 << max(14, (n_up + 256).bit_length())
+    m = mutate.MutableIndex(
+        index, k=k, params=sp,
+        config=mutate.MutateConfig(delta_capacities=(top // 4, top)))
+    m.warmup(q[:nq], shapes=(nq,))
+
+    rng = np.random.default_rng(11)
+    del_ids = rng.choice(n, size=n_del, replace=False)
+    # interleave in batches: 3 upsert batches per delete batch
+    bs = 256
+    t0 = time.perf_counter()
+    up_off = del_off = 0
+    while up_off < n_up or del_off < n_del:
+        for _ in range(3):
+            if up_off < n_up:
+                m.upsert(reserve[up_off:up_off + bs])
+                up_off += min(bs, n_up - up_off)
+        if del_off < n_del:
+            m.delete(del_ids[del_off:del_off + bs])
+            del_off += min(bs, n_del - del_off)
+    apply_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m.compact()
+    compact_s = time.perf_counter() - t0
+
+    # live corpus ground truth: deleted rows out, upserts appended;
+    # mutable ids map positions -> global id space
+    keep = np.ones(n, bool)
+    keep[del_ids] = False
+    live_db = np.concatenate([db[keep], reserve[:n_up]], axis=0)
+    live_ids = np.concatenate([np.arange(n)[keep],
+                               np.arange(n, n + n_up)]).astype(np.int32)
+    _, i_exact = brute_force_knn(live_db, q, k, mode="exact")
+    exact_ids = live_ids[np.asarray(i_exact)]
+
+    def _recall(ids_got):
+        g = np.asarray(ids_got)
+        return float(np.mean([len(set(g[r]) & set(exact_ids[r])) / k
+                              for r in range(len(g))]))
+
+    _, i_m = m.search(q, block=True)
+    rec_mutate = _recall(i_m)
+    rebuilt = ivf_flat.build(live_db, params)
+    _, i_r = ivf_flat.search(rebuilt, q, k, sp)
+    rec_rebuild = _recall(live_ids[np.asarray(i_r)])
+    results.append({
+        "metric": f"mutate_recall_{n//1000}kx{d}_m{n_muts}"
+                  f"_k{k}_p{n_probes}",
+        "value": round(rec_mutate, 4), "unit": "recall",
+        "mutate_recall": round(rec_mutate, 4),
+        "rebuild_recall": round(rec_rebuild, 4),
+        "recall_gap": round(rec_rebuild - rec_mutate, 4),
+        "mutations": n_muts,
+        "mutate_apply_qps": round(n_muts / apply_s, 1),
+        "compact_s": round(compact_s, 3)})
+
+    # -- serving under a concurrent mutation stream ----------------------
+    seconds = float(os.environ.get("BENCH_MUTATE_SECONDS", 2.0))
+    clients = int(os.environ.get("BENCH_MUTATE_CLIENTS", 8))
+    cfg = serve.ServeConfig(batch_sizes=(1, 8, 32, 128), max_queue=512,
+                            max_wait_ms=2.0)
+    server = serve.SearchServer.from_index(m, q[:128], k, config=cfg)
+    comp = mutate.Compactor(m)
+    stop_evt = threading.Event()
+    mut_counts = [0]
+
+    def writer():
+        i = 0
+        while not stop_evt.is_set():
+            try:
+                ids = m.upsert(reserve[(i * 64) % n_up:
+                                       (i * 64) % n_up + 64])
+                if i % 4 == 3:
+                    m.delete(ids[:16])
+                mut_counts[0] += 1
+            except mutate.DeltaFullError:
+                time.sleep(0.01)
+            i += 1
+            time.sleep(0.002)
+
+    lats, fails = [], [0]
+    lock = threading.Lock()
+
+    def client(tid):
+        my, i = [], tid
+        while time.perf_counter() < stop_at:
+            t1 = time.perf_counter()
+            try:
+                server.search(q[i % nq:i % nq + 1])
+                my.append(time.perf_counter() - t1)
+            except Exception:
+                with lock:
+                    fails[0] += 1
+            i += clients
+        with lock:
+            lats.extend(my)
+
+    try:
+        before = obs.snapshot()
+        wt = threading.Thread(target=writer, daemon=True)
+        stop_at = time.perf_counter() + seconds
+        t0 = time.perf_counter()
+        wt.start()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        # steady window compiles (the compactor may have folded — its
+        # prewarm compiles are off the serving path; report them apart)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+        compactions = cnt.get("raft.mutate.compact.total", 0.0)
+        compiles = (cnt.get("raft.plan.cache.misses", 0.0)
+                    + cnt.get("raft.plan.build.total", 0.0))
+        # one forced compaction under continuing load: serving must
+        # not drop a single request through the swap
+        stop_at = time.perf_counter() + min(seconds, 1.0)
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        comp.trigger()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop_evt.set()
+        wt.join(timeout=5.0)
+        lats.sort()
+
+        def pct(p):
+            return (lats[min(len(lats) - 1,
+                             int(p / 100 * (len(lats) - 1)))] * 1e3
+                    if lats else float("nan"))
+
+        results.append({
+            "metric": f"mutate_serve_{n//1000}kx{d}_q1_k{k}"
+                      f"_p{n_probes}_qps",
+            "value": round(len(lats) / wall, 1), "unit": "queries/s",
+            "mutate_serve_qps": round(len(lats) / wall, 1),
+            "mutate_serve_p50_ms": round(pct(50), 3),
+            "mutate_serve_p99_ms": round(pct(99), 3),
+            "mutation_batches": mut_counts[0],
+            "compactions_in_window": int(compactions),
+            "steady_state_compiles": (0 if compactions else
+                                      int(compiles)),
+            "failed_requests": fails[0]})
+    finally:
+        stop_evt.set()
+        comp.close()
+        server.close()
+
+
 def bench_brute_500k(results):
     # the IVF bench point's brute baseline, default-on so the
     # bfknn_fused_500k gate (wall-QPS floor 35k — see PERF_GATES) has
@@ -1062,6 +1258,7 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_flat, bench_ivf_flat_100k, bench_ivf_pq,
           bench_ivf_pq4,
           bench_ivf_bq, bench_serve, bench_serve_sharded,
+          bench_mutate,
           bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,

@@ -1,0 +1,52 @@
+"""raft_tpu.mutate — live mutable indexes over the serving stack.
+
+The capability gap this closes (ROADMAP item 3): RAFT's IVF indexes
+are build-once, but a served corpus changes while serving — and until
+now every upsert or delete was a full rebuild. ``MutableIndex`` wraps
+any built ivf_flat / ivf_pq / ivf_bq index with
+
+* an append-only **delta segment** on a pre-warmed fixed-capacity
+  shape ladder (no mutation ever triggers an XLA recompile — the
+  ``serve/ladder.py`` discipline applied to growing state, the Ragged
+  Paged Attention move, arxiv 2604.15464),
+* **tombstone bitmaps** for deletes, filtered at postprocess inside
+  the compiled search program (upsert = tombstone + append),
+* a **background compactor** that folds the delta into the main lists
+  (family ``extend`` with frozen centers, or a from-scratch rebuild
+  through PR 4's streaming/sharded build machinery) and atomically
+  swaps epochs under live traffic — zero serving downtime, zero
+  steady-state compiles (the next epoch's program grid is pre-warmed
+  on the compactor thread before the swap).
+
+Quick use::
+
+    from raft_tpu import mutate, serve
+    from raft_tpu.neighbors import ivf_flat
+
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
+    m = mutate.MutableIndex(index, k=10)
+    srv = serve.SearchServer.from_index(m, sample_queries, k=10)
+    comp = mutate.Compactor(m)           # background folds
+    m.upsert(new_rows); m.delete([12, 99])
+    dists, ids = srv.search(queries)     # live view, through the batcher
+    comp.close(); srv.close()
+
+Observability rides the ``raft.mutate.*`` taxonomy
+(docs/observability.md); ``/healthz`` degrades when the delta hits its
+top rung with no compaction running. Architecture + capacity planning:
+docs/mutability.md.
+"""
+
+from raft_tpu.mutate.compactor import Compactor
+from raft_tpu.mutate.mutable import (MutableIndex, build_dist_serve_ladder,
+                                     build_serve_ladder)
+from raft_tpu.mutate.types import DeltaFullError, MutateConfig
+
+__all__ = [
+    "Compactor",
+    "DeltaFullError",
+    "MutableIndex",
+    "MutateConfig",
+    "build_dist_serve_ladder",
+    "build_serve_ladder",
+]

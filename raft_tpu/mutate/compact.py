@@ -1,0 +1,180 @@
+"""Compaction: fold the delta segment + tombstones into the main lists.
+
+Two modes (``MutateConfig.compact_mode``):
+
+* **fold** (default) — coarse centers stay FROZEN: tombstoned slots are
+  purged (their ``lists_indices`` entries flip to -1, the universal
+  dead-slot sentinel every scan tier masks on), then the live delta
+  rows ride the family's ``extend`` path (label against the trained
+  centers, encode with the frozen codebooks/rotation, one re-bucketize
+  of the combined set). O(n) re-bucket, no re-training — the
+  steady-state mode a serving system can afford on every compaction.
+* **rebuild** — from-scratch re-train on the reconstructed live corpus
+  (IVF-Flat only: flat lists dequantize back to the exact rows). Routes
+  through ``host_memory.build_streaming`` when a chunk budget is set
+  (O(chunk) device memory — PR 4's streaming ingestion) or through
+  ``parallel.ivf.sharded_ivf_flat_build`` when a mesh is passed (the
+  sharded list-layout build, landing directly in the serving layout) —
+  the periodic center-refresh that bounds drift after many folds.
+
+Everything here runs on the COMPACTOR thread against an immutable
+snapshot (rows + tombstone set frozen under the index lock); the
+serving path never blocks on any of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+__all__ = ["fold", "purge", "reconstruct_rows"]
+
+
+def _family(index) -> str:
+    from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
+    if isinstance(index, ivf_flat.Index):
+        return "ivf_flat"
+    if isinstance(index, ivf_pq.Index):
+        return "ivf_pq"
+    if isinstance(index, ivf_bq.Index):
+        return "ivf_bq"
+    expects(False, "mutate: unsupported index type %s (want ivf_flat/"
+            "ivf_pq/ivf_bq Index)", type(index).__name__)
+
+
+def purge(index, tombstoned_ids):
+    """Drop tombstoned rows from the main lists WITHOUT re-bucketing:
+    their ``lists_indices`` slots flip to -1 — the pad sentinel every
+    scan tier already masks to +inf — and the per-list sizes / logical
+    size are refreshed. Returns a new Index sharing the untouched
+    arrays (cheap; the stale payload bytes in dead slots are never
+    scored)."""
+    tombs = np.asarray(sorted(tombstoned_ids), dtype=np.int64)
+    if tombs.size == 0:
+        return index, 0
+    ids = np.asarray(index.lists_indices)
+    dead = (ids >= 0) & np.isin(ids, tombs)
+    n_removed = int(dead.sum())
+    if n_removed == 0:
+        return index, 0
+    new_ids = np.where(dead, np.int32(-1), ids)
+    sizes = (new_ids >= 0).sum(axis=1).astype(np.int32)
+    return dataclasses.replace(
+        index, lists_indices=jnp.asarray(new_ids),
+        list_sizes=jnp.asarray(sizes),
+        size=int(index.size) - n_removed), n_removed
+
+
+def reconstruct_rows(index):
+    """(rows, ids) of every live slot of an IVF-Flat index, dequantized
+    to f32 — the rebuild-mode corpus. Row order is list-major (the
+    bucketize order), which is irrelevant to a re-train."""
+    from raft_tpu.neighbors import ivf_flat
+    expects(isinstance(index, ivf_flat.Index),
+            "mutate: rebuild compaction reconstructs rows from flat "
+            "lists only — use compact_mode='fold' for ivf_pq/ivf_bq")
+    ids = np.asarray(index.lists_indices).reshape(-1)
+    valid = ids >= 0
+    data = np.asarray(index.lists_data).reshape(-1, index.dim)[valid]
+    if data.dtype == np.int8:
+        data = data.astype(np.float32) * float(index.scale)
+    else:
+        data = np.asarray(data, np.float32)
+    return data, ids[valid].astype(np.int32)
+
+
+def fold(index, delta_rows, delta_ids, tombstoned_ids,
+         mode: str = "fold", mesh=None, axis: str = "data",
+         stream_chunk: int = 0, params=None):
+    """Produce the next epoch's index from the frozen snapshot: purge
+    tombstones, then absorb the live delta rows. See the module doc for
+    the two modes; ``mesh``/``stream_chunk`` select the PR 4 sharded /
+    streaming build machinery under ``mode='rebuild'``."""
+    from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
+    fam = _family(index)
+    delta_rows = np.asarray(delta_rows, np.float32)
+    delta_ids = np.asarray(delta_ids, np.int32)
+    expects(delta_rows.shape[0] == delta_ids.shape[0],
+            "mutate.fold: %d rows vs %d ids", delta_rows.shape[0],
+            delta_ids.shape[0])
+    purged, _removed = purge(index, tombstoned_ids)
+    if mode == "rebuild":
+        return _rebuild(purged, delta_rows, delta_ids, mesh=mesh,
+                        axis=axis, stream_chunk=stream_chunk,
+                        params=params)
+    expects(mode == "fold", "mutate.fold: unknown mode %r", mode)
+    if delta_rows.shape[0] == 0:
+        return purged
+    ext = {"ivf_flat": ivf_flat.extend, "ivf_pq": ivf_pq.extend,
+           "ivf_bq": ivf_bq.extend}[fam]
+    return ext(purged, delta_rows, new_indices=delta_ids)
+
+
+def _rebuild(purged, delta_rows, delta_ids, mesh=None,
+             axis: str = "data", stream_chunk: int = 0, params=None):
+    """From-scratch re-train on the live corpus (flat only): the
+    recall yardstick every fold-mode compaction is benchmarked against
+    (``bench_suite.bench_mutate``), and the periodic center refresh."""
+    from raft_tpu.neighbors import ivf_flat
+    old_rows, old_ids = reconstruct_rows(purged)
+    rows = np.concatenate([old_rows, delta_rows], axis=0)
+    ids = np.concatenate([old_ids, delta_ids])
+    if params is None:
+        params = ivf_flat.IndexParams(
+            n_lists=purged.n_lists, metric=purged.metric,
+            kmeans_n_iters=10)
+    if mesh is not None:
+        # PR 4 sharded list-layout build: lands directly in the
+        # list-sharded serving layout, then the ids are rewritten to
+        # the mutable id space (the sharded build numbers rows 0..n)
+        from raft_tpu.parallel.ivf import sharded_ivf_flat_build
+        built = sharded_ivf_flat_build(rows, params=params, mesh=mesh,
+                                       axis=axis)
+        return _renumber(built, ids)
+    if stream_chunk > 0:
+        from raft_tpu.neighbors.host_memory import build_streaming
+
+        def chunks():
+            for s in range(0, rows.shape[0], stream_chunk):
+                yield rows[s:s + stream_chunk]
+
+        built = build_streaming(chunks(), params=params,
+                                train_rows=min(rows.shape[0],
+                                               4 * stream_chunk))
+        built = _as_device_flat(built, purged.metric)
+        return _renumber(built, ids)
+    return _renumber(ivf_flat.build(rows, params), ids)
+
+
+def _renumber(index, row_ids):
+    """Rewrite a freshly built index's 0..n-1 slot ids to the mutable
+    id space (``row_ids[slot]``); pads stay -1."""
+    lists = np.asarray(index.lists_indices)
+    out = np.where(lists >= 0,
+                   np.asarray(row_ids, np.int32)[np.clip(lists, 0,
+                                                         None)],
+                   np.int32(-1))
+    return dataclasses.replace(index, lists_indices=jnp.asarray(out))
+
+
+def _as_device_flat(host_index, metric):
+    """Materialize a host-resident streaming build as a device
+    ivf_flat.Index (the rebuild path serves device-resident)."""
+    from raft_tpu.neighbors import ivf_flat
+    if isinstance(host_index, ivf_flat.Index):
+        return host_index
+    ids = np.asarray(host_index.lists_indices)
+    return ivf_flat.Index(
+        centers=jnp.asarray(host_index.centers),
+        lists_data=jnp.asarray(host_index.lists_data),
+        lists_indices=jnp.asarray(ids),
+        lists_norms=jnp.asarray(host_index.lists_norms),
+        list_sizes=jnp.asarray((ids >= 0).sum(axis=1).astype(np.int32)),
+        metric=metric, size=int(host_index.size),
+        scale=float(getattr(host_index, "scale", 1.0)))

@@ -1,0 +1,100 @@
+"""Background compactor: folds the delta into the main lists while the
+index keeps serving.
+
+One daemon thread polls :meth:`MutableIndex.should_compact` (delta
+slots past ``compact_trigger_frac`` of the top rung) and runs
+:meth:`MutableIndex.compact` when it trips — the fold, the next
+epoch's program prewarm and the atomic swap all happen on THIS thread;
+the serving dispatcher only ever swaps a reference. ``trigger()``
+forces a fold on the next wakeup regardless of fill (operational
+lever: fold before a deploy, a snapshot, a traffic spike).
+
+A failed fold is counted (``raft.mutate.compact.errors``), logged, and
+retried on the next trigger — the serving state is untouched by a
+failed attempt (the swap is the last step)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from raft_tpu.core.logger import get_logger
+
+__all__ = ["Compactor"]
+
+
+class Compactor:
+    """Owns the compaction thread for one
+    :class:`~raft_tpu.mutate.MutableIndex`. Context-manager friendly;
+    ``close()`` joins the thread (an in-flight fold finishes first —
+    it must, the swap is what frees the delta)."""
+
+    # static race contract (tools/graftlint GL003): the trigger flag
+    # and shutdown flag sit on the caller/compactor thread boundary
+    GUARDED_BY = ("_closed", "_force")
+
+    def __init__(self, mindex, mode: Optional[str] = None, mesh=None,
+                 axis: str = "data", poll_ms: Optional[float] = None,
+                 start: bool = True):
+        self._m = mindex
+        self._mode = mode
+        self._mesh = mesh
+        self._axis = axis
+        self._poll_s = (poll_ms if poll_ms is not None
+                        else mindex.cfg.compact_poll_ms) / 1e3
+        self._cond = threading.Condition()
+        self._closed = False
+        self._force = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    def start(self) -> "Compactor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="raft-mutate-compactor")
+            self._thread.start()
+        return self
+
+    def trigger(self) -> None:
+        """Force a fold on the next wakeup (without waiting for the
+        fill trigger)."""
+        with self._cond:
+            self._force = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=120.0)
+            self._thread = None
+
+    def __enter__(self) -> "Compactor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _loop(self) -> None:
+        log = get_logger("mutate")
+        while True:
+            with self._cond:
+                if self._closed:
+                    break
+                self._cond.wait(timeout=self._poll_s)
+                if self._closed:
+                    break
+                force, self._force = self._force, False
+            if not (force or self._m.should_compact()):
+                continue
+            try:
+                self._m.compact(mode=self._mode, mesh=self._mesh,
+                                axis=self._axis)
+            except Exception as e:   # counted in compact(); keep serving
+                log.warning("compaction failed (will retry on next "
+                            "trigger): %r", e)

@@ -1,0 +1,830 @@
+"""MutableIndex: live upsert/delete over a built IVF index.
+
+RAFT's IVF indexes are build-once artifacts; serving millions of users
+means the corpus changes *while* serving (ROADMAP item 3). This module
+makes any built ivf_flat/ivf_pq/ivf_bq index mutable without ever
+paying a steady-state XLA compile:
+
+* **delta segment** — upserts append into a fixed-capacity flat buffer
+  whose capacity walks a pre-warmed shape ladder
+  (``MutateConfig.delta_capacities``, the ``serve/ladder.py`` trick
+  applied to growing state); every query searches it EXACTLY and
+  merges with the main IVF top-k inside one compiled program
+  (:mod:`raft_tpu.mutate.program`).
+* **tombstones** — deletes set a bit in a packed bitmap over the main
+  index's id space, filtered at postprocess inside the same program;
+  an upsert of an existing id is tombstone + append (the delta row
+  shadows the stale main row). Delta rows die in place: their slot id
+  flips to -1.
+* **background compaction** — a compactor
+  (:class:`raft_tpu.mutate.compactor.Compactor`, or a manual
+  :meth:`MutableIndex.compact`) freezes a snapshot, folds it into the
+  main lists (:mod:`raft_tpu.mutate.compact`), pre-warms the NEXT
+  epoch's full program grid off the serving path, and atomically swaps
+  the epoch under the lock. Mutations landing during the fold stay in
+  the delta tail and survive the swap; deletes during the fold are
+  replayed onto the new epoch's bitmap. Old-epoch programs drain;
+  serving threads never observe a half-swapped state and never compile.
+
+Threading model (the GL003 ``GUARDED_BY`` contract below): caller
+threads mutate, the serving dispatcher searches, the compactor folds —
+all state hand-off happens under ``self._cond``; device dispatch and
+XLA compilation always run OUTSIDE the lock against immutable
+snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.mutate import compact as compact_mod
+from raft_tpu.mutate import program as program_mod
+from raft_tpu.mutate.types import DeltaFullError, MutateConfig
+
+__all__ = ["MutableIndex", "build_serve_ladder",
+           "build_dist_serve_ladder"]
+
+
+def _tomb_words(id_base: int) -> int:
+    return max(1, -(-int(id_base) // 32))
+
+
+@dataclass
+class _Epoch:
+    """One immutable generation of the wrapped index plus its compiled
+    program grid. Searches snapshot (epoch, device-state) atomically;
+    a compaction installs a fully pre-warmed replacement."""
+
+    index: object
+    id_base: int                    # ids < id_base live in the main lists
+    number: int
+    tomb_words: int
+    plans: Dict[tuple, object] = field(default_factory=dict)
+    tails: Dict[tuple, object] = field(default_factory=dict)
+    dist: Optional[dict] = None     # sharded view + DistSearchPlans
+
+
+@dataclass
+class _DeviceState:
+    """The delta/tombstone operands currently on device, pinned to the
+    epoch and delta rung they were shaped for."""
+
+    epoch_number: int
+    rung: int
+    delta_data: jax.Array
+    delta_norms: jax.Array
+    delta_ids: jax.Array
+    tomb: jax.Array
+
+
+class MutableIndex:
+    """Live mutable wrapper over a built IVF index: ``upsert`` /
+    ``delete`` / ``search`` under traffic, background compaction, zero
+    steady-state compiles. ``k`` is fixed at construction (the plan
+    contract); serving callers slice smaller k like the batcher does."""
+
+    # static race contract (tools/graftlint GL003): caller threads,
+    # the serving dispatcher and the compactor meet on these fields —
+    # touch them only under `with self._cond` or in `_locked` methods
+    GUARDED_BY = ("_epoch", "_dev", "_delta_data", "_delta_norms",
+                  "_delta_ids", "_delta_used", "_delta_live",
+                  "_delta_map", "_tomb", "_tomb_ids", "_next_id",
+                  "_compacting", "_frozen_id_base", "_pending_tombs",
+                  "_rep", "_rungs", "_grid", "_dist_cfg")
+
+    def __init__(self, index, k: int, params=None,
+                 config: Optional[MutateConfig] = None):
+        from raft_tpu.neighbors import plan as plan_mod
+        family, _ = plan_mod._resolve_builder(index)
+        expects(getattr(index, "raw", None) is None,
+                "mutate: the wrapped %s index carries a host rescore "
+                "corpus (raw) whose id-indexing cannot survive "
+                "deletes — rebuild with keep_raw=False (estimator + "
+                "device tiers still apply)", family)
+        self.family = family
+        self.k = int(k)
+        self.cfg = config if config is not None else MutateConfig()
+        self.params = (params if params is not None
+                       else plan_mod._default_params(family))
+        self._cond = threading.Condition()
+        top = self.cfg.delta_capacities[-1]
+        dim = int(index.dim)
+        with self._cond:
+            self._epoch = _Epoch(index=index, id_base=int(index.size),
+                                 number=0,
+                                 tomb_words=_tomb_words(index.size))
+            self._delta_data = np.zeros((top, dim), np.float32)
+            self._delta_norms = np.zeros((top,), np.float32)
+            self._delta_ids = np.full((top,), -1, np.int32)
+            self._delta_used = 0
+            self._delta_live = 0
+            self._delta_map: Dict[int, int] = {}
+            self._tomb = np.zeros((self._epoch.tomb_words,), np.uint32)
+            self._tomb_ids: set = set()
+            self._next_id = int(index.size)
+            self._compacting = False
+            self._frozen_id_base = 0
+            self._pending_tombs: set = set()
+            self._rep: Optional[np.ndarray] = None
+            self._rungs: Tuple[int, ...] = (
+                min(self.params.n_probes, index.n_lists),)
+            self._grid: set = set()
+            self._dist_cfg: Optional[dict] = None
+            self._dev: Optional[_DeviceState] = None
+            self._push_dev_locked()
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def dim(self) -> int:
+        with self._cond:
+            return int(self._epoch.index.dim)
+
+    @property
+    def metric(self) -> DistanceType:
+        with self._cond:
+            return self._epoch.index.metric
+
+    @property
+    def epoch(self) -> int:
+        with self._cond:
+            return self._epoch.number
+
+    @property
+    def index(self):
+        """The CURRENT epoch's immutable inner index (pending delta
+        rows and tombstones are NOT reflected — search through the
+        MutableIndex for the live view)."""
+        with self._cond:
+            return self._epoch.index
+
+    @property
+    def size(self) -> int:
+        """Live logical row count (main minus tombstones plus live
+        delta rows; deletes of never-existing ids undercount)."""
+        with self._cond:
+            return (int(self._epoch.index.size) - len(self._tomb_ids)
+                    + self._delta_live)
+
+    def stats(self) -> dict:
+        with self._cond:
+            rung = self._rung_for_locked(self._delta_used)
+            cap = self.cfg.delta_capacities[rung]
+            return {
+                "epoch": self._epoch.number,
+                "id_base": self._epoch.id_base,
+                "delta_used": self._delta_used,
+                "delta_live": self._delta_live,
+                "delta_rung": rung,
+                "delta_capacity": cap,
+                "delta_fill_frac": self._delta_used / cap,
+                "tombstones": len(self._tomb_ids),
+                "tombstone_frac": (len(self._tomb_ids)
+                                   / max(1, self._epoch.id_base)),
+                "compacting": self._compacting,
+                "next_id": self._next_id,
+            }
+
+    def should_compact(self) -> bool:
+        """Trigger predicate the background compactor polls: used delta
+        slots past ``compact_trigger_frac`` of the TOP rung (and no
+        fold already running)."""
+        with self._cond:
+            trigger = (self.cfg.compact_trigger_frac
+                       * self.cfg.delta_capacities[-1])
+            return (not self._compacting
+                    and self._delta_used >= trigger)
+
+    # -- mutation ----------------------------------------------------------
+    def upsert(self, vectors, ids=None) -> np.ndarray:
+        """Insert-or-replace rows → the int32 ids they live under.
+        Auto-assigned ids continue the monotone id space; passing an
+        existing id replaces that row (tombstone + append). Raises
+        :class:`DeltaFullError` when the delta segment is at its top
+        rung — compaction is the only way to drain it."""
+        x = np.asarray(vectors, np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        n = x.shape[0]
+        with self._cond:
+            dim = int(self._epoch.index.dim)
+            metric = self._epoch.index.metric
+        expects(x.ndim == 2 and x.shape[1] == dim,
+                "mutate.upsert: vectors must be (n, dim=%d), got %s",
+                dim, x.shape)
+        if metric == DistanceType.CosineExpanded:
+            # build() stores row-normalized vectors for cosine; the
+            # delta segment must match or the ip core scores raw dots
+            x = x / np.maximum(
+                np.linalg.norm(x, axis=1, keepdims=True), 1e-30)
+        top = self.cfg.delta_capacities[-1]
+        with self._cond:
+            if ids is None:
+                expects(self._next_id + n < 2 ** 31,
+                        "mutate.upsert: int32 id space exhausted")
+                ids_arr = np.arange(self._next_id, self._next_id + n,
+                                    dtype=np.int32)
+            else:
+                ids_arr = np.asarray(ids, np.int32).reshape(-1)
+                expects(ids_arr.shape[0] == n and (ids_arr >= 0).all(),
+                        "mutate.upsert: need %d non-negative ids", n)
+            if self._delta_used + n > top:
+                obs.counter("raft.mutate.delta.overflow.total").inc()
+                raise DeltaFullError(
+                    f"delta segment full ({self._delta_used}+{n} > "
+                    f"top rung {top}): waiting on compaction")
+            slots = np.arange(self._delta_used, self._delta_used + n)
+            self._delta_data[slots] = x
+            self._delta_norms[slots] = (x * x).sum(axis=1)
+            self._delta_ids[slots] = ids_arr
+            self._delta_used += n
+            self._delta_live += n
+            for j in range(n):
+                id_ = int(ids_arr[j])
+                old = self._delta_map.pop(id_, None)
+                if old is not None:
+                    self._delta_ids[old] = -1   # shadowed delta row
+                    self._delta_live -= 1
+                self._delta_map[id_] = int(slots[j])
+                self._tombstone_locked(id_)
+                self._next_id = max(self._next_id, id_ + 1)
+            obs.counter("raft.mutate.upserts.total").inc()
+            obs.counter("raft.mutate.upserts.rows").inc(n)
+            self._push_dev_locked()
+            self._cond.notify_all()
+        return ids_arr
+
+    def delete(self, ids) -> int:
+        """Tombstone rows by id → number of ids newly marked dead.
+        Main-index rows are filtered at search postprocess until the
+        next compaction purges them; delta rows die in place."""
+        ids_arr = np.asarray(ids, np.int64).reshape(-1)
+        hit = 0
+        with self._cond:
+            for id_ in ids_arr:
+                id_ = int(id_)
+                dead = False
+                slot = self._delta_map.pop(id_, None)
+                if slot is not None:
+                    self._delta_ids[slot] = -1
+                    self._delta_live -= 1
+                    dead = True
+                if self._tombstone_locked(id_):
+                    dead = True
+                hit += bool(dead)
+            obs.counter("raft.mutate.deletes.total").inc()
+            obs.counter("raft.mutate.deletes.rows").inc(
+                int(ids_arr.shape[0]))
+            self._push_dev_locked()
+        return hit
+
+    def _tombstone_locked(self, id_: int) -> bool:
+        """Mark one id dead in the main-index bitmap (and the pending
+        replay log while a fold is in flight) → True when the bit was
+        newly set."""
+        fresh = False
+        if id_ < self._epoch.id_base and id_ not in self._tomb_ids:
+            self._tomb_ids.add(id_)
+            self._tomb[id_ >> 5] |= np.uint32(1 << (id_ & 31))
+            fresh = True
+        if self._compacting and id_ < self._frozen_id_base:
+            self._pending_tombs.add(id_)
+        return fresh
+
+    # -- device state ------------------------------------------------------
+    def _rung_for_locked(self, used: int) -> int:
+        for r, cap in enumerate(self.cfg.delta_capacities):
+            if used <= cap:
+                return r
+        return len(self.cfg.delta_capacities) - 1
+
+    def _push_dev_locked(self) -> None:
+        """Refresh the device snapshot after a state change: the delta
+        buffer view at the CURRENT rung capacity + the bitmap. Plain
+        host→device transfers — never a compile."""
+        rung = self._rung_for_locked(self._delta_used)
+        cap = self.cfg.delta_capacities[rung]
+        self._dev = _DeviceState(
+            epoch_number=self._epoch.number, rung=rung,
+            delta_data=jnp.asarray(self._delta_data[:cap]),
+            delta_norms=jnp.asarray(self._delta_norms[:cap]),
+            delta_ids=jnp.asarray(self._delta_ids[:cap]),
+            tomb=jnp.asarray(self._tomb))
+        self._set_gauges_locked(rung, cap)
+
+    def _set_gauges_locked(self, rung: int, cap: int) -> None:
+        top = len(self.cfg.delta_capacities) - 1
+        obs.gauge("raft.mutate.epoch").set(self._epoch.number)
+        obs.gauge("raft.mutate.delta.rows").set(self._delta_live)
+        obs.gauge("raft.mutate.delta.capacity").set(cap)
+        obs.gauge("raft.mutate.delta.rung").set(rung)
+        obs.gauge("raft.mutate.delta.fill_frac").set(
+            round(self._delta_used / cap, 4))
+        # a delta at its TOP rung with no fold in flight is a stalled
+        # compactor — /healthz degrades on this gauge (ISSUE 9)
+        obs.gauge("raft.mutate.delta.stalled").set(
+            1.0 if (rung == top and not self._compacting) else 0.0)
+        obs.gauge("raft.mutate.tombstone.rows").set(len(self._tomb_ids))
+        obs.gauge("raft.mutate.tombstone.frac").set(
+            round(len(self._tomb_ids) / max(1, self._epoch.id_base), 6))
+        obs.gauge("raft.mutate.compact.inflight").set(
+            1.0 if self._compacting else 0.0)
+
+    # -- search ------------------------------------------------------------
+    def search(self, queries, k: Optional[int] = None,
+               block: bool = False) -> Tuple[jax.Array, jax.Array]:
+        """Search the LIVE view (main minus tombstones plus delta) →
+        (dists, ids), both (nq, k). Arbitrary nq: a cold shape compiles
+        once (counted under ``raft.plan.cache.misses``) and is cached
+        on the epoch; warmed shapes never compile again."""
+        expects(k is None or int(k) == self.k,
+                "mutate.search: k=%s != plan k=%d (fixed at "
+                "construction; slice smaller k caller-side)", k, self.k)
+        return self._search_rung(queries, 0, block)
+
+    def _search_rung(self, queries, rung_idx: int, block: bool
+                     ) -> Tuple[jax.Array, jax.Array]:
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        nq = q.shape[0]
+        entry, dev = self._entry_for(nq, rung_idx, q)
+        d, i = entry.run(jnp.asarray(q), dev.delta_data,
+                         dev.delta_norms, dev.delta_ids, dev.tomb)
+        if block:
+            jax.block_until_ready((d, i))
+        return d, i
+
+    def _entry_for(self, nq: int, rung_idx: int, rep_q):
+        """Atomically snapshot (compiled entry, device state) for the
+        current epoch at the current delta rung, compiling the entry
+        outside the lock when cold."""
+        while True:
+            with self._cond:
+                epoch = self._epoch
+                dev = self._dev
+                entry = epoch.plans.get((nq, rung_idx, dev.rung))
+            if entry is not None and dev.epoch_number == epoch.number:
+                return entry, dev
+            self._build_entry(epoch, nq, rung_idx, dev.rung, rep_q)
+
+    def _build_entry(self, epoch: _Epoch, nq: int, rung_idx: int,
+                     delta_rung: int, rep_q=None, warm: bool = True):
+        """Compile one (nq, n_probes-rung, delta-rung) program for
+        ``epoch`` — plan-cache-counted, inserted under the lock."""
+        import dataclasses as _dc
+        key = (nq, rung_idx, delta_rung)
+        with self._cond:
+            entry = epoch.plans.get(key)
+            if entry is not None:
+                return entry
+            rep = self._rep if self._rep is not None else rep_q
+            n_probes = self._rungs[min(rung_idx, len(self._rungs) - 1)]
+        expects(rep is not None,
+                "mutate: no representative queries available — call "
+                "warmup() before background prewarm")
+        params = _dc.replace(self.params, n_probes=n_probes)
+        delta_cap = self.cfg.delta_capacities[delta_rung]
+        entry = program_mod.compile_mutate_program(
+            epoch.index, rep, nq, self.k, params, delta_cap,
+            epoch.tomb_words, slack=self.cfg.tombstone_slack)
+        if warm:
+            # run once on dummy operands so device-side warmup is off
+            # the serving path (the build_plan warm contract)
+            reps = -(-nq // np.asarray(rep).shape[0])
+            qw = jnp.asarray(np.tile(np.asarray(rep, np.float32),
+                                     (reps, 1))[:nq])
+            dim = qw.shape[1]
+            jax.block_until_ready(entry.run(
+                qw, jnp.zeros((delta_cap, dim), jnp.float32),
+                jnp.zeros((delta_cap,), jnp.float32),
+                jnp.full((delta_cap,), -1, jnp.int32),
+                jnp.zeros((epoch.tomb_words,), jnp.uint32)))
+        with self._cond:
+            cur = epoch.plans.get(key)
+            if cur is None:
+                epoch.plans[key] = entry
+            else:
+                entry = cur
+        return entry
+
+    # -- warmup / ladder registration --------------------------------------
+    def warmup(self, rep_queries,
+               shapes: Tuple[int, ...] = (1, 8, 32, 128),
+               probes_ladder: Tuple[int, ...] = ()) -> "MutableIndex":
+        """Pre-warm the full (shape × n_probes-rung × delta-rung)
+        program grid so steady-state traffic — including delta growth
+        across rung boundaries and post-compaction epochs — never
+        compiles. The grid is remembered: every future epoch is
+        pre-warmed to the same grid by the compactor BEFORE it swaps
+        in."""
+        rep = np.asarray(rep_queries, np.float32)
+        with self._cond:
+            index = self._epoch.index
+        expects(rep.ndim == 2 and rep.shape[1] == index.dim,
+                "mutate.warmup: rep_queries must be (nq, dim=%d), "
+                "got %s", index.dim, rep.shape)
+        with self._cond:
+            self._rep = rep
+            if probes_ladder:
+                self._rungs = tuple(
+                    min(p, index.n_lists) for p in probes_ladder)
+            self._grid |= {(int(s), r) for s in shapes
+                           for r in range(len(self._rungs))}
+            epoch = self._epoch
+        self._prewarm_epoch(epoch)
+        return self
+
+    def _warm_delta_rungs(self) -> range:
+        n = len(self.cfg.delta_capacities)
+        if self.cfg.prewarm_rungs > 0:
+            n = min(n, self.cfg.prewarm_rungs)
+        return range(n)
+
+    def _prewarm_epoch(self, epoch: _Epoch) -> None:
+        """Compile + warm the registered grid for ``epoch`` (runs on
+        the warmup caller or the compactor — never the serving path)."""
+        with self._cond:
+            grid = sorted(self._grid)
+            dist_cfg = self._dist_cfg
+        for (nq, rung_idx) in grid:
+            for dr in self._warm_delta_rungs():
+                self._build_entry(epoch, nq, rung_idx, dr)
+        if dist_cfg is not None:
+            self._prewarm_dist(epoch, dist_cfg)
+
+    # -- distributed serving (ISSUE 8 composition) -------------------------
+    def register_dist(self, mesh, axis: str, rep_queries,
+                      shapes: Tuple[int, ...],
+                      probes_ladder: Tuple[int, ...] = (),
+                      merge: Optional[str] = None) -> None:
+        """Attach a mesh: every epoch (current and future) additionally
+        pre-warms a list-sharded view served by ``DistSearchPlan``
+        shard_map programs, with the delta merge + tombstone filter
+        composed as a standalone tail program after the cross-shard
+        merge (the delta segment is replicated — it is orders of
+        magnitude smaller than the sharded lists)."""
+        from raft_tpu.serve.merge import merge_mode
+        rep = np.asarray(rep_queries, np.float32)
+        with self._cond:
+            index = self._epoch.index
+            self._rep = rep if self._rep is None else self._rep
+            if probes_ladder:
+                self._rungs = tuple(probes_ladder)
+            cfg = {"mesh": mesh, "axis": axis,
+                   "shapes": tuple(int(s) for s in shapes),
+                   "merge": (merge_mode(default="int8")
+                             if merge is None else merge)}
+            self._dist_cfg = cfg
+            epoch = self._epoch
+        expects(index.n_lists % mesh.shape[axis] == 0,
+                "mutate.register_dist: n_lists=%d not divisible by %d "
+                "shards", index.n_lists, mesh.shape[axis])
+        self._prewarm_dist(epoch, cfg)
+
+    def _prewarm_dist(self, epoch: _Epoch, cfg: dict) -> None:
+        import dataclasses as _dc
+        from raft_tpu.parallel import ivf as pivf
+        from raft_tpu.serve.dist import DistSearchPlan
+        mesh, axis = cfg["mesh"], cfg["axis"]
+        with self._cond:
+            rep = self._rep
+            rungs = self._rungs
+        sharded = pivf.shard_ivf_flat(epoch.index, mesh, axis=axis) \
+            if self.family == "ivf_flat" else \
+            pivf.shard_ivf_pq(epoch.index, mesh, axis=axis)
+        comms = pivf.get_comms(mesh, axis)
+        plans = {}
+        d_dt = i_dt = None
+        # the mesh-wide main phase over-fetches k + slack candidates so
+        # the tail's tombstone filter never costs a result slot
+        k_fetch = self.k + self.cfg.tombstone_slack
+        for ri, n_probes in enumerate(rungs):
+            p_r = _dc.replace(self.params, n_probes=n_probes)
+            for s in cfg["shapes"]:
+                dp = DistSearchPlan(self.family, sharded, mesh, axis, s,
+                                    k_fetch, p_r, cfg["merge"], comms,
+                                    level=ri)
+                reps = -(-s // rep.shape[0])
+                d, i = dp.search(np.tile(rep, (reps, 1))[:s],
+                                 block=True)
+                d_dt, i_dt = d.dtype, i.dtype
+                plans[(s, ri)] = dp
+        epoch.dist = {"index": sharded, "plans": plans,
+                      "d_dtype": d_dt, "i_dtype": i_dt}
+        with self._cond:
+            dim = int(epoch.index.dim)
+        for s in cfg["shapes"]:
+            for dr in self._warm_delta_rungs():
+                self._build_tail(epoch, s, dr, dim)
+
+    def _build_tail(self, epoch: _Epoch, nq: int, delta_rung: int,
+                    dim: int):
+        key = (nq, delta_rung)
+        with self._cond:
+            tail = epoch.tails.get(key)
+        if tail is not None:
+            return tail
+        dist = epoch.dist
+        tail = program_mod.compile_tail_program(
+            nq, self.k, dim, epoch.index.metric,
+            self.cfg.delta_capacities[delta_rung], epoch.tomb_words,
+            k_main=self.k + self.cfg.tombstone_slack,
+            d_dtype=dist["d_dtype"], i_dtype=dist["i_dtype"])
+        with self._cond:
+            cur = epoch.tails.get(key)
+            if cur is None:
+                epoch.tails[key] = tail
+            else:
+                tail = cur
+        return tail
+
+    def _dist_search(self, nq: int, rung_idx: int, queries,
+                     block: bool) -> Tuple[jax.Array, jax.Array]:
+        q = np.asarray(queries, np.float32)
+        with self._cond:
+            epoch = self._epoch
+            dev = self._dev
+            dist_cfg = self._dist_cfg
+        if epoch.dist is None:
+            # mesh registered after this epoch was built (cold path):
+            # shard + warm it now, off the steady-state contract
+            expects(dist_cfg is not None,
+                    "mutate: no mesh registered (register_dist)")
+            self._prewarm_dist(epoch, dist_cfg)
+        dp = epoch.dist["plans"][(nq, rung_idx)]
+        d, i = dp.search(q, block=False)
+        # the cross-shard merge returns mesh-replicated (nq, k) arrays;
+        # the tail executable is a single-device program — re-place the
+        # tiny merged block (k*8 bytes/row, an async local copy)
+        dev0 = jax.devices()[0]
+        d, i = jax.device_put(d, dev0), jax.device_put(i, dev0)
+        tail = epoch.tails.get((nq, dev.rung))
+        if tail is None:
+            tail = self._build_tail(epoch, nq, dev.rung, q.shape[1])
+        d, i = tail.run(jnp.asarray(q), d, i, dev.delta_data,
+                        dev.delta_norms, dev.delta_ids, dev.tomb)
+        if block:
+            jax.block_until_ready((d, i))
+        return d, i
+
+    def _dist_plan(self, nq: int, rung_idx: int):
+        """The current epoch's underlying DistSearchPlan at a grid
+        point (gauge/introspection surface for the serving tier)."""
+        with self._cond:
+            dist = self._epoch.dist
+        expects(dist is not None,
+                "mutate: no mesh registered (register_dist)")
+        return dist["plans"][(nq, rung_idx)]
+
+    # -- compaction --------------------------------------------------------
+    def compact(self, mode: Optional[str] = None, mesh=None,
+                axis: str = "data") -> bool:
+        """Fold the delta + tombstones into the main lists and swap the
+        epoch — under live traffic, zero serving downtime, zero
+        serving-path compiles (the next epoch's grid is pre-warmed
+        HERE, on the calling/compactor thread, before the swap).
+        Returns False when a fold is already in flight."""
+        from raft_tpu.obs import spans
+        with self._cond:
+            if self._compacting:
+                return False
+            self._compacting = True
+            self._frozen_id_base = self._next_id
+            self._pending_tombs = set()
+            used = self._delta_used
+            live = self._delta_ids[:used] >= 0
+            snap_rows = self._delta_data[:used][live].copy()
+            snap_ids = self._delta_ids[:used][live].copy()
+            snap_tombs = frozenset(self._tomb_ids)
+            freeze_used = used
+            old_epoch = self._epoch
+            new_id_base = self._frozen_id_base
+            self._set_gauges_locked(
+                self._rung_for_locked(used),
+                self.cfg.delta_capacities[self._rung_for_locked(used)])
+        mode = mode if mode is not None else self.cfg.compact_mode
+        try:
+            with spans.span("raft.mutate.compact",
+                            epoch=old_epoch.number, mode=mode,
+                            rows=int(snap_rows.shape[0]),
+                            tombstones=len(snap_tombs)) as sp, \
+                    obs.timed("raft.mutate.compact"):
+                new_index = compact_mod.fold(
+                    old_epoch.index, snap_rows, snap_ids, snap_tombs,
+                    mode=mode, mesh=mesh, axis=axis,
+                    stream_chunk=self.cfg.rebuild_stream_chunk)
+                new_epoch = _Epoch(index=new_index,
+                                   id_base=new_id_base,
+                                   number=old_epoch.number + 1,
+                                   tomb_words=_tomb_words(new_id_base))
+                # pre-warm the whole registered grid for the NEW epoch
+                # before anyone can route to it — the serving threads
+                # keep draining old-epoch programs meanwhile
+                self._prewarm_epoch(new_epoch)
+                sp.set_attr("new_size", int(new_index.size))
+            self._swap_epoch(new_epoch, freeze_used, new_id_base)
+            obs.counter("raft.mutate.compact.total").inc()
+            return True
+        except BaseException:
+            obs.counter("raft.mutate.compact.errors").inc()
+            with self._cond:
+                self._compacting = False
+                self._push_dev_locked()
+            raise
+
+    def _swap_epoch(self, new_epoch: _Epoch, freeze_used: int,
+                    new_id_base: int) -> None:
+        with self._cond:
+            # rebase the delta: rows appended after the freeze slide to
+            # the front; everything folded leaves the segment
+            tail_n = self._delta_used - freeze_used
+            if tail_n:
+                self._delta_data[:tail_n] = \
+                    self._delta_data[freeze_used:self._delta_used].copy()
+                self._delta_norms[:tail_n] = \
+                    self._delta_norms[freeze_used:self._delta_used].copy()
+                self._delta_ids[:tail_n] = \
+                    self._delta_ids[freeze_used:self._delta_used].copy()
+            self._delta_ids[tail_n:self._delta_used] = -1
+            self._delta_used = tail_n
+            self._delta_map = {
+                int(i): s for s, i in
+                enumerate(self._delta_ids[:tail_n]) if i >= 0}
+            self._delta_live = len(self._delta_map)
+            # deletes that raced the fold replay onto the new bitmap
+            self._tomb_ids = {i for i in self._pending_tombs
+                              if i < new_id_base}
+            self._pending_tombs = set()
+            self._tomb = np.zeros((new_epoch.tomb_words,), np.uint32)
+            for id_ in self._tomb_ids:
+                self._tomb[id_ >> 5] |= np.uint32(1 << (id_ & 31))
+            self._epoch = new_epoch
+            self._compacting = False
+            self._push_dev_locked()
+            self._cond.notify_all()
+
+    # -- persistence (neighbors/serialize.py) ------------------------------
+    def export_state(self) -> dict:
+        """Consistent snapshot for :func:`serialize.save_mutable`."""
+        with self._cond:
+            used = self._delta_used
+            return {
+                "index": self._epoch.index,
+                "epoch": self._epoch.number,
+                "id_base": self._epoch.id_base,
+                "next_id": self._next_id,
+                "k": self.k,
+                "delta_data": self._delta_data[:used].copy(),
+                "delta_ids": self._delta_ids[:used].copy(),
+                "tomb_ids": np.asarray(sorted(self._tomb_ids),
+                                       np.int64),
+            }
+
+    @classmethod
+    def restore(cls, index, state: dict, params=None,
+                config: Optional[MutateConfig] = None
+                ) -> "MutableIndex":
+        """Rebuild a MutableIndex from :meth:`export_state` payload —
+        pending delta rows and tombstones survive the round trip."""
+        m = cls(index, k=int(state["k"]), params=params, config=config)
+        rows = np.asarray(state["delta_data"], np.float32)
+        ids = np.asarray(state["delta_ids"], np.int32)
+        tombs = np.asarray(state["tomb_ids"], np.int64)
+        with m._cond:
+            id_base = int(state["id_base"])
+            m._epoch = _Epoch(index=index, id_base=id_base,
+                              number=int(state["epoch"]),
+                              tomb_words=_tomb_words(id_base))
+            n = rows.shape[0]
+            expects(n <= m.cfg.delta_capacities[-1],
+                    "mutate.restore: %d saved delta rows exceed the "
+                    "configured top rung %d", n,
+                    m.cfg.delta_capacities[-1])
+            m._delta_data[:n] = rows
+            m._delta_norms[:n] = (rows * rows).sum(axis=1)
+            m._delta_ids[:n] = ids
+            m._delta_used = n
+            m._delta_map = {int(i): s for s, i in enumerate(ids)
+                            if i >= 0}
+            m._delta_live = len(m._delta_map)
+            m._tomb_ids = {int(i) for i in tombs}
+            m._tomb = np.zeros((m._epoch.tomb_words,), np.uint32)
+            for id_ in m._tomb_ids:
+                m._tomb[id_ >> 5] |= np.uint32(1 << (id_ & 31))
+            m._next_id = int(state["next_id"])
+            m._push_dev_locked()
+        return m
+
+
+# ---------------------------------------------------------------------------
+# serving-tier glue: PlanLadder handles over a MutableIndex
+# ---------------------------------------------------------------------------
+
+
+class _MutableServePlan:
+    """Plan-like handle (the :class:`PlanLadder` contract: ``search``,
+    ``nq``, ``n_probes``) pinned to one (shape, rung) point; resolution
+    to the current epoch/delta-rung executable happens per call, so the
+    ladder object survives every compaction."""
+
+    def __init__(self, mindex: MutableIndex, nq: int, rung: int,
+                 n_probes: int):
+        self._m = mindex
+        self.nq = int(nq)
+        self.rung = int(rung)
+        self.n_probes = int(n_probes)
+
+    def search(self, queries, block: bool = False):
+        return self._m._search_rung(queries, self.rung, block)
+
+
+class _MutableDistPlan:
+    """The distributed counterpart: one cached shard_map dispatch (the
+    current epoch's :class:`DistSearchPlan`) followed by the compiled
+    delta/tombstone tail."""
+
+    dist_like = True     # accepted by DistributedSearchServer
+
+    def __init__(self, mindex: MutableIndex, nq: int, rung: int,
+                 n_probes: int):
+        self._m = mindex
+        self.nq = int(nq)
+        self.rung = int(rung)
+        self.n_probes = int(n_probes)
+
+    @property
+    def mesh(self):
+        return self._m._dist_plan(self.nq, self.rung).mesh
+
+    @property
+    def n_shards(self) -> int:
+        return self._m._dist_plan(self.nq, self.rung).n_shards
+
+    @property
+    def merge_ratio(self) -> float:
+        return self._m._dist_plan(self.nq, self.rung).merge_ratio
+
+    def search(self, queries, block: bool = False):
+        return self._m._dist_search(self.nq, self.rung, queries, block)
+
+
+def build_serve_ladder(mindex: MutableIndex, rep_queries,
+                       shapes: Tuple[int, ...] = (1, 8, 32, 128),
+                       probes_ladder: Tuple[int, ...] = (),
+                       prewarm: bool = True):
+    """The mutable analogue of :meth:`PlanLadder.build`: pre-warm the
+    (shape × rung × delta-rung) grid on the CURRENT epoch, register it
+    so compactions pre-warm every future epoch, and return a
+    :class:`PlanLadder` of stable handles the micro-batcher serves
+    from across epoch swaps."""
+    from raft_tpu.serve.ladder import PlanLadder
+    if prewarm:
+        mindex.warmup(rep_queries, shapes=shapes,
+                      probes_ladder=probes_ladder)
+    else:
+        with mindex._cond:
+            mindex._rep = np.asarray(rep_queries, np.float32)
+            if probes_ladder:
+                mindex._rungs = tuple(probes_ladder)
+            mindex._grid |= {(int(s), r) for s in shapes
+                             for r in range(len(mindex._rungs))}
+    with mindex._cond:
+        rungs = mindex._rungs
+    plans = {(s, r): _MutableServePlan(mindex, s, r, rungs[r])
+             for s in shapes for r in range(len(rungs))}
+    return PlanLadder(shapes=tuple(shapes), rungs=rungs, plans=plans,
+                      dim=mindex.dim, k=mindex.k)
+
+
+def build_dist_serve_ladder(mindex: MutableIndex, rep_queries,
+                            mesh=None, axis: str = "data",
+                            shapes: Tuple[int, ...] = (1, 8, 32, 128),
+                            probes_ladder: Tuple[int, ...] = (),
+                            merge: Optional[str] = None):
+    """Mesh-wide mutable serving: list-shard the current epoch, build
+    the :class:`DistSearchPlan` grid + tail programs, register the mesh
+    so every compaction re-shards and pre-warms the next epoch before
+    swapping. Returns a :class:`PlanLadder` of stable dist handles."""
+    from raft_tpu.serve.ladder import PlanLadder
+    expects(mesh is not None, "build_dist_serve_ladder: mesh required")
+    mindex.register_dist(mesh, axis, rep_queries, shapes=shapes,
+                         probes_ladder=probes_ladder, merge=merge)
+    with mindex._cond:
+        rungs = mindex._rungs
+    plans = {}
+    for s in shapes:
+        for r in range(len(rungs)):
+            dp = mindex._dist_plan(s, r)
+            plans[(s, r)] = _MutableDistPlan(mindex, s, r, dp.n_probes)
+    return PlanLadder(shapes=tuple(shapes), rungs=rungs, plans=plans,
+                      dim=mindex.dim, k=mindex.k)

@@ -1,0 +1,86 @@
+"""Mutable-index types: config + typed errors.
+
+Kept dependency-light (stdlib only — no jax import) so the error types
+can be raised through the serving stack and caught by HTTP routes
+without pulling the device runtime into the import graph (the
+``serve/types.py`` convention).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+__all__ = ["DeltaFullError", "MutateConfig"]
+
+
+class DeltaFullError(RuntimeError):
+    """The delta segment is at its top ladder rung and cannot absorb
+    more rows until a compaction folds it into the main lists —
+    explicit admission control for writes, the mutation-side analogue
+    of :class:`raft_tpu.serve.RejectedError`. Nothing was applied."""
+
+
+@dataclass(frozen=True)
+class MutateConfig:
+    """Operating contract of a :class:`~raft_tpu.mutate.MutableIndex`.
+
+    * ``delta_capacities`` — the delta-segment shape ladder (ascending
+      row capacities). The live delta buffer always executes at one of
+      these compiled widths (the ``serve/ladder.py`` fixed-shape trick
+      applied to *growing* state — Ragged Paged Attention, arxiv
+      2604.15464, pages growing KV state the same way): crossing a rung
+      boundary swaps to the next pre-warmed program instead of
+      triggering an XLA recompile. Appends past the top rung fail NOW
+      with :class:`DeltaFullError`.
+    * ``compact_trigger_frac`` — the background compactor starts a fold
+      when used delta slots reach this fraction of the TOP rung
+      capacity (headroom so mutations keep landing while the fold
+      runs; a compaction must finish before the remaining
+      ``1 - frac`` of the ladder fills).
+    * ``compact_mode`` — ``"fold"`` keeps the trained coarse centers
+      frozen and folds the delta into the main lists via the family's
+      ``extend`` path (fast, the steady-state mode); ``"rebuild"``
+      re-trains from the reconstructed corpus via the family ``build``
+      (or the PR 4 sharded/streaming build machinery when a mesh /
+      chunk budget is passed) — the periodic center-refresh mode.
+    * ``compact_poll_ms`` — the compactor thread's trigger-check
+      interval while idle.
+    * ``tombstone_slack`` — extra candidates the MAIN phase fetches
+      (the program compiles at ``k + tombstone_slack`` and the merge
+      cuts back to ``k``). The tombstone filter runs AFTER the main
+      top-k, so each dead id in a query's main candidates costs one
+      slot — slack absorbs up to this many per query; past it, recall
+      dips until compaction purges (the ``raft.mutate.tombstone.frac``
+      gauge is the watch signal; docs/mutability.md "Capacity
+      planning").
+    """
+
+    delta_capacities: Tuple[int, ...] = (1024, 4096, 16384)
+    tombstone_slack: int = 16
+    compact_trigger_frac: float = 0.5
+    compact_mode: str = "fold"
+    compact_poll_ms: float = 50.0
+    # rebuild-mode knobs: host-streaming chunk rows (0 = plain build)
+    rebuild_stream_chunk: int = 0
+    # optional cap on pre-warmed delta rungs counted from the bottom
+    # (0 = warm every rung); a library user who never expects the top
+    # rung can trim startup compiles
+    prewarm_rungs: int = 0
+
+    def __post_init__(self):
+        caps = tuple(int(c) for c in self.delta_capacities)
+        if not caps or list(caps) != sorted(set(caps)) or min(caps) < 8:
+            raise ValueError(
+                "MutateConfig.delta_capacities must be distinct "
+                "ascending ints >= 8")
+        object.__setattr__(self, "delta_capacities", caps)
+        if not 0.0 < self.compact_trigger_frac <= 1.0:
+            raise ValueError(
+                "MutateConfig.compact_trigger_frac must be in (0, 1]")
+        if self.compact_mode not in ("fold", "rebuild"):
+            raise ValueError(
+                "MutateConfig.compact_mode must be 'fold' or 'rebuild'")
+        if self.tombstone_slack < 0:
+            raise ValueError(
+                "MutateConfig.tombstone_slack must be >= 0")

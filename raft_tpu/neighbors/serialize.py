@@ -215,12 +215,62 @@ def load_ball_cover(path: str):
         size=meta["size"])
 
 
+def save_mutable(mindex, path: str) -> None:
+    """Write a :class:`raft_tpu.mutate.MutableIndex` — the inner index
+    (via its family writer, embedded as bytes) PLUS the mutable state
+    (pending delta rows, tombstone ids, epoch/id-space counters), so a
+    mutated index reloads without losing a single pending mutation.
+    The snapshot is consistent (taken under the index lock)."""
+    import tempfile
+    st = mindex.export_state()
+    fd, tmp = tempfile.mkstemp(
+        suffix=".npz", dir=os.path.dirname(os.path.abspath(path)) or ".")
+    os.close(fd)
+    try:
+        save(st["index"], tmp)
+        inner = np.fromfile(tmp, dtype=np.uint8)
+    finally:
+        os.remove(tmp)
+    _pack(path, "mutable",
+          {"k": int(st["k"]), "epoch": int(st["epoch"]),
+           "id_base": int(st["id_base"]), "next_id": int(st["next_id"])},
+          {"inner": inner, "delta_data": st["delta_data"],
+           "delta_ids": st["delta_ids"], "tomb_ids": st["tomb_ids"]})
+
+
+def load_mutable(path: str, params=None, config=None):
+    """Read a mutable index written by :func:`save_mutable` →
+    :class:`raft_tpu.mutate.MutableIndex` with the delta segment,
+    tombstones and epoch counters restored (programs re-warm via
+    ``warmup()`` / the serving ladder, exactly like a fresh wrap)."""
+    import tempfile
+    from raft_tpu.mutate import MutableIndex
+    meta, a = _unpack(path, "mutable")
+    fd, tmp = tempfile.mkstemp(
+        suffix=".npz", dir=os.path.dirname(os.path.abspath(path)) or ".")
+    os.close(fd)
+    try:
+        a["inner"].tofile(tmp)
+        inner = load(tmp)
+    finally:
+        os.remove(tmp)
+    state = {"k": meta["k"], "epoch": meta["epoch"],
+             "id_base": meta["id_base"], "next_id": meta["next_id"],
+             "delta_data": a["delta_data"], "delta_ids": a["delta_ids"],
+             "tomb_ids": a["tomb_ids"]}
+    return MutableIndex.restore(inner, state, params=params,
+                                config=config)
+
+
 def save(index, path: str) -> None:
     """Type-dispatching save for any supported ANN index."""
     from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_bq
     from raft_tpu.neighbors.ball_cover import BallCoverIndex
     from raft_tpu.neighbors.host_memory import HostIvfFlat
-    if isinstance(index, ivf_flat.Index):
+    from raft_tpu.mutate import MutableIndex
+    if isinstance(index, MutableIndex):
+        save_mutable(index, path)
+    elif isinstance(index, ivf_flat.Index):
         save_ivf_flat(index, path)
     elif isinstance(index, ivf_pq.Index):
         save_ivf_pq(index, path)
@@ -250,4 +300,6 @@ def load(path: str):
         return load_host_ivf_flat(path)
     if fmt == "ball_cover":
         return load_ball_cover(path)
+    if fmt == "mutable":
+        return load_mutable(path)
     raise ValueError(f"serialize.load: unknown format {fmt!r} in {path}")

@@ -78,12 +78,29 @@ def _health_body(snapshot: dict) -> dict:
     shed_rate = _gsum("raft.serve.shed.rate")
     serve_degraded = (overloaded > 0 or shed_rate > 0
                       or (qmax > 0 and depth >= qmax))
+    # mutable-index plane (ISSUE 9): a delta segment sitting at its TOP
+    # ladder rung with no compaction in flight is a stalled compactor —
+    # the next rung boundary is a hard DeltaFullError wall, so this box
+    # must stop reporting healthy BEFORE writes start bouncing
+    mutate_stalled = _gsum("raft.mutate.delta.stalled")
+    mutate_degraded = mutate_stalled > 0
     body = {
-        "status": ("degraded" if (comms_degraded or serve_degraded)
+        "status": ("degraded" if (comms_degraded or serve_degraded
+                                  or mutate_degraded)
                    else "ok"),
         "suspects": suspects,
         "max_staleness_seconds": staleness,
     }
+    if any(k.split("{")[0].startswith("raft.mutate.") for k in gauges):
+        body["mutate"] = {
+            "epoch": _gsum("raft.mutate.epoch"),
+            "delta_fill_frac": _gsum("raft.mutate.delta.fill_frac"),
+            "delta_rung": _gsum("raft.mutate.delta.rung"),
+            "delta_rows": _gsum("raft.mutate.delta.rows"),
+            "tombstone_frac": _gsum("raft.mutate.tombstone.frac"),
+            "compact_inflight": _gsum("raft.mutate.compact.inflight"),
+            "delta_stalled": mutate_stalled,
+        }
     if any(k.startswith("raft.serve.") for k in gauges):
         body["serve"] = {
             "overloaded": overloaded,

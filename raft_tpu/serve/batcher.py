@@ -101,12 +101,29 @@ class SearchServer:
         """Build + pre-warm the (shape × rung) plan ladder for
         ``index`` and start serving. ``rep_queries`` is the
         representative cap-measurement sample (same contract as
-        ``plan.build_plan``)."""
+        ``plan.build_plan``). A :class:`raft_tpu.mutate.MutableIndex`
+        is accepted too: its (shape × rung × delta-rung) grid is
+        pre-warmed instead and the server keeps serving through every
+        background compaction (the ladder handles re-resolve to the
+        live epoch per call)."""
         config = config if config is not None else ServeConfig()
-        ladder = PlanLadder.build(index, rep_queries, k, params,
-                                  shapes=config.batch_sizes,
-                                  probes_ladder=config.probes_ladder,
-                                  prewarm=config.prewarm)
+        from raft_tpu.mutate import MutableIndex, build_serve_ladder
+        if isinstance(index, MutableIndex):
+            expects(k == index.k,
+                    "serve.from_index: k=%d != MutableIndex k=%d "
+                    "(fixed at its construction)", k, index.k)
+            expects(params is None,
+                    "serve.from_index: a MutableIndex carries its own "
+                    "search params (set them at its construction)")
+            ladder = build_serve_ladder(
+                index, rep_queries, shapes=config.batch_sizes,
+                probes_ladder=config.probes_ladder,
+                prewarm=config.prewarm)
+        else:
+            ladder = PlanLadder.build(index, rep_queries, k, params,
+                                      shapes=config.batch_sizes,
+                                      probes_ladder=config.probes_ladder,
+                                      prewarm=config.prewarm)
         return cls(ladder, config, start=start)
 
     # -- lifecycle ---------------------------------------------------------

@@ -202,9 +202,11 @@ class DistributedSearchServer(SearchServer):
                  config: Optional[ServeConfig] = None,
                  start: bool = True):
         p0 = ladder.plan_for(ladder.shapes[0], 0)[1]
-        expects(isinstance(p0, DistSearchPlan),
+        expects(isinstance(p0, DistSearchPlan)
+                or getattr(p0, "dist_like", False),
                 "DistributedSearchServer: ladder must hold "
-                "DistSearchPlans (build via build_dist_ladder)")
+                "DistSearchPlans (build via build_dist_ladder /"
+                " mutate.build_dist_serve_ladder)")
         # the ratio gauge reports the SATURATED operating point (the
         # largest ladder shape) — tiny shapes ride the profitability
         # fallback and would misstate the compression
@@ -234,4 +236,27 @@ class DistributedSearchServer(SearchServer):
             shapes=config.batch_sizes,
             probes_ladder=config.probes_ladder,
             prewarm=config.prewarm, merge=merge)
+        return cls(ladder, config, start=start)
+
+    @classmethod
+    def from_mutable(cls, mindex, rep_queries, mesh=None,
+                     axis: str = "data",
+                     config: Optional[ServeConfig] = None,
+                     merge: Optional[str] = None,
+                     start: bool = True) -> "DistributedSearchServer":
+        """Serve a :class:`raft_tpu.mutate.MutableIndex` mesh-wide:
+        each epoch's inner index is list-sharded and served through the
+        cached shard_map grid, with the delta merge + tombstone filter
+        composed as a compiled tail after the cross-shard merge (the
+        delta segment replicates — it is orders of magnitude smaller
+        than the sharded lists). Background compactions re-shard and
+        pre-warm the next epoch off the serving path, then swap — the
+        server never stops and never compiles in steady state
+        (docs/mutability.md)."""
+        from raft_tpu.mutate import build_dist_serve_ladder
+        config = config if config is not None else ServeConfig()
+        ladder = build_dist_serve_ladder(
+            mindex, rep_queries, mesh=mesh, axis=axis,
+            shapes=config.batch_sizes,
+            probes_ladder=config.probes_ladder, merge=merge)
         return cls(ladder, config, start=start)

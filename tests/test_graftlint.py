@@ -465,6 +465,32 @@ class TestBaselineContract:
                 os.path.join(REPO, "raft_tpu", "serve", "merge.py")])
         assert findings == []
 
+    def test_mutate_carries_zero_baseline_and_zero_gl003(self):
+        """ISSUE 9 acceptance: the new mutable-index subsystem
+        (raft_tpu/mutate/) ships with an EMPTY baseline — no
+        grandfathered findings — and a fresh GL003 lint of the tree
+        finds nothing live: the dispatcher/compactor boundary's
+        GUARDED_BY discipline holds statically."""
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        assert not [k for k in allow
+                    if k[1].startswith("raft_tpu/mutate/")]
+        findings, _ = engine.run(
+            REPO, files=[os.path.join(REPO, "raft_tpu", "mutate")],
+            select=["GL003"])
+        assert findings == []
+        # the whole tree (all rules) is clean too, modulo justified
+        # suppressions
+        findings, _ = engine.run(
+            REPO, files=[os.path.join(REPO, "raft_tpu", "mutate")])
+        assert findings == []
+
+    def test_gl003_scope_covers_mutate(self):
+        """The GL003 path scope gained mutate/: a seeded unlocked
+        GUARDED_BY write there is a live finding."""
+        from tools.graftlint.rules.locks import LockDiscipline
+        assert "raft_tpu/mutate" in LockDiscipline.paths
+
     def test_no_grandfathered_findings_in_parallel(self):
         """ISSUE 7 satellite: the per-build shard_map sites in
         parallel/ now ride the keyed _shmap_plan cache — their GL002

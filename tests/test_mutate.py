@@ -1,0 +1,498 @@
+"""Live mutable index tests (ISSUE 9).
+
+The contracts:
+  * **semantics** — upserts are visible to the next search, deletes
+    never come back (tombstone filter through the compiled program),
+    re-upserting an id replaces its row, overflowing the top delta
+    rung is an explicit :class:`DeltaFullError`;
+  * **zero steady-state compiles** — with the grid pre-warmed, mixed
+    search+mutation traffic (including delta growth ACROSS a rung
+    boundary) never touches the plan-cache miss counters;
+  * **compaction** — after >= 10k interleaved upserts/deletes and one
+    fold, recall matches a from-scratch rebuild within 0.01; searches
+    keep succeeding (zero failures) while a background compaction
+    runs; mutations landing DURING the fold survive the epoch swap;
+  * **persistence** — save -> load -> search parity including pending
+    delta rows and tombstones;
+  * **observability** — /healthz grows a ``mutate`` section and
+    degrades when the delta sits at its top rung with no compaction
+    running.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import mutate, obs, serve
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_flat, ivf_pq, serialize
+
+
+def _brute_ids(db, ids, q, k, metric="l2"):
+    """Exact reference over an id-labelled corpus."""
+    if metric == "ip":
+        s = -(q @ db.T)
+    else:
+        s = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    sel = np.argsort(s, axis=1, kind="stable")[:, :k]
+    return np.asarray(ids)[sel]
+
+
+def _misses(diff):
+    cnt = diff.get("counters", {})
+    return (cnt.get("raft.plan.cache.misses", 0.0)
+            + cnt.get("raft.plan.build.total", 0.0))
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((2000, 16)).astype(np.float32)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def flat_index(dataset):
+    x, _ = dataset
+    return ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16,
+                                                  kmeans_n_iters=4))
+
+
+def _mutable(index, k=5, caps=(64, 256), n_probes=16):
+    return mutate.MutableIndex(
+        index, k=k, params=ivf_flat.SearchParams(n_probes=n_probes),
+        config=mutate.MutateConfig(delta_capacities=caps))
+
+
+class TestSemantics:
+    def test_wrap_matches_exact(self, dataset, flat_index):
+        x, q = dataset
+        m = _mutable(flat_index)
+        _, i = m.search(q, block=True)
+        ref = _brute_ids(x, np.arange(len(x)), q, 5)
+        assert (np.asarray(i) == ref).all()
+
+    def test_upsert_visible_delete_gone(self, dataset, flat_index):
+        x, q = dataset
+        rng = np.random.default_rng(1)
+        m = _mutable(flat_index)
+        new = q[:4] + 0.001 * rng.standard_normal((4, 16)).astype(
+            np.float32)
+        ids = m.upsert(new)
+        assert list(ids) == [2000, 2001, 2002, 2003]
+        _, i = m.search(q, block=True)
+        for r in range(4):   # each upserted row is its query's nearest
+            assert int(ids[r]) == int(np.asarray(i)[r][0])
+        # delete one delta row and one main row
+        ref = _brute_ids(x, np.arange(len(x)), q, 5)
+        victim_main = int(ref[5][0])
+        assert m.delete([int(ids[0]), victim_main]) == 2
+        _, i = m.search(q, block=True)
+        got = np.asarray(i)
+        assert int(ids[0]) not in got[0]
+        assert victim_main not in got[5]
+        # model parity over the live corpus
+        live = np.ones(len(x), bool)
+        live[victim_main] = False
+        db = np.concatenate([x[live], new[1:]], 0)
+        lid = np.concatenate([np.arange(len(x))[live], ids[1:]])
+        assert (got == _brute_ids(db, lid, q, 5)).all()
+
+    def test_reupsert_replaces(self, dataset, flat_index):
+        _, q = dataset
+        m = _mutable(flat_index)
+        ids = m.upsert(q[0:1] + 100.0)      # far away: never returned
+        m.upsert(q[0:1], ids=[int(ids[0])])  # replace AT the query
+        _, i = m.search(q, block=True)
+        assert int(np.asarray(i)[0][0]) == int(ids[0])
+        assert m.stats()["delta_live"] == 1
+
+    def test_overflow_is_explicit(self, dataset, flat_index):
+        _, q = dataset
+        m = _mutable(flat_index, caps=(8, 16))
+        rng = np.random.default_rng(2)
+        m.upsert(rng.standard_normal((16, 16)).astype(np.float32))
+        with pytest.raises(mutate.DeltaFullError):
+            m.upsert(q[:1])
+        # nothing was applied by the failed call
+        assert m.stats()["delta_used"] == 16
+
+    def test_ip_metric_merge_direction(self, dataset):
+        x, q = dataset
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(
+            n_lists=16, kmeans_n_iters=4,
+            metric=DistanceType.InnerProduct))
+        m = mutate.MutableIndex(
+            idx, k=5, params=ivf_flat.SearchParams(n_probes=16),
+            config=mutate.MutateConfig(delta_capacities=(64,)))
+        ids = m.upsert(q[0:1] * 10.0)       # dominant inner product
+        d, i = m.search(q, block=True)
+        got = np.asarray(i)
+        assert int(got[0][0]) == int(ids[0])
+        ref = _brute_ids(np.concatenate([x, q[0:1] * 10.0]),
+                         np.arange(2001), q, 5, metric="ip")
+        assert (got == ref).all()
+        # descending output convention preserved through the merge
+        dd = np.asarray(d)
+        assert (np.diff(dd, axis=1) <= 1e-5).all()
+
+    def test_raw_index_rejected(self, dataset):
+        x, _ = dataset
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=8, pq_dim=4, kmeans_n_iters=2, keep_raw=True))
+        if idx.raw is None:
+            pytest.skip("build dropped raw")
+        with pytest.raises(Exception):
+            mutate.MutableIndex(idx, k=5)
+
+
+class TestZeroCompileLadder:
+    def test_rung_growth_without_compiles(self, dataset, flat_index):
+        x, q = dataset
+        m = _mutable(flat_index, caps=(32, 128))
+        m.warmup(q, shapes=(16,))
+        rng = np.random.default_rng(3)
+        before = obs.snapshot()
+        assert m.stats()["delta_rung"] == 0
+        # grow straight through the rung boundary under search traffic
+        for step in range(4):
+            m.upsert(rng.standard_normal((25, 16)).astype(np.float32))
+            m.search(q, block=True)
+        assert m.stats()["delta_rung"] == 1
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        assert _misses(diff) == 0
+        # still exact vs the model
+        _, i = m.search(q, block=True)
+        assert m.stats()["delta_live"] == 100
+        db = np.concatenate([x, m._delta_data[:100]], 0)
+        lid = np.arange(len(x) + 100)
+        assert (np.asarray(i) == _brute_ids(db, lid, q, 5)).all()
+
+
+class TestCompaction:
+    def test_recall_parity_after_10k_mutations(self):
+        """Acceptance: N >= 10k interleaved upserts/deletes, one fold,
+        recall within 0.01 of a from-scratch rebuild at a
+        non-exhaustive probe point. Clustered corpus (the bench
+        distribution): upserts drawn from the SAME mixture, the
+        serving reality fold-mode compaction targets — on uniform
+        random data at >100% turnover the frozen-centers gap is a
+        property of ``extend`` itself (measured ~0.03 on the plain
+        extend path too; ``compact_mode='rebuild'`` is the re-train
+        lever, docs/mutability.md)."""
+        rng = np.random.default_rng(10)
+        n, d, k = 6000, 24, 10
+        nc = 48
+        cents = rng.standard_normal((nc, d)).astype(np.float32)
+
+        def draw(m):
+            lab = rng.integers(0, nc, m)
+            return (cents[lab] + rng.standard_normal((m, d))
+                    ).astype(np.float32)
+
+        x, reserve, q = draw(n), draw(7800), draw(48)
+        params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=4)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        m = mutate.MutableIndex(
+            ivf_flat.build(x, params), k=k, params=sp,
+            config=mutate.MutateConfig(delta_capacities=(2048, 8192)))
+        n_up, n_del = 7800, 2600           # 10400 interleaved mutations
+        del_ids = rng.choice(n, size=n_del, replace=False)
+        up_off = del_off = 0
+        while up_off < n_up or del_off < n_del:
+            take = min(300, n_up - up_off)
+            if take:
+                m.upsert(reserve[up_off:up_off + take])
+                up_off += take
+            dtake = min(100, n_del - del_off)
+            if dtake:
+                m.delete(del_ids[del_off:del_off + dtake])
+                del_off += dtake
+        assert m.compact()
+        assert m.stats()["delta_used"] == 0
+        assert m.stats()["tombstones"] == 0
+        assert m.epoch == 1
+        keep = np.ones(n, bool)
+        keep[del_ids] = False
+        live_db = np.concatenate([x[keep], reserve], 0)
+        live_ids = np.concatenate(
+            [np.arange(n)[keep], np.arange(n, n + n_up)])
+        exact = _brute_ids(live_db, live_ids, q, k)
+
+        def recall(ids_got):
+            g = np.asarray(ids_got)
+            return np.mean([len(set(g[r]) & set(exact[r])) / k
+                            for r in range(len(g))])
+
+        _, i_m = m.search(q, block=True)
+        rebuilt = ivf_flat.build(live_db, params)
+        _, i_r = ivf_flat.search(rebuilt, q, k, sp)
+        rec_m, rec_r = recall(i_m), recall(live_ids[np.asarray(i_r)])
+        assert rec_m >= rec_r - 0.01, (rec_m, rec_r)
+        # no deleted id survives the fold anywhere in the new lists
+        new_ids = np.asarray(m.index.lists_indices)
+        assert not np.isin(new_ids[new_ids >= 0], del_ids).any()
+
+    def test_mutations_during_compaction_survive(self, dataset,
+                                                 flat_index):
+        x, q = dataset
+        m = _mutable(flat_index, caps=(64, 256))
+        ids0 = m.upsert(q[:2] + 0.001)     # folded by the compaction
+        t = threading.Thread(target=m.compact)
+        t.start()
+        # race mutations against the fold (some land before the swap,
+        # some after — both must survive)
+        ids1 = m.upsert(q[2:4] + 0.001)
+        m.delete([int(ids0[0])])
+        t.join()
+        for _ in range(2):                 # settle: second epoch view
+            _, i = m.search(q, block=True)
+        got = np.asarray(i)
+        assert int(ids0[0]) not in got[0]
+        assert int(ids0[1]) == int(got[1][0])
+        assert int(ids1[0]) == int(got[2][0])
+        assert int(ids1[1]) == int(got[3][0])
+
+    def test_rebuild_mode(self, dataset, flat_index):
+        x, q = dataset
+        m = _mutable(flat_index)
+        ids = m.upsert(q[:2] + 0.001)
+        m.delete([5])
+        assert m.compact(mode="rebuild")
+        _, i = m.search(q, block=True)
+        got = np.asarray(i)
+        assert int(ids[0]) == int(got[0][0])
+        assert 5 not in got
+        live = np.ones(len(x), bool)
+        live[5] = False
+        db = np.concatenate([x[live], q[:2] + 0.001], 0)
+        lid = np.concatenate([np.arange(len(x))[live], ids])
+        assert (got == _brute_ids(db, lid, q, 5)).all()
+
+    def test_pq_fold(self, dataset):
+        x, q = dataset
+        idx = ivf_pq.build(x, ivf_pq.IndexParams(
+            n_lists=8, pq_dim=8, kmeans_n_iters=2))
+        m = mutate.MutableIndex(
+            idx, k=5, params=ivf_pq.SearchParams(n_probes=8),
+            config=mutate.MutateConfig(delta_capacities=(64,)))
+        ids = m.upsert(q[:2])
+        _, i = m.search(q, block=True)
+        assert int(ids[0]) == int(np.asarray(i)[0][0])
+        victim = int(np.asarray(i)[4][0])
+        m.delete([victim])
+        assert m.compact()
+        _, i = m.search(q, block=True)
+        got = np.asarray(i)
+        assert int(ids[0]) == int(got[0][0])
+        assert victim not in got[4]
+
+
+class TestServingThroughCompaction:
+    def test_zero_failures_and_zero_steady_compiles(self, dataset,
+                                                    flat_index):
+        """Acceptance: searches succeed continuously (zero failed
+        requests) while a background compaction runs, and the
+        no-compaction mixed window performs zero compiles."""
+        x, q = dataset
+        m = _mutable(flat_index, caps=(64, 256))
+        cfg = serve.ServeConfig(batch_sizes=(1, 8), max_wait_ms=0.5)
+        srv = serve.SearchServer.from_index(m, q[:8], k=5, config=cfg)
+        comp = mutate.Compactor(m, poll_ms=5.0)
+        fails, done = [0], [0]
+        stop = threading.Event()
+
+        def client():
+            i = 0
+            while not stop.is_set():
+                try:
+                    srv.search(q[i % 16:i % 16 + 1])
+                    done[0] += 1
+                except Exception:
+                    fails[0] += 1
+                i += 1
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            # window A: mixed search+mutation, no compaction
+            before = obs.snapshot()
+            rng = np.random.default_rng(4)
+            for j in range(6):
+                ids = m.upsert(
+                    rng.standard_normal((4, 16)).astype(np.float32))
+                m.delete(ids[:1])
+                time.sleep(0.02)
+            diff = obs.snapshot_diff(before, obs.snapshot())
+            assert _misses(diff) == 0
+            assert diff.get("counters", {}).get(
+                "raft.mutate.compact.total", 0.0) == 0
+            # window B: force a compaction under continuing traffic
+            epoch0 = m.epoch
+            comp.trigger()
+            deadline = time.time() + 60
+            while m.epoch == epoch0 and time.time() < deadline:
+                time.sleep(0.01)
+            assert m.epoch == epoch0 + 1
+            time.sleep(0.05)               # a few post-swap searches
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            comp.close()
+            srv.close()
+        assert fails[0] == 0
+        assert done[0] > 0
+
+    def test_batcher_results_match_direct(self, dataset, flat_index):
+        x, q = dataset
+        m = _mutable(flat_index)
+        ids = m.upsert(q[:2] + 0.001)
+        m.delete([3])
+        cfg = serve.ServeConfig(batch_sizes=(1, 8), max_wait_ms=0.5)
+        srv = serve.SearchServer.from_index(m, q[:8], k=5, config=cfg)
+        try:
+            d_s, i_s = srv.search(q[:4])
+            d_d, i_d = m.search(q[:4], block=True)
+            assert (np.asarray(i_s) == np.asarray(i_d)).all()
+            np.testing.assert_allclose(np.asarray(d_s),
+                                       np.asarray(d_d), rtol=1e-5)
+        finally:
+            srv.close()
+
+
+class TestSaveLoad:
+    def test_roundtrip_with_pending_mutations(self, tmp_path, dataset,
+                                              flat_index):
+        x, q = dataset
+        m = _mutable(flat_index)
+        ids = m.upsert(q[:3] + 0.001)
+        m.delete([7, int(ids[1])])
+        d0, i0 = m.search(q, block=True)
+        path = str(tmp_path / "mut.npz")
+        serialize.save(m, path)
+        m2 = serialize.load(path)
+        assert isinstance(m2, mutate.MutableIndex)
+        st, st2 = m.stats(), m2.stats()
+        assert st2["tombstones"] == st["tombstones"]
+        assert st2["delta_live"] == st["delta_live"]
+        assert st2["next_id"] == st["next_id"]
+        assert st2["epoch"] == st["epoch"]
+        d1, i1 = m2.search(q, block=True)
+        assert (np.asarray(i0) == np.asarray(i1)).all()
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-5)
+        # mutation continues where it left off (id space monotone)
+        ids2 = m2.upsert(q[4:5])
+        assert int(ids2[0]) == st["next_id"]
+
+    def test_roundtrip_after_compaction(self, tmp_path, dataset,
+                                        flat_index):
+        _, q = dataset
+        m = _mutable(flat_index)
+        ids = m.upsert(q[:2] + 0.001)
+        m.compact()
+        path = str(tmp_path / "mut2.npz")
+        serialize.save_mutable(m, path)
+        m2 = serialize.load_mutable(path)
+        assert m2.epoch == 1
+        _, i = m2.search(q, block=True)
+        assert int(ids[0]) == int(np.asarray(i)[0][0])
+
+
+class TestHealthz:
+    def test_mutate_section_and_stalled_degradation(self, dataset,
+                                                    flat_index):
+        _, q = dataset
+        m = _mutable(flat_index, caps=(8, 16))
+        rng = np.random.default_rng(5)
+
+        def healthz():
+            # NB: urlopen raises HTTPError on 503 (caught at call site)
+            with urllib.request.urlopen(dbg.url + "/healthz") as r:
+                return r.status, json.loads(r.read())
+
+        dbg = obs.serve(port=0)
+
+        def get():
+            try:
+                return healthz()
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        try:
+            m.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+            # NB: other planes (comms suspects from unrelated tests in
+            # the same process) may already degrade the GLOBAL verdict;
+            # assertions on the overall status are therefore relative
+            # to this baseline — the stalled->503 direction is strict
+            code0, body = get()
+            assert "mutate" in body
+            assert body["mutate"]["delta_stalled"] == 0
+            # push the delta onto its TOP rung with no compactor:
+            # stalled -> degraded verdict
+            m.upsert(rng.standard_normal((8, 16)).astype(np.float32))
+            assert m.stats()["delta_rung"] == 1
+            code, body = get()
+            assert code == 503
+            assert body["status"] == "degraded"
+            assert body["mutate"]["delta_stalled"] == 1
+            # compaction drains the delta: the mutate plane recovers
+            # (and the verdict returns to its baseline)
+            m.compact()
+            code, body = get()
+            assert body["mutate"]["delta_stalled"] == 0
+            assert body["mutate"]["epoch"] == 1
+            assert code == code0
+        finally:
+            dbg.close()
+
+
+@pytest.fixture(scope="module")
+def mesh8(dataset):
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from raft_tpu.parallel.mesh import make_mesh
+    return make_mesh()
+
+
+class TestDistributedMutable:
+    def test_dist_serving_through_mutation_and_compaction(
+            self, dataset, mesh8):
+        x, _q = dataset
+        rng = np.random.default_rng(6)
+        q = rng.standard_normal((8, 16)).astype(np.float32)
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16,
+                                                     kmeans_n_iters=4))
+        m = mutate.MutableIndex(
+            idx, k=5, params=ivf_flat.SearchParams(n_probes=2),
+            config=mutate.MutateConfig(delta_capacities=(64,)))
+        cfg = serve.ServeConfig(batch_sizes=(1, 8), max_wait_ms=0.5)
+        srv = serve.DistributedSearchServer.from_mutable(
+            m, q, mesh=mesh8, config=cfg)
+        try:
+            _d, i = srv.search(q[:1])
+            ids = m.upsert(q[0:1] + 0.0001)
+            before = obs.snapshot()
+            _d, i = srv.search(q[:1])
+            assert int(ids[0]) in np.asarray(i)[0]
+            assert _misses(obs.snapshot_diff(before,
+                                             obs.snapshot())) == 0
+            victim = int(np.asarray(i)[0][1])
+            m.delete([victim])
+            _d, i = srv.search(q[:1])
+            assert victim not in np.asarray(i)[0]
+            assert m.compact()
+            _d, i = srv.search(q[:1])
+            got = np.asarray(i)[0]
+            assert int(ids[0]) in got and victim not in got
+        finally:
+            srv.close()

@@ -114,6 +114,19 @@ REQUIRED_NAMES = (
     "raft.serve.dist.shards",
     "raft.serve.dist.merge.ratio",
     "raft.comms.health.suspect_rank",
+    # live mutable indexes (ISSUE 9): mutation volume, the delta-fill /
+    # tombstone gauges the /healthz mutate section reads (incl. the
+    # stalled-compactor flag that degrades the verdict), and the
+    # compaction lifecycle counters the bench keys on
+    "raft.mutate.upserts.total",
+    "raft.mutate.deletes.total",
+    "raft.mutate.delta.fill_frac",
+    "raft.mutate.delta.stalled",
+    "raft.mutate.tombstone.frac",
+    "raft.mutate.epoch",
+    "raft.mutate.compact.total",
+    "raft.mutate.compact.inflight",
+    "raft.mutate.delta.overflow.total",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -145,6 +158,9 @@ REQUIRED_SPAN_NAMES = (
     # root under raft.serve.batch (the rank-tagged
     # raft.parallel.ivf.shard children ride under it)
     "raft.serve.dist.dispatch",
+    # live mutable indexes (ISSUE 9): the compaction fold/prewarm/swap
+    # lifecycle span (epoch + row/tombstone counts ride as attrs)
+    "raft.mutate.compact",
 )
 
 

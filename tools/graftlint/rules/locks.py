@@ -132,8 +132,9 @@ class LockDiscipline(Rule):
     description = ("_locked-suffix methods called without the lock and "
                    "GUARDED_BY fields touched outside `with "
                    "self._lock/_cond` (static race detector for the "
-                   "PR 5 dispatcher/caller thread boundary)")
-    paths = ("raft_tpu/serve", "raft_tpu/comms")
+                   "PR 5 dispatcher/caller thread boundary and the "
+                   "ISSUE 9 mutate dispatcher/compactor boundary)")
+    paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         tree = ctx.tree

@@ -59,10 +59,16 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
                   duration_s: float, nq: int = 1,
                   k: Optional[int] = None,
                   deadline_ms: Optional[float] = None,
-                  seed: int = 0, drain_timeout_s: float = 60.0) -> dict:
+                  seed: int = 0, drain_timeout_s: float = 60.0,
+                  mutator=None, mutate_frac: float = 0.0) -> dict:
     """Offer Poisson traffic at ``rate_qps`` requests/s for
     ``duration_s``; every request draws ``nq`` consecutive rows from
-    ``query_pool``. Returns the accounting + latency report."""
+    ``query_pool``. With ``mutator`` (a
+    :class:`raft_tpu.mutate.MutableIndex`) and ``mutate_frac`` > 0,
+    each arrival is a WRITE with that probability instead — an upsert
+    of one pool row (or, every 4th write, a delete of a previously
+    upserted id): the mixed read/write traffic a live corpus actually
+    sees. Returns the accounting + latency report."""
     from raft_tpu import obs
     from raft_tpu.serve import DeadlineExceeded, RejectedError
 
@@ -71,6 +77,8 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
     lock = threading.Lock()
     latencies, outcomes = [], {"ok": 0, "shed": 0, "deadline": 0,
                                "error": 0}
+    writes = {"upserts": 0, "deletes": 0, "write_rejects": 0}
+    written_ids = []
     pending = []
     before = obs.snapshot()
     t0 = time.perf_counter()
@@ -85,6 +93,21 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
             continue
         t_next += rng.expovariate(rate_qps)
         s = rng.randrange(0, max(1, pool_n - nq))
+        if mutator is not None and rng.random() < mutate_frac:
+            # mutation arrival: inline host-side apply (mutations are
+            # lock+numpy+one async transfer — microseconds)
+            from raft_tpu.mutate import DeltaFullError
+            try:
+                if written_ids and writes["upserts"] % 4 == 3:
+                    writes["deletes"] += mutator.delete(
+                        [written_ids.pop(0)])
+                else:
+                    ids = mutator.upsert(query_pool[s:s + 1])
+                    written_ids.append(int(ids[0]))
+                    writes["upserts"] += 1
+            except DeltaFullError:
+                writes["write_rejects"] += 1
+            continue
         t_sub = time.perf_counter()
         fut = server.submit(query_pool[s:s + nq], k=k,
                             deadline_ms=deadline_ms)
@@ -133,6 +156,11 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
                 k_: v for k_, v in diff.get("counters", {}).items()
                 if k_.startswith("raft.serve.")},
         }
+        if mutator is not None and mutate_frac > 0:
+            report["mutate"] = dict(
+                writes, mutate_metrics={
+                    k_: v for k_, v in diff.get("counters", {}).items()
+                    if k_.startswith("raft.mutate.")})
     return report
 
 
@@ -151,7 +179,8 @@ def measure_sustainable_qps(server, query_pool: np.ndarray, nq: int = 1,
 
 def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
                        probes_ladder, deadline_ms: float,
-                       server: str = "single"):
+                       server: str = "single",
+                       mutate_frac: float = 0.0):
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.random import make_blobs
@@ -183,13 +212,22 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
         params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
         srv = serve.DistributedSearchServer.from_sharded_index(
             sindex, q[:32], k=k, params=params, mesh=mesh, config=cfg)
-        return srv, q
+        return srv, q, None
     index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
                                                    kmeans_n_iters=4))
     params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
+    if mutate_frac > 0:
+        # mixed read/write traffic (ISSUE 9): serve a MutableIndex and
+        # run a background compactor — writes land in the delta
+        # segment, the open loop interleaves them with searches
+        from raft_tpu import mutate
+        mindex = mutate.MutableIndex(index, k=k, params=params)
+        srv = serve.SearchServer.from_index(mindex, q[:32], k=k,
+                                            config=cfg)
+        return srv, q, mindex
     srv = serve.SearchServer.from_index(index, q[:32], k=k,
                                         params=params, config=cfg)
-    return srv, q
+    return srv, q, None
 
 
 def merge_bytes_by_rung(metrics_diff: dict) -> dict:
@@ -227,16 +265,30 @@ def main(argv=None) -> int:
                          "SearchServer, 'dist' = DistributedSearchServer "
                          "over a mesh of every local device (list-"
                          "sharded index, quantized cross-shard merge)")
+    ap.add_argument("--mutate-frac", type=float, default=0.0,
+                    help="fraction of arrivals that are WRITES "
+                         "(upsert/delete against a MutableIndex with a "
+                         "background compactor) instead of searches — "
+                         "mixed read/write traffic; single server only")
     ap.add_argument("--demo", action="store_true",
                     help="overload demo: offer 2x the calibrated "
                          "sustainable rate and show the ladder holding "
                          "p99 while recall steps down")
     args = ap.parse_args(argv)
+    if args.mutate_frac and args.server == "dist":
+        ap.error("--mutate-frac rides the single-device server "
+                 "(DistributedSearchServer.from_mutable is the "
+                 "library-level mesh path)")
 
     ladder = tuple(int(s) for s in args.probes_ladder.split(","))
-    srv, q = _build_demo_server(args.n, args.dim, args.n_lists, args.k,
-                                ladder, args.deadline_ms,
-                                server=args.server)
+    srv, q, mindex = _build_demo_server(
+        args.n, args.dim, args.n_lists, args.k, ladder,
+        args.deadline_ms, server=args.server,
+        mutate_frac=args.mutate_frac)
+    comp = None
+    if mindex is not None:
+        from raft_tpu import mutate
+        comp = mutate.Compactor(mindex)
     try:
         if args.demo:
             from raft_tpu import obs
@@ -249,7 +301,8 @@ def main(argv=None) -> int:
             report = run_open_loop(
                 srv, q, rate_qps=rate, duration_s=args.duration,
                 nq=args.nq, deadline_ms=args.deadline_ms or None,
-                seed=args.seed)
+                seed=args.seed, mutator=mindex,
+                mutate_frac=args.mutate_frac)
             report["phase"] = "overload"
             report["watermark_ms"] = srv.config.degrade_watermark_ms
             report["p99_under_watermark"] = (
@@ -275,9 +328,12 @@ def main(argv=None) -> int:
             report = run_open_loop(
                 srv, q, rate_qps=args.rate, duration_s=args.duration,
                 nq=args.nq, deadline_ms=args.deadline_ms or None,
-                seed=args.seed)
+                seed=args.seed, mutator=mindex,
+                mutate_frac=args.mutate_frac)
             print(json.dumps(report), flush=True)
     finally:
+        if comp is not None:
+            comp.close()
         srv.close()
     return 0
 

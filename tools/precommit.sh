@@ -71,6 +71,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# live mutable indexes (ISSUE 9): upsert/delete/tombstone semantics,
+# the delta rung ladder's zero-compile growth, recall parity of
+# fold-compaction vs a from-scratch rebuild, serving continuity through
+# a background compaction, and the mutable save→load→search round trip.
+echo "precommit: mutable-index tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_mutate.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # distributed serving tier (ISSUE 8): the int8 merge codec round-trip
 # + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
 # mesh, pad-row non-leakage through the distributed scatter, and the

@@ -12,6 +12,8 @@
 #        acceptance on hardware)
 #   ds1  merge-format A/B at the same point: RAFT_TPU_DIST_MERGE=f32
 #        rerun — the compression's QPS/recall cost measured same-round
+#   mu0  mutable-index row (ISSUE 9): fold-vs-rebuild recall parity
+#        after 10k mutations + serving QPS under a mutation stream
 #   h1   headline bench (driver format) so the round has fresh
 #        single-device context for the dist comparison
 #   g0   full gated suite (PERF/RECALL/GAP gates end-to-end on TPU)
@@ -59,6 +61,14 @@ ds1() {  # f32-merge A/B at the same operating point (compression cost)
   cp -f "$OUT/dist_serve_f32.log" docs/measurements/
 }
 
+mu0() {  # mutable-index row (ISSUE 9): recall parity of fold-vs-
+         # rebuild after 10k interleaved mutations + sustained serving
+         # QPS under a concurrent mutation stream, on hardware
+  BENCH_MUTATE_N=500000 python bench_suite.py mutate \
+    2>&1 | tee "$OUT/mutate_r6.log"
+  cp -f "$OUT/mutate_r6.log" docs/measurements/
+}
+
 h1() {  # headline bench rows (driver format, embedded measured_at)
   python bench.py 2>&1 | tee "$OUT/headline_r6.log"
   cp -f "$OUT/headline_r6.log" docs/measurements/
@@ -71,6 +81,7 @@ g0() {  # the full gated suite, end-to-end on hardware
 
 run ds0 ds0
 run ds1 ds1
+run mu0 mu0
 run h1 h1
 run g0 g0
 echo "[$(stamp)] == r6 campaign complete"
