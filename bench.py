@@ -368,6 +368,32 @@ def child_main():
         except Exception as e:
             out["mutate_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # chaos row (ISSUE 10): one shard stalled mid-load through the
+        # watchdog/retry/failover stack — availability, flagged-partial
+        # fraction, bounded p99 and the zero-failure-path-compile
+        # contract, plus recovery clearing the exclusion
+        try:
+            rows = []
+            bench_suite.bench_chaos(rows, n=min(n_ivf, 100_000))
+            for r in rows:
+                if "chaos_availability" in r:
+                    out["chaos_availability"] = r["chaos_availability"]
+                    out["chaos_availability_ok"] = \
+                        r["chaos_availability_ok"]
+                    out["chaos_partial_fraction"] = \
+                        r["chaos_partial_fraction"]
+                    out["chaos_hung_requests"] = \
+                        r["chaos_hung_requests"]
+                    out["chaos_p99_ms"] = r["chaos_p99_ms"]
+                    out["chaos_p99_bounded"] = r["chaos_p99_bounded"]
+                    out["chaos_recovered"] = r["chaos_recovered"]
+                    out["chaos_steady_state_compiles"] = \
+                        r["chaos_steady_state_compiles"]
+                elif "error" in r:
+                    out.setdefault("chaos_error", r["error"])
+        except Exception as e:
+            out["chaos_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

@@ -1132,6 +1132,140 @@ def bench_mutate(results, n=None, nlists=1024, n_probes=None):
         server.close()
 
 
+def bench_chaos(results, n=None, nlists=64):
+    """Chaos smoke (ISSUE 10): open-loop traffic against the mesh-wide
+    ``DistributedSearchServer`` with the full failure-handling stack on
+    (dispatch watchdog, retry budget, pre-warmed partial-mesh failover)
+    while ONE shard stalls mid-run via the fault harness
+    (``raft_tpu.testing.faults.stall_shard``), then recovers. The
+    acceptance row: zero hung requests (every future resolves within
+    deadline+grace), availability ≥ 0.999 with partial results
+    explicitly flagged, p99 under the degradation watermark, zero
+    steady-state compiles through failure AND recovery (asserted from
+    the plan-cache counters — the degraded ladder is pre-warmed, never
+    compiled on the failure path), and the exclusion cleared at the
+    end. Knobs: ``BENCH_CHAOS_N`` (rows, default 100k),
+    ``BENCH_CHAOS_SECONDS`` (traffic window, default 6)."""
+    import importlib.util
+    import threading
+    from raft_tpu import obs, serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import shard_ivf_flat
+    from raft_tpu.parallel.mesh import make_mesh
+    from raft_tpu.testing import faults
+    n = n or int(os.environ.get("BENCH_CHAOS_N", 100_000))
+    seconds = float(os.environ.get("BENCH_CHAOS_SECONDS", 6.0))
+    mesh = make_mesh()
+    n_shards = mesh.shape["data"]
+    metric = f"chaos_stall_{n//1000}kx128_x{n_shards}"
+    if n_shards < 2:
+        results.append({"metric": metric,
+                        "error": "needs a multi-device mesh (a stalled "
+                                 "shard on 1 device is an outage, not "
+                                 "a failover)"})
+        return
+    if nlists % n_shards:
+        nlists = max(n_shards, nlists // n_shards * n_shards)
+    d, nq_pool, k = 128, 256, 32
+    db, q = _ann_dataset(n, d, nq_pool)
+    q_np = np.asarray(q)
+    index = ivf_flat.build(db, ivf_flat.IndexParams(
+        n_lists=nlists, kmeans_n_iters=10))
+    sindex = shard_ivf_flat(index, mesh)
+    p_shard = max(1, min(FLAT_PROBES // n_shards, nlists // n_shards))
+    watermark_ms = 1000.0
+    cfg = serve.ServeConfig(
+        batch_sizes=(1, 8, 32), max_queue=512, max_wait_ms=2.0,
+        default_deadline_ms=3000.0,
+        degrade_watermark_ms=watermark_ms,
+        dispatch_timeout_ms=300.0, max_retries=2,
+        retry_backoff_ms=20.0, failover=True, failover_probe_ms=300.0)
+    srv = serve.DistributedSearchServer.from_sharded_index(
+        sindex, q_np[:32], k,
+        params=ivf_flat.SearchParams(n_probes=p_shard), mesh=mesh,
+        config=cfg)
+    spec = importlib.util.spec_from_file_location(
+        "raft_loadgen",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    try:
+        # modest open-loop rate (half the closed-loop ceiling): the row
+        # measures failure handling, not saturation
+        sustainable = loadgen.measure_sustainable_qps(
+            srv, q_np, seconds=1.0)
+        rate = max(20.0, 0.5 * sustainable)
+        stall_rank = n_shards - 1
+        before = obs.snapshot()
+        release = threading.Event()
+
+        def chaos():
+            time.sleep(seconds / 3.0)
+            with faults.stall_shard(stall_rank, seconds=60.0):
+                release.wait(seconds / 3.0)
+
+        t = threading.Thread(target=chaos, daemon=True)
+        t.start()
+        rep = loadgen.run_open_loop(
+            srv, q_np, rate_qps=rate, duration_s=seconds, nq=1,
+            deadline_ms=cfg.default_deadline_ms, seed=0)
+        release.set()
+        t.join(timeout=90.0)
+        # recovery: traffic after the fault cleared must re-admit the
+        # full mesh (the probe runs on batch arrivals)
+        recovered = False
+        t_end = time.perf_counter() + 15.0
+        while time.perf_counter() < t_end:
+            srv.search(q_np[:1])
+            if obs.snapshot()["gauges"].get(
+                    "raft.serve.failover.engaged", 0.0) == 0:
+                recovered = True
+                break
+            time.sleep(0.2)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+
+        def csum(name):
+            return sum(v for k_, v in cnt.items()
+                       if k_ == name or k_.startswith(name + "{"))
+
+        compiles = (csum("raft.parallel.plan.misses")
+                    + csum("raft.plan.cache.misses")
+                    + csum("raft.plan.build.total"))
+        hung = rep["offered"] - (rep["completed"] + rep["shed"]
+                                 + rep["deadline_expired"]
+                                 + rep["errors"])
+        results.append({
+            "metric": metric,
+            "value": rep["availability"], "unit": "availability",
+            "chaos_availability": rep["availability"],
+            "chaos_availability_ok": rep["availability"] >= 0.999,
+            "chaos_partial_fraction": rep["partial_fraction"],
+            "chaos_partial": rep["partial"],
+            "chaos_hung_requests": int(hung),
+            "chaos_p99_ms": rep["p99_ms"],
+            "chaos_watermark_ms": watermark_ms,
+            "chaos_p99_bounded": rep["p99_ms"] <= watermark_ms,
+            "chaos_errors": rep["errors"],
+            "chaos_deadline_expired": rep["deadline_expired"],
+            "chaos_retries": int(csum("raft.serve.retry.total")),
+            "chaos_dispatch_timeouts": int(
+                csum("raft.serve.dispatch.timeouts.total")),
+            "chaos_failover_engagements": int(
+                csum("raft.serve.failover.total")),
+            "chaos_recovered": recovered,
+            "chaos_steady_state_compiles": int(compiles),
+            "offered_qps": rep["offered_qps"],
+            "n_shards": n_shards,
+            "stalled_rank": stall_rank})
+    except Exception as e:
+        results.append({"metric": metric, "error": repr(e)[:200]})
+    finally:
+        faults.reset()
+        srv.close()
+
+
 def bench_brute_500k(results):
     # the IVF bench point's brute baseline, default-on so the
     # bfknn_fused_500k gate (wall-QPS floor 35k — see PERF_GATES) has
@@ -1258,7 +1392,7 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_flat, bench_ivf_flat_100k, bench_ivf_pq,
           bench_ivf_pq4,
           bench_ivf_bq, bench_serve, bench_serve_sharded,
-          bench_mutate,
+          bench_mutate, bench_chaos,
           bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,

@@ -287,6 +287,23 @@ class HealthMonitor:
         self.stop()
 
 
+def suspects_from_gauges(gauges: Dict[str, float]) -> List[int]:
+    """Parse the per-rank suspect flags out of a metrics-snapshot
+    ``gauges`` dict → sorted ranks currently flagged. One parser shared
+    by the ``/healthz`` dist section and the distributed serving tier's
+    failover exclusion (ISSUE 10) — the two consumers of the
+    ``raft.comms.health.suspect_rank`` plane must never disagree on
+    what it says."""
+    raw = {lbl.split("rank=")[1].rstrip("}").split(",")[0]
+           for lbl, v in gauges.items()
+           if lbl.startswith("raft.comms.health.suspect_rank{")
+           and "rank=" in lbl and v > 0}
+    try:
+        return sorted(int(r) for r in raw)
+    except ValueError:
+        return sorted(raw)
+
+
 # ranks of a single-process clique share one board, mirroring host_p2p's
 # default registry
 _default_board = _InProcessBoard()

@@ -41,12 +41,14 @@ from raft_tpu.mutate.compactor import Compactor
 from raft_tpu.mutate.mutable import (MutableIndex, build_dist_serve_ladder,
                                      build_serve_ladder)
 from raft_tpu.mutate.types import DeltaFullError, MutateConfig
+from raft_tpu.mutate.wal import MutationWAL
 
 __all__ = [
     "Compactor",
     "DeltaFullError",
     "MutableIndex",
     "MutateConfig",
+    "MutationWAL",
     "build_dist_serve_ladder",
     "build_serve_ladder",
 ]

@@ -9,15 +9,25 @@ the serving dispatcher only ever swaps a reference. ``trigger()``
 forces a fold on the next wakeup regardless of fill (operational
 lever: fold before a deploy, a snapshot, a traffic spike).
 
-A failed fold is counted (``raft.mutate.compact.errors``), logged, and
-retried on the next trigger — the serving state is untouched by a
-failed attempt (the swap is the last step)."""
+Crash-loop guard (ISSUE 10): the WHOLE iteration body — including the
+``should_compact`` poll, which previously ran outside the try and
+could kill the daemon forever with one exception — is guarded. A
+failed attempt is counted (``raft.mutate.compactor.errors``), the poll
+interval backs off exponentially (a poisoned fold must not busy-loop
+the machine), and after ``fail_threshold`` consecutive failures the
+``raft.mutate.compactor.failing`` gauge degrades ``/healthz``: a
+compactor that cannot fold means the delta WILL hit its
+:class:`~raft_tpu.mutate.DeltaFullError` wall, and the box must say so
+before writes start bouncing. The serving state is untouched by any
+failed attempt (the swap is compact()'s last step), and the first
+success clears the gauge and resets the backoff."""
 
 from __future__ import annotations
 
 import threading
 from typing import Optional
 
+from raft_tpu import obs
 from raft_tpu.core.logger import get_logger
 
 __all__ = ["Compactor"]
@@ -35,17 +45,22 @@ class Compactor:
 
     def __init__(self, mindex, mode: Optional[str] = None, mesh=None,
                  axis: str = "data", poll_ms: Optional[float] = None,
-                 start: bool = True):
+                 fail_threshold: int = 3, backoff_mult: float = 2.0,
+                 max_backoff_s: float = 5.0, start: bool = True):
         self._m = mindex
         self._mode = mode
         self._mesh = mesh
         self._axis = axis
         self._poll_s = (poll_ms if poll_ms is not None
                         else mindex.cfg.compact_poll_ms) / 1e3
+        self._fail_threshold = max(1, int(fail_threshold))
+        self._backoff_mult = max(1.0, float(backoff_mult))
+        self._max_backoff_s = float(max_backoff_s)
         self._cond = threading.Condition()
         self._closed = False
         self._force = False
         self._thread: Optional[threading.Thread] = None
+        obs.gauge("raft.mutate.compactor.failing").set(0)
         if start:
             self.start()
 
@@ -80,21 +95,50 @@ class Compactor:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _wait_s(self, consecutive_failures: int) -> float:
+        """Poll interval with exponential backoff while failing."""
+        if consecutive_failures <= 0:
+            return self._poll_s
+        return min(self._poll_s
+                   * self._backoff_mult ** consecutive_failures,
+                   self._max_backoff_s)
+
     def _loop(self) -> None:
         log = get_logger("mutate")
+        consec = 0
         while True:
             with self._cond:
                 if self._closed:
                     break
-                self._cond.wait(timeout=self._poll_s)
+                self._cond.wait(timeout=self._wait_s(consec))
                 if self._closed:
                     break
                 force, self._force = self._force, False
-            if not (force or self._m.should_compact()):
-                continue
+            # crash-loop guard: EVERYTHING the iteration does is inside
+            # the try — one exception (in the poll or the fold) used to
+            # kill the daemon and silently stall the delta at its top
+            # rung forever
             try:
+                if not (force or self._m.should_compact()):
+                    continue
                 self._m.compact(mode=self._mode, mesh=self._mesh,
                                 axis=self._axis)
-            except Exception as e:   # counted in compact(); keep serving
-                log.warning("compaction failed (will retry on next "
-                            "trigger): %r", e)
+                if consec:
+                    log.warn("compactor recovered after %d failed "
+                             "attempt(s)", consec)
+                consec = 0
+                obs.gauge("raft.mutate.compactor.failing").set(0)
+            except Exception as e:
+                consec += 1
+                obs.counter("raft.mutate.compactor.errors").inc()
+                if consec >= self._fail_threshold:
+                    # /healthz degrades on this gauge: N consecutive
+                    # failed folds mean DeltaFullError is coming
+                    obs.gauge("raft.mutate.compactor.failing").set(1)
+                # NB: the framework logger has warn(), not warning() —
+                # the pre-guard code called log.warning here, so the
+                # "failure handler" itself raised AttributeError and
+                # killed the daemon (exactly the bug class GL006 hunts)
+                log.warn(
+                    "compaction failed (attempt %d, next retry in "
+                    "%.3gs): %r", consec, self._wait_s(consec), e)

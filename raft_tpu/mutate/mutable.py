@@ -35,6 +35,7 @@ snapshots.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
@@ -50,6 +51,9 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.mutate import compact as compact_mod
 from raft_tpu.mutate import program as program_mod
 from raft_tpu.mutate.types import DeltaFullError, MutateConfig
+from raft_tpu.mutate.wal import (OP_DELETE, OP_META, OP_UPSERT,
+                                 MutationWAL)
+from raft_tpu.testing import faults
 
 __all__ = ["MutableIndex", "build_serve_ladder",
            "build_dist_serve_ladder"]
@@ -100,7 +104,8 @@ class MutableIndex:
                   "_delta_ids", "_delta_used", "_delta_live",
                   "_delta_map", "_tomb", "_tomb_ids", "_next_id",
                   "_compacting", "_frozen_id_base", "_pending_tombs",
-                  "_rep", "_rungs", "_grid", "_dist_cfg")
+                  "_rep", "_rungs", "_grid", "_dist_cfg", "_wal",
+                  "_wal_ckpt")
 
     def __init__(self, index, k: int, params=None,
                  config: Optional[MutateConfig] = None):
@@ -140,6 +145,8 @@ class MutableIndex:
                 min(self.params.n_probes, index.n_lists),)
             self._grid: set = set()
             self._dist_cfg: Optional[dict] = None
+            self._wal: Optional[MutationWAL] = None
+            self._wal_ckpt: Optional[str] = None
             self._dev: Optional[_DeviceState] = None
             self._push_dev_locked()
 
@@ -242,6 +249,14 @@ class MutableIndex:
                 raise DeltaFullError(
                     f"delta segment full ({self._delta_used}+{n} > "
                     f"top rung {top}): waiting on compaction")
+            if self._wal is not None:
+                # write-ahead: the record is durable (fsync'd) BEFORE
+                # the in-memory apply, so an ack implies recoverability;
+                # an append that made it to disk without the apply
+                # (crash in between) replays harmlessly — the caller
+                # was never acked, and at-least-once replay of explicit
+                # ids reproduces the same logical state
+                self._wal.append_upsert(ids_arr, x)
             slots = np.arange(self._delta_used, self._delta_used + n)
             self._delta_data[slots] = x
             self._delta_norms[slots] = (x * x).sum(axis=1)
@@ -270,6 +285,8 @@ class MutableIndex:
         ids_arr = np.asarray(ids, np.int64).reshape(-1)
         hit = 0
         with self._cond:
+            if self._wal is not None:
+                self._wal.append_delete(ids_arr)
             for id_ in ids_arr:
                 id_ = int(id_)
                 dead = False
@@ -313,12 +330,22 @@ class MutableIndex:
         host→device transfers — never a compile."""
         rung = self._rung_for_locked(self._delta_used)
         cap = self.cfg.delta_capacities[rung]
-        self._dev = _DeviceState(
-            epoch_number=self._epoch.number, rung=rung,
-            delta_data=jnp.asarray(self._delta_data[:cap]),
-            delta_norms=jnp.asarray(self._delta_norms[:cap]),
-            delta_ids=jnp.asarray(self._delta_ids[:cap]),
-            tomb=jnp.asarray(self._tomb))
+        try:
+            faults.inject("mutate.transfer", epoch=self._epoch.number)
+            self._dev = _DeviceState(
+                epoch_number=self._epoch.number, rung=rung,
+                delta_data=jnp.asarray(self._delta_data[:cap]),
+                delta_norms=jnp.asarray(self._delta_norms[:cap]),
+                delta_ids=jnp.asarray(self._delta_ids[:cap]),
+                tomb=jnp.asarray(self._tomb))
+        except Exception:
+            # a failed host→device refresh leaves the PREVIOUS
+            # consistent snapshot serving (stale by exactly this
+            # mutation batch); the caller sees the error — with a WAL
+            # attached the mutation is already durable, so the next
+            # successful mutation (or recovery) repairs the view
+            obs.counter("raft.mutate.transfer.errors").inc()
+            raise
         self._set_gauges_locked(rung, cap)
 
     def _set_gauges_locked(self, rung: int, cap: int) -> None:
@@ -595,6 +622,9 @@ class MutableIndex:
         HERE, on the calling/compactor thread, before the swap).
         Returns False when a fold is already in flight."""
         from raft_tpu.obs import spans
+        # chaos-harness site (kill_compactor): raises BEFORE any state
+        # is frozen, so a killed fold leaves serving untouched
+        faults.inject("mutate.compact")
         with self._cond:
             if self._compacting:
                 return False
@@ -632,7 +662,12 @@ class MutableIndex:
                 # keep draining old-epoch programs meanwhile
                 self._prewarm_epoch(new_epoch)
                 sp.set_attr("new_size", int(new_index.size))
-            self._swap_epoch(new_epoch, freeze_used, new_id_base)
+                # durable checkpoint of the folded index (compactor
+                # thread, off the serving path) so the swap may
+                # truncate the WAL (ISSUE 10)
+                ckpt_tmp = self._checkpoint_epoch(new_index)
+            self._swap_epoch(new_epoch, freeze_used, new_id_base,
+                             ckpt_tmp=ckpt_tmp)
             obs.counter("raft.mutate.compact.total").inc()
             return True
         except BaseException:
@@ -642,8 +677,23 @@ class MutableIndex:
                 self._push_dev_locked()
             raise
 
+    def _checkpoint_epoch(self, new_index) -> Optional[str]:
+        """Save the folded inner index next to the WAL checkpoint path
+        (tmp file; the swap promotes it atomically). None when no WAL /
+        checkpoint is configured — then the log is never truncated and
+        recovery replays it in full onto the original base."""
+        with self._cond:
+            wal, ckpt = self._wal, self._wal_ckpt
+        if wal is None or not ckpt:
+            return None
+        from raft_tpu.neighbors import serialize
+        tmp = ckpt + ".tmp"
+        serialize.save(new_index, tmp)
+        return tmp
+
     def _swap_epoch(self, new_epoch: _Epoch, freeze_used: int,
-                    new_id_base: int) -> None:
+                    new_id_base: int,
+                    ckpt_tmp: Optional[str] = None) -> None:
         with self._cond:
             # rebase the delta: rows appended after the freeze slide to
             # the front; everything folded leaves the segment
@@ -670,8 +720,104 @@ class MutableIndex:
                 self._tomb[id_ >> 5] |= np.uint32(1 << (id_ & 31))
             self._epoch = new_epoch
             self._compacting = False
+            if self._wal is not None and ckpt_tmp is not None:
+                # promote the checkpoint, then truncate the log to the
+                # still-pending tail: deletes first, then live tail
+                # upserts, so a replayed tail upsert re-shadows its
+                # tombstoned main row (both steps atomic; a crash
+                # between them replays the old full log onto the new
+                # checkpoint — at-least-once, same logical state)
+                os.replace(ckpt_tmp, self._wal_ckpt)
+                live = self._delta_ids[:self._delta_used] >= 0
+                self._wal.rewrite(
+                    meta={"epoch": new_epoch.number,
+                          "id_base": new_epoch.id_base,
+                          "next_id": self._next_id},
+                    tomb_ids=np.asarray(sorted(self._tomb_ids),
+                                        np.int64),
+                    upsert_ids=self._delta_ids[:self._delta_used][live],
+                    upsert_rows=self._delta_data[:self._delta_used][live])
             self._push_dev_locked()
             self._cond.notify_all()
+
+    # -- durability: mutation WAL (ISSUE 10) -------------------------------
+    def attach_wal(self, wal: MutationWAL,
+                   checkpoint_path: Optional[str] = None
+                   ) -> "MutableIndex":
+        """Make every acked mutation durable: subsequent ``upsert`` /
+        ``delete`` calls append + fsync their WAL record BEFORE the
+        in-memory apply, so :meth:`recover` replays 100% of acked
+        mutations after process death. ``checkpoint_path`` additionally
+        lets compactions truncate the log — the folded inner index is
+        saved there (tmp + atomic replace at the epoch swap) and the
+        WAL rewrites to just the still-pending tail; without it the log
+        grows until rotated externally and recovery replays it in full
+        onto the original base index (docs/robustness.md)."""
+        with self._cond:
+            self._wal = wal
+            self._wal_ckpt = checkpoint_path
+        return self
+
+    @classmethod
+    def recover(cls, wal_path: str, k: int, base_index=None,
+                checkpoint_path: Optional[str] = None, params=None,
+                config: Optional[MutateConfig] = None,
+                sync: bool = True) -> "MutableIndex":
+        """Rebuild the live mutable state after process death: load the
+        latest durable inner index (the compaction checkpoint when one
+        exists, else ``base_index`` — the index the WAL was started
+        against), replay every acked mutation from the log in order,
+        and re-attach the log for new writes. Replay is at-least-once
+        over explicit ids, so a record that was fsync'd but never
+        acked/applied reproduces the same logical state; a replay that
+        overflows the delta segment compacts inline and continues —
+        recovery never fails on volume."""
+        from raft_tpu.neighbors import serialize
+        inner = None
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            inner = serialize.load(checkpoint_path)
+        else:
+            inner = base_index
+        expects(inner is not None,
+                "mutate.recover: no checkpoint at %r and no base_index "
+                "— recovery needs the index the WAL was started "
+                "against", checkpoint_path)
+        wal = MutationWAL(wal_path, sync=sync)
+        records = wal.replay()
+        m = cls(inner, k=int(k), params=params, config=config)
+        if records and records[0].op == OP_META:
+            # post-compaction log: restore the id-space/epoch counters
+            # the checkpointed index was folded under (id_base may
+            # exceed inner.size — ids are a space, rows are a count)
+            meta = records[0].meta
+            with m._cond:
+                id_base = int(meta["id_base"])
+                m._epoch = _Epoch(index=inner, id_base=id_base,
+                                  number=int(meta["epoch"]),
+                                  tomb_words=_tomb_words(id_base))
+                m._tomb = np.zeros((m._epoch.tomb_words,), np.uint32)
+                m._next_id = int(meta["next_id"])
+                m._push_dev_locked()
+            records = records[1:]
+        top = m.cfg.delta_capacities[-1]
+        for rec in records:
+            if rec.op == OP_DELETE:
+                m.delete(rec.ids)
+            elif rec.op == OP_UPSERT:
+                ids32 = np.asarray(rec.ids, np.int32)
+                # chunk to the top rung: the log may have been written
+                # under a LARGER delta budget than the recovering
+                # process configures
+                for s in range(0, ids32.shape[0], top):
+                    try:
+                        m.upsert(rec.rows[s:s + top],
+                                 ids=ids32[s:s + top])
+                    except DeltaFullError:
+                        m.compact()
+                        m.upsert(rec.rows[s:s + top],
+                                 ids=ids32[s:s + top])
+        m.attach_wal(wal, checkpoint_path=checkpoint_path)
+        return m
 
     # -- persistence (neighbors/serialize.py) ------------------------------
     def export_state(self) -> dict:

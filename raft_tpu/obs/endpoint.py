@@ -78,12 +78,20 @@ def _health_body(snapshot: dict) -> dict:
     shed_rate = _gsum("raft.serve.shed.rate")
     serve_degraded = (overloaded > 0 or shed_rate > 0
                       or (qmax > 0 and depth >= qmax))
+    # failure-handling plane (ISSUE 10): serving partial results over a
+    # degraded mesh is availability, not health — /healthz must say so
+    # until recovery clears the exclusion
+    failover_engaged = _gsum("raft.serve.failover.engaged")
+    serve_degraded = serve_degraded or failover_engaged > 0
     # mutable-index plane (ISSUE 9): a delta segment sitting at its TOP
     # ladder rung with no compaction in flight is a stalled compactor —
     # the next rung boundary is a hard DeltaFullError wall, so this box
-    # must stop reporting healthy BEFORE writes start bouncing
+    # must stop reporting healthy BEFORE writes start bouncing. A
+    # crash-looping compactor (ISSUE 10) degrades the same way: N
+    # consecutive failed folds mean the delta WILL hit that wall.
     mutate_stalled = _gsum("raft.mutate.delta.stalled")
-    mutate_degraded = mutate_stalled > 0
+    compactor_failing = _gsum("raft.mutate.compactor.failing")
+    mutate_degraded = mutate_stalled > 0 or compactor_failing > 0
     body = {
         "status": ("degraded" if (comms_degraded or serve_degraded
                                   or mutate_degraded)
@@ -100,6 +108,7 @@ def _health_body(snapshot: dict) -> dict:
             "tombstone_frac": _gsum("raft.mutate.tombstone.frac"),
             "compact_inflight": _gsum("raft.mutate.compact.inflight"),
             "delta_stalled": mutate_stalled,
+            "compactor_failing": compactor_failing,
         }
     if any(k.startswith("raft.serve.") for k in gauges):
         body["serve"] = {
@@ -109,6 +118,11 @@ def _health_body(snapshot: dict) -> dict:
             "shed_rate_per_s": shed_rate,
             "degrade_level": _gsum("raft.serve.degrade.level"),
         }
+        if failover_engaged:
+            body["serve"]["failover"] = {
+                "engaged": failover_engaged,
+                "coverage": _gsum("raft.serve.failover.coverage"),
+            }
     # distributed serving tier (ISSUE 8): when a mesh-wide server is
     # active (shards gauge set), surface the mesh shape, the merge
     # compression it runs at, and — folding the per-shard comms-health
@@ -116,15 +130,11 @@ def _health_body(snapshot: dict) -> dict:
     # names the shard, not only a suspect count
     dist_shards = _gsum("raft.serve.dist.shards")
     if dist_shards:
-        raw_ranks = {
-            lbl.split("rank=")[1].rstrip("}").split(",")[0]
-            for lbl, v in gauges.items()
-            if lbl.startswith("raft.comms.health.suspect_rank{")
-            and "rank=" in lbl and v > 0}
-        try:
-            suspect_ranks = sorted(int(r) for r in raw_ranks)
-        except ValueError:
-            suspect_ranks = sorted(raw_ranks)
+        # one parser shared with the serving tier's failover exclusion
+        # (lazy import: comms pulls obs, so a module-scope import here
+        # would cycle through obs/__init__)
+        from raft_tpu.comms.health import suspects_from_gauges
+        suspect_ranks = suspects_from_gauges(gauges)
         body.setdefault("serve", {})["dist"] = {
             "shards": dist_shards,
             "merge_ratio": _gsum("raft.serve.dist.merge.ratio"),

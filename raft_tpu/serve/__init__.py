@@ -46,28 +46,35 @@ from raft_tpu.serve.batcher import (OCCUPANCY_BUCKETS,
                                     SERVE_LATENCY_BUCKETS, SearchServer)
 from raft_tpu.serve.controller import LoadController
 from raft_tpu.serve.ladder import PlanLadder
-from raft_tpu.serve.types import (DeadlineExceeded, RejectedError,
-                                  ServeConfig)
+from raft_tpu.serve.types import (DeadlineExceeded, DispatchError,
+                                  RejectedError, SearchResult,
+                                  ServeConfig, ShardFailedError)
 
 __all__ = [
     "DeadlineExceeded",
+    "DispatchError",
     "DistSearchPlan",
     "DistributedSearchServer",
+    "FailoverLadder",
     "LoadController",
     "OCCUPANCY_BUCKETS",
     "PlanLadder",
     "RejectedError",
     "SERVE_LATENCY_BUCKETS",
+    "SearchResult",
     "SearchServer",
     "ServeConfig",
+    "ShardFailedError",
     "build_dist_ladder",
+    "build_failover_ladder",
 ]
 
 # the distributed tier (serve/dist.py, ISSUE 8) pulls in jax through
 # the merge codec; resolve it lazily so importing raft_tpu.serve for
 # the error types (the obs endpoint does) stays dependency-light
 _DIST_NAMES = ("DistSearchPlan", "DistributedSearchServer",
-               "build_dist_ladder")
+               "FailoverLadder", "build_dist_ladder",
+               "build_failover_ladder")
 
 
 def __getattr__(name):

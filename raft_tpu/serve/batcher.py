@@ -23,18 +23,36 @@ Robustness is part of the contract, not an afterthought:
   ``n_probes`` down the configured ladder above the queue-delay
   watermark and back up when drained (p99 bounded at slightly reduced
   recall instead of unbounded latency).
+* **failure handling** (ISSUE 10, docs/robustness.md) — an optional
+  dispatch **watchdog** (``ServeConfig.dispatch_timeout_ms``) abandons
+  a hung dispatch (XLA collectives hang, not error, when a participant
+  dies) and converts it into a typed :class:`ShardFailedError`; a
+  comms-layer ``Status.ABORT``/``ERROR`` returned by a plan is
+  converted the same way. Such failures are **retried** with
+  exponential backoff under a ``max_retries`` budget, deadline-aware:
+  a request whose deadline lands inside the backoff window fails NOW
+  with :class:`DeadlineExceeded` rather than being retried past it.
+  A **crash guard** around batch processing mirrors the compactor's:
+  an unexpected dispatcher exception fails that batch's futures with
+  a typed :class:`DispatchError` (counted under
+  ``raft.serve.dispatcher.errors``) and the dispatcher keeps serving.
 
 Every decision lands in ``raft.serve.*`` metrics and spans
 (docs/serving.md has the taxonomy and a capacity-planning walkthrough).
 
-Threading model: ONE dispatcher thread owns all device work, so the
-underlying jax dispatch is never called concurrently; caller threads
-only touch numpy and futures. Future callbacks run on the dispatcher
-thread — keep them trivial.
+Threading model: ONE dispatcher thread owns all device work; caller
+threads only touch numpy and futures. With the watchdog enabled,
+dispatch runs on a single helper thread the dispatcher waits on — an
+abandoned (timed-out) helper drains its stuck program and exits, and a
+fresh helper takes over, so at most one *live* dispatch exists at any
+time (the overlap with a draining orphan mirrors real abort semantics:
+a hung collective cannot be cancelled, only orphaned). Future
+callbacks run on the dispatcher thread — keep them trivial.
 """
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from collections import deque
@@ -44,11 +62,15 @@ import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import get_logger
 from raft_tpu.obs import spans
 from raft_tpu.serve.controller import LoadController
 from raft_tpu.serve.ladder import PlanLadder
-from raft_tpu.serve.types import (DeadlineExceeded, RejectedError,
-                                  ServeConfig, _Request)
+from raft_tpu.serve.types import (DeadlineExceeded, DispatchError,
+                                  RejectedError, SearchResult,
+                                  ServeConfig, ShardFailedError,
+                                  _Request)
+from raft_tpu.testing import faults
 
 __all__ = ["SearchServer", "SERVE_LATENCY_BUCKETS", "OCCUPANCY_BUCKETS"]
 
@@ -62,6 +84,43 @@ OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
                      0.875, 1.0)
 
 _SHED_RATE_WINDOW_S = 10.0
+
+
+class _DispatchWorker:
+    """The watchdog's helper thread: executes dispatches so the
+    dispatcher can time one out and walk away. A timed-out worker is
+    *abandoned* — it finishes (or hangs forever on) its stuck call,
+    notices the flag, and exits without touching any shared serving
+    state; the server spawns a replacement for the next dispatch."""
+
+    def __init__(self, name: str):
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self.abandoned = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def submit(self, fn) -> dict:
+        box = {"done": threading.Event(), "out": None, "err": None}
+        self._q.put((fn, box))
+        return box
+
+    def stop(self) -> None:
+        self._q.put(None)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, box = item
+            try:
+                box["out"] = fn()
+            except BaseException as e:  # delivered to the dispatcher
+                box["err"] = e
+            box["done"].set()
+            if self.abandoned.is_set():
+                return
 
 
 class SearchServer:
@@ -88,6 +147,9 @@ class SearchServer:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._shed_times: deque = deque()
+        # watchdog helper (dispatcher-thread-only state, like the
+        # LoadController: no lock because there is no sharing)
+        self._worker: Optional[_DispatchWorker] = None
         obs.gauge("raft.serve.queue.max").set(self._cfg.max_queue)
         obs.gauge("raft.serve.queue.depth").set(0)
         obs.gauge("raft.serve.shed.rate").set(0.0)
@@ -145,6 +207,9 @@ class SearchServer:
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
+        if self._worker is not None:
+            self._worker.stop()
+            self._worker = None
         # a never-started server still owes its queue explicit errors
         self._drain_closed()
 
@@ -320,14 +385,86 @@ class SearchServer:
             for r in expired:
                 self._fail_deadline(r, now)
             if batch:
-                self._execute(batch, rows, depth)
+                # dispatcher crash guard (mirrors the compactor's,
+                # ISSUE 10): one broken batch fails ITS futures with a
+                # typed error; the dispatcher thread keeps serving —
+                # previously any exception escaping _execute killed the
+                # thread and hung every future behind it
+                try:
+                    self._execute(batch, rows, depth)
+                except Exception as e:
+                    obs.counter("raft.serve.dispatcher.errors").inc()
+                    get_logger("serve").error(
+                        "dispatcher: batch failed outside the dispatch "
+                        "path (crash guard): %r", e)
+                    err = (e if isinstance(e, DispatchError) else
+                           DispatchError(f"dispatcher error: {e!r}"))
+                    for r in batch:
+                        if not r.future.done():
+                            r.future.set_exception(err)
         self._drain_closed()
 
+    # -- dispatch hooks (overridden by the distributed tier) ---------------
+    def _plan_for_batch(self, rows: int, level: int):
+        """(shape, plan) for a coalesced batch — the failover-aware
+        distributed tier reroutes this to the partial-mesh ladder while
+        shards are excluded."""
+        return self._ladder.plan_for(rows, level)
+
+    def _plan_after_failure(self, shape: int, level: int, err):
+        """A replacement plan for the next attempt after a
+        :class:`ShardFailedError` (the distributed tier returns its
+        pre-warmed partial-mesh plan once suspects are known); None =
+        retry the same plan."""
+        return None
+
+    def _watchdog_call(self, fn, timeout_s: float):
+        if self._worker is None:
+            self._worker = _DispatchWorker("raft-serve-watchdog")
+        box = self._worker.submit(fn)
+        if not box["done"].wait(timeout_s):
+            # a hung XLA dispatch cannot be cancelled — orphan the
+            # helper (it exits once its stuck program drains) and turn
+            # the hang into a typed, retryable failure
+            self._worker.abandoned.set()
+            self._worker = None
+            obs.counter("raft.serve.dispatch.timeouts.total").inc()
+            raise ShardFailedError(
+                f"dispatch exceeded dispatch_timeout_ms="
+                f"{self._cfg.dispatch_timeout_ms:g}")
+        if box["err"] is not None:
+            raise box["err"]
+        return box["out"]
+
+    def _dispatch(self, plan, qb):
+        """One plan execution with the failure conversions applied:
+        a watchdog timeout and a comms ``ABORT``/``ERROR`` status both
+        become :class:`ShardFailedError` — typed and retryable —
+        instead of a silent hang or a bare exception that could kill
+        the dispatcher thread."""
+        def call():
+            faults.inject("serve.execute", shape=plan.nq)
+            return plan.search(qb, block=True)
+
+        timeout_s = self._cfg.dispatch_timeout_ms / 1e3
+        out = (self._watchdog_call(call, timeout_s) if timeout_s > 0
+               else call())
+        if not (isinstance(out, tuple) and len(out) == 2):
+            # a comms-aware plan may surface sync_stream's verdict as a
+            # Status instead of results (duck-typed — no comms import
+            # on the serving path)
+            status = getattr(out, "name", None) or repr(out)
+            raise ShardFailedError(
+                f"dispatch reported comms status {status}",
+                ranks=getattr(out, "ranks", ()))
+        return out
+
     def _execute(self, batch, rows: int, depth: int) -> None:
+        cfg = self._cfg
         t_start = time.perf_counter()
         head_wait = t_start - min(r.t_enq for r in batch)
         level = self._controller.observe(head_wait, depth)
-        shape, plan = self._ladder.plan_for(rows, level)
+        shape, plan = self._plan_for_batch(rows, level)
         qb = (batch[0].queries if len(batch) == 1
               else np.concatenate([r.queries for r in batch], axis=0))
         pad = shape - rows
@@ -341,6 +478,8 @@ class SearchServer:
             qb = np.concatenate([qb, np.tile(qb, (reps, 1))[:pad]],
                                 axis=0)
         err = None
+        dead: set = set()       # ids of requests failed during backoff
+        attempt = 0
         with spans.span("raft.serve.batch", shape=shape, rows=rows,
                         requests=len(batch),
                         occupancy=round(rows / shape, 4),
@@ -349,14 +488,57 @@ class SearchServer:
                 spans.add_child_span("raft.serve.queue_wait", r.t_enq,
                                      t_start - r.t_enq, request=idx,
                                      rows=r.nq)
-            with spans.span("raft.serve.execute", shape=shape,
-                            n_probes=plan.n_probes):
-                try:
-                    d, i = plan.search(qb, block=True)
-                    d, i = np.asarray(d), np.asarray(i)
-                except Exception as e:     # scatter the failure, keep serving
-                    err = e
-                    bsp.set_attr("error", type(e).__name__)
+            while True:
+                with spans.span("raft.serve.execute", shape=shape,
+                                n_probes=plan.n_probes,
+                                attempt=attempt):
+                    try:
+                        d, i = self._dispatch(plan, qb)
+                        d, i = np.asarray(d), np.asarray(i)
+                        err = None
+                    except ShardFailedError as e:   # retryable
+                        err = e
+                    except Exception as e:  # scatter as-is, keep serving
+                        err = e
+                        bsp.set_attr("error", type(e).__name__)
+                        break
+                if err is None:
+                    if attempt:
+                        obs.counter("raft.serve.retry.success.total").inc()
+                    break
+                bsp.set_attr("error", type(err).__name__)
+                # the failover-aware tier may hand back a degraded plan
+                # for the next attempt (pre-warmed — never compiled on
+                # the failure path)
+                nxt = self._plan_after_failure(shape, level, err)
+                if nxt is not None:
+                    plan = nxt
+                if attempt >= cfg.max_retries:
+                    obs.counter("raft.serve.retry.exhausted.total").inc()
+                    break
+                attempt += 1
+                backoff = (cfg.retry_backoff_ms / 1e3
+                           * cfg.retry_backoff_mult ** (attempt - 1))
+                # deadline-aware: a request whose deadline lands inside
+                # the backoff window fails NOW with DeadlineExceeded —
+                # a retry must never resolve after the caller stopped
+                # waiting
+                now = time.perf_counter()
+                for r in batch:
+                    if (id(r) not in dead and r.deadline is not None
+                            and r.deadline <= now + backoff):
+                        dead.add(id(r))
+                        self._fail_deadline(r, now)
+                if len(dead) == len(batch):
+                    break       # nobody left waiting for the retry
+                obs.counter("raft.serve.retry.total").inc()
+                with spans.span("raft.serve.retry", attempt=attempt,
+                                backoff_ms=round(backoff * 1e3, 3),
+                                error=type(err).__name__):
+                    if backoff > 0:
+                        time.sleep(backoff)
+            if attempt:
+                bsp.set_attr("retries", attempt)
         t_done = time.perf_counter()
         exec_dur = t_done - t_start
         obs.counter("raft.serve.batch.total", level=level).inc()
@@ -366,8 +548,13 @@ class SearchServer:
                       buckets=obs.SIZE_BUCKETS).observe(rows)
         obs.histogram("raft.serve.batch.occupancy",
                       buckets=OCCUPANCY_BUCKETS).observe(rows / shape)
+        partial = bool(getattr(plan, "partial", False))
+        coverage = float(getattr(plan, "coverage", 1.0))
         off = 0
         for r in batch:
+            if id(r) in dead:   # already failed with DeadlineExceeded
+                off += r.nq
+                continue
             wait_s = t_start - r.t_enq
             obs.histogram("raft.serve.queue.delay.seconds",
                           buckets=SERVE_LATENCY_BUCKETS).observe(wait_s)
@@ -382,16 +569,20 @@ class SearchServer:
             obs.histogram("raft.serve.request.seconds",
                           buckets=SERVE_LATENCY_BUCKETS).observe(lat)
             obs.counter("raft.serve.completed.total").inc()
+            if partial:
+                obs.counter("raft.serve.failover.partial.total").inc()
             # per-request root trace: queue-wait + (shared) execution
             # children under one raft.serve.request root — the flight
             # recorder shows each caller's story, batch sharing included
             with spans.span("raft.serve.request", nq=r.nq, k=r.k,
-                            outcome="ok", level=level,
-                            batch_shape=shape,
+                            outcome="partial" if partial else "ok",
+                            level=level, batch_shape=shape,
                             latency_ms=round(lat * 1e3, 3)):
                 spans.add_child_span("raft.serve.queue_wait", r.t_enq,
                                      wait_s)
                 spans.add_child_span("raft.serve.execute", t_start,
                                      exec_dur, shape=shape,
                                      shared=len(batch) > 1)
-            r.future.set_result((d_r, i_r))
+            r.future.set_result(
+                SearchResult(d_r, i_r, partial=True, coverage=coverage)
+                if partial else (d_r, i_r))

@@ -31,22 +31,27 @@ under the batcher's ``raft.serve.batch`` root, and a ``/healthz``
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+import time
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import get_logger
 from raft_tpu.obs import spans
 from raft_tpu.serve.batcher import SearchServer
 from raft_tpu.serve.ladder import PlanLadder
 from raft_tpu.serve.merge import merge_mode, merge_wire_bytes
-from raft_tpu.serve.types import ServeConfig
+from raft_tpu.serve.types import ServeConfig, ShardFailedError
+from raft_tpu.testing import faults
 
 __all__ = [
     "DistSearchPlan",
     "DistributedSearchServer",
+    "FailoverLadder",
     "build_dist_ladder",
+    "build_failover_ladder",
 ]
 
 
@@ -71,7 +76,8 @@ class DistSearchPlan:
     dispatch over the whole mesh, merge wire format pinned at build."""
 
     def __init__(self, family: str, index, mesh, axis: str, nq: int,
-                 k: int, params, merge: str, comms, level: int = 0):
+                 k: int, params, merge: str, comms, level: int = 0,
+                 sync_timeout_s: Optional[float] = None):
         self.family = family
         self.nq = int(nq)
         self.dim = int(index.dim)
@@ -83,9 +89,17 @@ class DistSearchPlan:
         self.axis = axis
         self.level = int(level)
         self.n_shards = int(mesh.shape[axis])
+        # the participants this plan needs alive — the chaos harness's
+        # stall_shard matches against this set, and ShardFailedError
+        # reports suspects out of it
+        self.ranks = tuple(range(self.n_shards))
         self._index = index
         self._params = params
         self._comms = comms
+        # when set, block=True waits through comms.sync_stream so a
+        # device-side non-completion surfaces as a typed ABORT instead
+        # of an indefinite block_until_ready hang (ISSUE 10)
+        self._sync_timeout_s = sync_timeout_s
         # analytic per-dispatch wire accounting (serve/merge.py): the
         # trace-time collective counters fire once per program, these
         # fire per batch
@@ -114,6 +128,10 @@ class DistSearchPlan:
         expects(q.shape == (self.nq, self.dim),
                 "dist plan.search: queries %s != plan shape (%d, %d)",
                 q.shape, self.nq, self.dim)
+        # chaos-harness site: a stall/drop rule matching any of this
+        # plan's participating ranks fires here (no-op in production)
+        faults.inject("serve.dist.dispatch", ranks=self.ranks,
+                      family=self.family)
         obs.counter("raft.serve.dist.batches", level=self.level).inc()
         obs.counter("raft.serve.dist.queries").inc(self.nq)
         obs.counter("raft.serve.dist.merge.bytes_pre",
@@ -139,8 +157,21 @@ class DistSearchPlan:
                     mesh=self.mesh, axis=self.axis, comms=self._comms,
                     merge=self.merge)
         if block:
-            import jax
-            jax.block_until_ready((d, i))
+            if self._sync_timeout_s:
+                # comms-layer completion wait with failure semantics:
+                # a lost participant makes the collective never
+                # complete — sync_stream converts that into a typed
+                # ABORT the dispatcher fails the batch with, instead of
+                # an indefinite hang (reference waitall-with-timeout)
+                st = self._comms.sync_stream(
+                    d, i, timeout_s=self._sync_timeout_s)
+                if getattr(st, "name", "SUCCESS") != "SUCCESS":
+                    raise ShardFailedError(
+                        f"cross-shard dispatch reported "
+                        f"{getattr(st, 'name', st)}", ranks=self.ranks)
+            else:
+                import jax
+                jax.block_until_ready((d, i))
         return d, i
 
 
@@ -149,7 +180,9 @@ def build_dist_ladder(index, rep_queries, k: int, params=None,
                       shapes: Tuple[int, ...] = (1, 8, 32, 128),
                       probes_ladder: Tuple[int, ...] = (),
                       prewarm: bool = True,
-                      merge: Optional[str] = None) -> PlanLadder:
+                      merge: Optional[str] = None,
+                      sync_timeout_s: Optional[float] = None
+                      ) -> PlanLadder:
     """Pre-warm the (shape × rung) grid of distributed plans over a
     list-sharded index → a :class:`PlanLadder` the micro-batcher serves
     from. With ``prewarm`` every grid point executes once at build, so
@@ -176,13 +209,194 @@ def build_dist_ladder(index, rep_queries, k: int, params=None,
         p_r = dataclasses.replace(params, n_probes=n_probes)
         for s in shapes:
             plan = DistSearchPlan(family, index, mesh, axis, s, k, p_r,
-                                  merge, comms, level=ri)
+                                  merge, comms, level=ri,
+                                  sync_timeout_s=sync_timeout_s)
             if prewarm:
+                # warm OUTSIDE the sync-timeout path: the first
+                # dispatch pays the shard_map compile, which must not
+                # read as a failed collective
+                import jax
                 reps = -(-s // q.shape[0])
-                plan.search(np.tile(q, (reps, 1))[:s], block=True)
+                jax.block_until_ready(plan.search(
+                    np.tile(q, (reps, 1))[:s], block=False))
             plans[(s, ri)] = plan
     return PlanLadder(shapes=tuple(shapes), rungs=rungs, plans=plans,
                       dim=index.dim, k=k)
+
+
+# ---------------------------------------------------------------------------
+# partial-mesh failover (ISSUE 10): degraded serving over healthy shards
+# ---------------------------------------------------------------------------
+
+
+def _shard_local_view(index, rank: int, nl_local: int, family: str,
+                      device):
+    """Shard ``rank``'s slice of a list-sharded index as a standalone
+    single-device index on ``device`` — its own coarse centers and
+    lists, global ids intact. This is what a healthy host still holds
+    when a peer dies: its lists, searchable without any collective."""
+    import jax
+    sl = slice(rank * nl_local, (rank + 1) * nl_local)
+
+    def put(a):
+        return jax.device_put(np.asarray(a)[sl], device)
+
+    if family == "ivf_flat":
+        from raft_tpu.neighbors.ivf_flat import Index
+        return Index(
+            centers=put(index.centers), lists_data=put(index.lists_data),
+            lists_indices=put(index.lists_indices),
+            lists_norms=put(index.lists_norms),
+            list_sizes=put(index.list_sizes), metric=index.metric,
+            size=index.size, scale=index.scale)
+    from raft_tpu.neighbors.ivf_pq import CodebookGen, Index
+    per_cluster = index.codebook_kind == CodebookGen.PER_CLUSTER
+    return Index(
+        centers=put(index.centers), centers_rot=put(index.centers_rot),
+        rotation_matrix=jax.device_put(
+            np.asarray(index.rotation_matrix), device),
+        pq_centers=(put(index.pq_centers) if per_cluster else
+                    jax.device_put(np.asarray(index.pq_centers), device)),
+        codes=put(index.codes), lists_indices=put(index.lists_indices),
+        list_sizes=put(index.list_sizes), metric=index.metric,
+        pq_bits=index.pq_bits, size=index.size,
+        codebook_kind=index.codebook_kind,
+        code_norms=(put(index.code_norms)
+                    if index.code_norms is not None else None),
+        decoded=(put(index.decoded)
+                 if index.decoded is not None else None),
+        decoded_norms=(put(index.decoded_norms)
+                       if index.decoded_norms is not None else None))
+
+
+class _PartialMeshPlan:
+    """Plan-like handle serving one batch over the HEALTHY shard
+    subset: each healthy shard's pre-warmed single-device
+    :class:`~raft_tpu.neighbors.plan.SearchPlan` scans its own lists
+    (no collective — a dead participant cannot hang what it is not part
+    of), and the per-shard top-k merge happens host-side on the (nq, k)
+    blocks. Results are explicitly partial: ``coverage`` is the row
+    fraction of the corpus the surviving shards hold."""
+
+    partial = True
+
+    def __init__(self, ladder: "FailoverLadder", nq: int,
+                 excluded: Tuple[int, ...]):
+        self._ladder = ladder
+        self.nq = int(nq)
+        self.excluded = tuple(excluded)
+        self.ranks = tuple(r for r in range(ladder.n_shards)
+                           if r not in self.excluded)
+        self.n_probes = ladder.n_probes
+        self.coverage = ladder.coverage(self.excluded)
+        self.k = ladder.k
+
+    def search(self, queries, block: bool = False):
+        lad = self._ladder
+        faults.inject("serve.dist.dispatch", ranks=self.ranks,
+                      family="failover")
+        expects(self.ranks, "partial-mesh plan: every shard excluded")
+        obs.counter("raft.serve.failover.batches.total").inc()
+        with spans.span("raft.serve.dist.dispatch", mode="partial",
+                        nq=self.nq, k=self.k,
+                        healthy=len(self.ranks),
+                        excluded=len(self.excluded),
+                        coverage=round(self.coverage, 4)):
+            # enqueue every healthy shard's dispatch before syncing any
+            # (they run concurrently on their own devices)
+            outs = [lad.plan(r, self.nq).search(queries, block=False)
+                    for r in self.ranks]
+            d = np.concatenate([np.asarray(o[0]) for o in outs], axis=1)
+            i = np.concatenate([np.asarray(o[1]) for o in outs], axis=1)
+            sel = np.argsort(-d if lad.descending else d, axis=1,
+                             kind="stable")[:, :self.k]
+            return (np.take_along_axis(d, sel, axis=1),
+                    np.take_along_axis(i, sel, axis=1))
+
+
+class FailoverLadder:
+    """The pre-warmed degraded tier: per (rank, shape) single-device
+    plans over each shard's local lists, built and warmed at SERVER
+    construction so engaging failover never compiles (the zero-compile
+    contract holds through the failure path — asserted from
+    ``raft.plan.cache.*`` in tests/test_faults.py). One grid serves ANY
+    suspect subset: exclusion is a host-side choice of which per-shard
+    plans to run, not program structure."""
+
+    def __init__(self, shapes: Tuple[int, ...],
+                 plans: Dict[Tuple[int, int], object],
+                 weights: Dict[int, float], n_shards: int, k: int,
+                 n_probes: int, descending: bool):
+        self.shapes = tuple(shapes)
+        self._plans = dict(plans)
+        self._weights = dict(weights)
+        self.n_shards = int(n_shards)
+        self.k = int(k)
+        self.n_probes = int(n_probes)
+        self.descending = bool(descending)
+
+    def plan(self, rank: int, shape: int):
+        return self._plans[(rank, shape)]
+
+    def coverage(self, excluded: Tuple[int, ...]) -> float:
+        return max(0.0, 1.0 - sum(self._weights.get(r, 0.0)
+                                  for r in set(excluded)))
+
+    def bind(self, rows: int, excluded: Tuple[int, ...]
+             ) -> Tuple[int, _PartialMeshPlan]:
+        """Smallest shape fitting ``rows`` → (shape, partial plan over
+        the non-excluded shards) — the PlanLadder.plan_for contract."""
+        expects(0 < rows <= self.shapes[-1],
+                "FailoverLadder: %d rows exceed the largest shape %d",
+                rows, self.shapes[-1])
+        for s in self.shapes:
+            if rows <= s:
+                return s, _PartialMeshPlan(self, s, excluded)
+        raise AssertionError("unreachable")
+
+
+def build_failover_ladder(index, rep_queries, k: int, params=None,
+                          mesh=None, axis: str = "data",
+                          shapes: Tuple[int, ...] = (1, 8, 32, 128),
+                          prewarm: bool = True) -> FailoverLadder:
+    """Build + pre-warm the partial-mesh failover grid for a
+    list-sharded index: one single-device
+    :class:`~raft_tpu.neighbors.plan.SearchPlan` per (shard, shape),
+    each over that shard's local lists on that shard's device. A single
+    quality rung (the full ``n_probes`` clamped to the local list
+    count) — degraded mode IS the quality reduction; the n_probes
+    ladder stays a full-mesh concern."""
+    from raft_tpu.distance.distance_types import DistanceType
+    from raft_tpu.neighbors import plan as plan_mod
+    expects(mesh is not None, "build_failover_ladder: mesh is required")
+    family = _resolve_family(index)
+    if params is None:
+        params = plan_mod._default_params(family)
+    n_shards = int(mesh.shape[axis])
+    nl_local = index.n_lists // n_shards
+    q = np.asarray(rep_queries, np.float32)
+    sizes = np.asarray(index.list_sizes).reshape(-1).astype(np.float64)
+    total = max(1.0, float(sizes.sum()))
+    weights = {r: float(sizes[r * nl_local:(r + 1) * nl_local].sum())
+               / total for r in range(n_shards)}
+    devices = list(np.asarray(mesh.devices).reshape(-1))
+    p_local = dataclasses.replace(
+        params, n_probes=min(params.n_probes, nl_local))
+    plans: Dict[Tuple[int, int], object] = {}
+    for r in range(n_shards):
+        sub = _shard_local_view(index, r, nl_local, family,
+                                devices[r % len(devices)])
+        for s in shapes:
+            reps = -(-s // q.shape[0])
+            plans[(r, s)] = plan_mod.build_plan(
+                sub, np.tile(q, (reps, 1))[:s], k, p_local,
+                warm=prewarm)
+    descending = index.metric in (DistanceType.InnerProduct,
+                                  DistanceType.CosineExpanded)
+    return FailoverLadder(shapes=tuple(shapes), plans=plans,
+                          weights=weights, n_shards=n_shards, k=k,
+                          n_probes=p_local.n_probes,
+                          descending=descending)
 
 
 class DistributedSearchServer(SearchServer):
@@ -195,12 +409,15 @@ class DistributedSearchServer(SearchServer):
     # same dispatcher/caller thread boundary as the base server (GL003
     # static race contract — redeclared because the rule is per-class):
     # this subclass adds NO cross-thread state; add a field another
-    # thread writes and it belongs in this tuple AND under self._cond
+    # thread writes and it belongs in this tuple AND under self._cond.
+    # The failover fields (_failover/_excluded/_next_probe) are
+    # dispatcher-thread-only, like the LoadController's.
     GUARDED_BY = ("_q", "_rows_queued", "_closed", "_shed_times")
 
     def __init__(self, ladder: PlanLadder,
                  config: Optional[ServeConfig] = None,
-                 start: bool = True):
+                 start: bool = True,
+                 failover_ladder: Optional[FailoverLadder] = None):
         p0 = ladder.plan_for(ladder.shapes[0], 0)[1]
         expects(isinstance(p0, DistSearchPlan)
                 or getattr(p0, "dist_like", False),
@@ -214,7 +431,77 @@ class DistributedSearchServer(SearchServer):
         obs.gauge("raft.serve.dist.shards").set(p0.n_shards)
         obs.gauge("raft.serve.dist.merge.ratio").set(
             round(p_top.merge_ratio, 4))
+        self._failover = failover_ladder
+        self._excluded: Tuple[int, ...] = ()
+        self._next_probe = 0.0
+        obs.gauge("raft.serve.failover.engaged").set(0)
         super().__init__(ladder, config, start=start)
+
+    # -- partial-mesh failover (ISSUE 10) ----------------------------------
+    @property
+    def excluded_ranks(self) -> Tuple[int, ...]:
+        """Currently excluded (suspect) shard ranks — dispatcher-thread
+        state; read-only snapshot for tests and status surfaces."""
+        return self._excluded
+
+    def _suspects(self) -> Tuple[int, ...]:
+        from raft_tpu.comms.health import suspects_from_gauges
+        return tuple(suspects_from_gauges(
+            obs.snapshot().get("gauges", {})))
+
+    def _engage_failover(self, suspects: Tuple[int, ...]) -> None:
+        fresh = tuple(sorted(set(suspects)))
+        if fresh == self._excluded:
+            return
+        first = not self._excluded
+        self._excluded = fresh
+        cov = self._failover.coverage(fresh)
+        if first:
+            obs.counter("raft.serve.failover.total").inc()
+        obs.gauge("raft.serve.failover.engaged").set(1)
+        obs.gauge("raft.serve.failover.coverage").set(round(cov, 4))
+        self._next_probe = (time.monotonic()
+                            + self._cfg.failover_probe_ms / 1e3)
+        get_logger("serve").warn(
+            "failover engaged: serving partial results over healthy "
+            "shards (excluded ranks %s, coverage %.4f)", fresh, cov)
+
+    def _maybe_recover(self) -> None:
+        """While excluded, periodically re-read the suspect gauges; a
+        clean bill of health clears the exclusion and the next batch
+        rides the (still-warm) full-mesh ladder — recovery never
+        compiles either."""
+        now = time.monotonic()
+        if now < self._next_probe:
+            return
+        self._next_probe = now + self._cfg.failover_probe_ms / 1e3
+        suspects = self._suspects()
+        if suspects:
+            self._engage_failover(suspects)
+            return
+        self._excluded = ()
+        obs.gauge("raft.serve.failover.engaged").set(0)
+        obs.gauge("raft.serve.failover.coverage").set(1.0)
+        obs.counter("raft.serve.failover.recovered.total").inc()
+        get_logger("serve").warn(
+            "failover recovered: suspect ranks cleared, back to the "
+            "full mesh")
+
+    def _plan_for_batch(self, rows: int, level: int):
+        if self._excluded and self._failover is not None:
+            self._maybe_recover()
+            if self._excluded:
+                return self._failover.bind(rows, self._excluded)
+        return super()._plan_for_batch(rows, level)
+
+    def _plan_after_failure(self, shape: int, level: int, err):
+        if self._failover is None:
+            return None
+        suspects = self._suspects()
+        if not suspects:
+            return None     # nothing to exclude — retry the full mesh
+        self._engage_failover(suspects)
+        return self._failover.bind(shape, self._excluded)[1]
 
     @property
     def mesh(self):
@@ -229,14 +516,25 @@ class DistributedSearchServer(SearchServer):
                            ) -> "DistributedSearchServer":
         """Build + pre-warm the distributed plan ladder for a
         list-sharded ``index`` (``shard_ivf_*`` / ``sharded_*_build``
-        layout) and start serving the mesh."""
+        layout) and start serving the mesh. With ``config.failover``
+        the partial-mesh ladder is pre-warmed too, so a suspect shard
+        degrades the server to flagged-partial results instead of
+        errors — with zero failure-path compiles."""
         config = config if config is not None else ServeConfig()
+        sync_timeout_s = (config.dispatch_timeout_ms / 1e3
+                          if config.dispatch_timeout_ms > 0 else None)
         ladder = build_dist_ladder(
             index, rep_queries, k, params, mesh=mesh, axis=axis,
             shapes=config.batch_sizes,
             probes_ladder=config.probes_ladder,
-            prewarm=config.prewarm, merge=merge)
-        return cls(ladder, config, start=start)
+            prewarm=config.prewarm, merge=merge,
+            sync_timeout_s=sync_timeout_s)
+        fol = None
+        if config.failover:
+            fol = build_failover_ladder(
+                index, rep_queries, k, params, mesh=mesh, axis=axis,
+                shapes=config.batch_sizes, prewarm=config.prewarm)
+        return cls(ladder, config, start=start, failover_ladder=fol)
 
     @classmethod
     def from_mutable(cls, mindex, rep_queries, mesh=None,
@@ -255,6 +553,11 @@ class DistributedSearchServer(SearchServer):
         (docs/mutability.md)."""
         from raft_tpu.mutate import build_dist_serve_ladder
         config = config if config is not None else ServeConfig()
+        expects(not config.failover,
+                "from_mutable: partial-mesh failover is not supported "
+                "over a MutableIndex yet (the delta/tombstone tail "
+                "would need per-shard recomposition) — serve with "
+                "failover=False")
         ladder = build_dist_serve_ladder(
             mindex, rep_queries, mesh=mesh, axis=axis,
             shapes=config.batch_sizes,

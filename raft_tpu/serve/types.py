@@ -14,8 +14,11 @@ from typing import Optional, Tuple
 
 __all__ = [
     "DeadlineExceeded",
+    "DispatchError",
     "RejectedError",
+    "SearchResult",
     "ServeConfig",
+    "ShardFailedError",
 ]
 
 
@@ -26,8 +29,55 @@ class RejectedError(RuntimeError):
 
 
 class DeadlineExceeded(TimeoutError):
-    """The request's deadline expired while it waited in the queue; it
-    was dropped without occupying a batch slot."""
+    """The request's deadline expired — while waiting in the queue (it
+    was dropped without occupying a batch slot) or while the retry
+    budget was backing off after a failed dispatch (retries never
+    extend past the deadline)."""
+
+
+class DispatchError(RuntimeError):
+    """The dispatcher could not complete a batch for an infrastructure
+    reason — the typed failure every affected future resolves with.
+    The dispatcher thread itself survives (crash guard): one broken
+    batch never takes the server down."""
+
+
+class ShardFailedError(DispatchError):
+    """A dispatch failed in a way that implicates a participant: the
+    watchdog timed it out (``dispatch_timeout_ms``), the comms layer
+    reported ``Status.ABORT``/``ERROR``, or the mesh tier saw a suspect
+    shard. Retryable (subject to the ``max_retries`` budget and the
+    request deadline); on the distributed tier it also triggers the
+    partial-mesh failover. ``ranks`` names the suspect participants
+    when known (empty tuple otherwise)."""
+
+    def __init__(self, message: str, ranks=()):
+        super().__init__(message)
+        self.ranks = tuple(ranks)
+
+
+class SearchResult(tuple):
+    """``(dists, ids)`` plus failure-handling metadata.
+
+    A 2-tuple subclass, so ``d, i = result`` keeps working for every
+    existing caller; degraded partial-mesh responses arrive flagged
+    with ``partial=True`` and ``coverage`` = the fraction of the corpus
+    (by row count) the healthy shards could search."""
+
+    def __new__(cls, dists, ids, partial: bool = False,
+                coverage: float = 1.0):
+        self = super().__new__(cls, (dists, ids))
+        self.partial = bool(partial)
+        self.coverage = float(coverage)
+        return self
+
+    @property
+    def dists(self):
+        return self[0]
+
+    @property
+    def ids(self):
+        return self[1]
 
 
 @dataclass(frozen=True)
@@ -62,6 +112,28 @@ class ServeConfig:
     * ``prewarm`` — compile + run every (shape × rung) plan at server
       construction; with it off, rungs compile on first use (a compile
       stall exactly when the server is overloaded — leave it on).
+
+    Failure handling (ISSUE 10 — docs/robustness.md):
+
+    * ``dispatch_timeout_ms`` — the dispatcher watchdog: a dispatch
+      exceeding this is abandoned (XLA collectives hang rather than
+      error when a participant dies) and converted into a typed
+      :class:`ShardFailedError`. ``0`` disables the watchdog (dispatch
+      runs inline on the dispatcher thread).
+    * ``max_retries`` — per-batch retry budget for
+      :class:`ShardFailedError`-class failures; retries back off
+      exponentially (``retry_backoff_ms`` × ``retry_backoff_mult`` ^
+      attempt) and are deadline-aware: a request whose deadline lands
+      inside the backoff window fails NOW with
+      :class:`DeadlineExceeded` instead of being retried past it.
+    * ``failover`` — distributed tier only: pre-warm the partial-mesh
+      failover ladder at construction so a suspect shard flips the
+      server into degraded mode (explicitly-flagged ``partial=True``
+      results over the healthy subset) instead of erroring, with zero
+      failure-path compiles.
+    * ``failover_probe_ms`` — while failover is engaged, how often the
+      dispatcher re-reads the suspect-rank gauges to decide whether
+      the exclusion can be cleared (recovery back to the full mesh).
     """
 
     batch_sizes: Tuple[int, ...] = (1, 8, 32, 128)
@@ -74,6 +146,12 @@ class ServeConfig:
     upgrade_watermark_ms: float = 20.0
     degrade_cooldown_ms: float = 50.0
     prewarm: bool = True
+    dispatch_timeout_ms: float = 0.0
+    max_retries: int = 0
+    retry_backoff_ms: float = 10.0
+    retry_backoff_mult: float = 2.0
+    failover: bool = False
+    failover_probe_ms: float = 1000.0
 
     def __post_init__(self):
         if not self.batch_sizes or list(self.batch_sizes) != sorted(
@@ -91,6 +169,12 @@ class ServeConfig:
         if not 0.0 < self.degrade_trigger_frac <= 1.0:
             raise ValueError("ServeConfig.degrade_trigger_frac must be "
                              "in (0, 1]")
+        if self.dispatch_timeout_ms < 0 or self.max_retries < 0:
+            raise ValueError("ServeConfig: dispatch_timeout_ms and "
+                             "max_retries must be >= 0")
+        if self.retry_backoff_ms < 0 or self.retry_backoff_mult < 1.0:
+            raise ValueError("ServeConfig: retry_backoff_ms must be >= 0 "
+                             "and retry_backoff_mult >= 1.0")
 
 
 @dataclass

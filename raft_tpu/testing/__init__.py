@@ -1,0 +1,17 @@
+"""raft_tpu.testing — chaos/fault-injection support (ISSUE 10).
+
+Deliberately tiny and dependency-light: :mod:`raft_tpu.testing.faults`
+is imported by serving/mutation hot paths for its injection points, so
+nothing here may pull in jax or any device runtime.
+"""
+
+from raft_tpu.testing.faults import (FaultError, FaultRule, inject,
+                                     inject_fault, reset)
+
+__all__ = [
+    "FaultError",
+    "FaultRule",
+    "inject",
+    "inject_fault",
+    "reset",
+]

@@ -1,0 +1,731 @@
+"""Fault injection + failure handling tests (ISSUE 10).
+
+The chaos acceptance, layer by layer:
+
+* the harness itself — deterministic, scoped, fault-free when
+  inactive;
+* the batcher's failure path — watchdog timeout → typed
+  ``ShardFailedError``, retry with backoff under the ``max_retries``
+  budget, deadline-aware ordering (a retry never resolves after the
+  caller's deadline), comms ``ABORT`` statuses converted to typed
+  batch failures, and the dispatcher crash guard (one broken batch
+  never kills the thread);
+* the distributed tier — one shard stalled mid-load degrades the
+  server to explicitly-flagged partial results over the pre-warmed
+  healthy-subset ladder (ZERO compiles on the failure path, asserted
+  from the plan-cache counters), ``/healthz`` says degraded, and
+  recovery clears the exclusion;
+* the mutation side — the compactor crash-loop guard (counted errors,
+  backoff, ``/healthz`` degradation after N consecutive failures,
+  recovery), the WAL's crash-recovery parity (100% of acked mutations
+  replayed), and the concurrent-writer ``DeltaFullError`` race against
+  a stalled compactor.
+"""
+
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve
+from raft_tpu.mutate.wal import MutationWAL
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.random import make_blobs
+from raft_tpu.serve import (DeadlineExceeded, DispatchError, PlanLadder,
+                            SearchServer, ServeConfig, ShardFailedError)
+from raft_tpu.testing import faults
+
+
+def _csum(snap, name):
+    return sum(v for k, v in snap["counters"].items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _cdiff(before, after, name):
+    return _csum(after, name) - _csum(before, name)
+
+
+def _gauge(name):
+    return obs.snapshot()["gauges"].get(name, 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# the harness
+# ---------------------------------------------------------------------------
+
+
+class TestHarness:
+    def test_inactive_is_noop(self):
+        assert not faults.active()
+        faults.inject("serve.execute", shape=8)   # nothing registered
+
+    def test_error_delay_scope_and_reset(self):
+        with faults.inject_fault("site.a", action="error") as rule:
+            assert faults.active()
+            with pytest.raises(faults.FaultError):
+                faults.inject("site.a")
+            assert rule.hits == 1
+            faults.inject("site.b")    # other sites untouched
+        assert not faults.active()
+        faults.inject("site.a")        # scope ended: no-op again
+        t0 = time.perf_counter()
+        with faults.inject_fault("site.d", action="delay", seconds=0.05):
+            faults.inject("site.d")
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_label_matching_scalar_and_containment(self):
+        with faults.inject_fault("s", match={"ranks": 3}) as rule:
+            faults.inject("s", ranks=(0, 1, 2))     # 3 not in set
+            with pytest.raises(faults.FaultError):
+                faults.inject("s", ranks=(2, 3))
+            faults.inject("s")                      # label missing
+            assert rule.hits == 1
+
+    def test_max_hits_and_seeded_probability(self):
+        with faults.inject_fault("s", max_hits=2) as rule:
+            for _ in range(2):
+                with pytest.raises(faults.FaultError):
+                    faults.inject("s")
+            faults.inject("s")          # budget spent
+            assert rule.hits == 2
+        # probability draws from the rule-local seeded RNG: two runs
+        # with the same seed fire on exactly the same call indices
+        def fires(seed):
+            out = []
+            with faults.inject_fault("p", probability=0.5, seed=seed):
+                for i in range(32):
+                    try:
+                        faults.inject("p")
+                        out.append(False)
+                    except faults.FaultError:
+                        out.append(True)
+            return out
+
+        assert fires(7) == fires(7)
+        assert any(fires(7)) and not all(fires(7))
+
+    def test_stall_shard_raises_and_clears_suspect_gauge(self):
+        with faults.stall_shard(5, seconds=0.01, session="chaos"):
+            # gauge raised on first HIT, not on entry
+            assert _gauge("raft.comms.health.suspect_rank"
+                          "{rank=5,session=chaos}") == 0
+            faults.inject("serve.dist.dispatch", ranks=(4, 5))
+            assert _gauge("raft.comms.health.suspect_rank"
+                          "{rank=5,session=chaos}") == 1
+        assert _gauge("raft.comms.health.suspect_rank"
+                      "{rank=5,session=chaos}") == 0
+
+
+# ---------------------------------------------------------------------------
+# batcher failure path (fake plans — no device work)
+# ---------------------------------------------------------------------------
+
+
+class _FlakyPlan:
+    """Fails the first ``fail_n`` dispatches (with ``exc`` or by
+    returning an ABORT-shaped status), then serves normally."""
+
+    def __init__(self, nq, fail_n=0, exc=None, status=None, delay=0.0,
+                 k=4):
+        self.nq = nq
+        self.n_probes = 8
+        self.k = k
+        self.fail_n = fail_n
+        self.exc = exc
+        self.status = status
+        self.delay = delay
+        self.calls = 0
+
+    def search(self, q, block=True):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        if self.calls <= self.fail_n:
+            if self.status is not None:
+                return self.status
+            raise self.exc
+        marker = np.asarray(q)[:, :1]
+        return (np.repeat(marker.astype(np.float32), self.k, axis=1),
+                np.repeat(marker.astype(np.int64), self.k, axis=1))
+
+
+def _ladder_of(plan_factory, shapes=(1, 4), dim=4, k=4):
+    plans = {(s, 0): plan_factory(s) for s in shapes}
+    return PlanLadder(shapes=shapes, rungs=(8,), plans=plans, dim=dim,
+                      k=k)
+
+
+def _rows(n, dim=4, base=0):
+    out = np.zeros((n, dim), np.float32)
+    out[:, 0] = np.arange(base, base + n, dtype=np.float32)
+    return out
+
+
+class TestWatchdogAndRetry:
+    def test_watchdog_times_out_hung_dispatch(self):
+        ladder = _ladder_of(lambda s: _FlakyPlan(s, delay=5.0))
+        cfg = ServeConfig(batch_sizes=(1, 4), max_wait_ms=0.0,
+                          dispatch_timeout_ms=60.0, max_retries=0)
+        srv = SearchServer(ladder, cfg)
+        before = obs.snapshot()
+        try:
+            with pytest.raises(ShardFailedError):
+                srv.search(_rows(1), timeout=30)
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.serve.dispatch.timeouts.total") == 1
+            assert _cdiff(before, after,
+                          "raft.serve.retry.exhausted.total") == 1
+        finally:
+            srv.close()
+
+    def test_retry_succeeds_within_budget(self):
+        made = []
+
+        def factory(s):
+            p = _FlakyPlan(s, fail_n=2,
+                           exc=ShardFailedError("injected"))
+            made.append(p)
+            return p
+
+        ladder = _ladder_of(factory)
+        cfg = ServeConfig(batch_sizes=(1, 4), max_wait_ms=0.0,
+                          max_retries=2, retry_backoff_ms=5.0)
+        srv = SearchServer(ladder, cfg)
+        before = obs.snapshot()
+        try:
+            d, i = srv.search(_rows(1, base=42), timeout=30)
+            assert i[0, 0] == 42
+            after = obs.snapshot()
+            assert _cdiff(before, after, "raft.serve.retry.total") == 2
+            assert _cdiff(before, after,
+                          "raft.serve.retry.success.total") == 1
+            assert _cdiff(before, after,
+                          "raft.serve.retry.exhausted.total") == 0
+            assert _cdiff(before, after,
+                          "raft.serve.completed.total") == 1
+        finally:
+            srv.close()
+
+    def test_retry_then_deadline_ordering(self):
+        """Satellite: mixed retry-then-deadline — a request whose
+        deadline lands inside the backoff window fails with
+        DeadlineExceeded (not ShardFailedError) BEFORE the retry
+        sleeps; a deadline-less request in the same batch rides the
+        full retry budget and gets the typed dispatch error."""
+        ladder = _ladder_of(
+            lambda s: _FlakyPlan(s, fail_n=99,
+                                 exc=ShardFailedError("injected")))
+        cfg = ServeConfig(batch_sizes=(1, 4), max_wait_ms=5.0,
+                          max_retries=3, retry_backoff_ms=60.0,
+                          retry_backoff_mult=1.0)
+        srv = SearchServer(ladder, cfg, start=False)
+        before = obs.snapshot()
+        try:
+            t0 = time.perf_counter()
+            f_dead = srv.submit(_rows(1, base=1), deadline_ms=80.0)
+            f_live = srv.submit(_rows(1, base=2))
+            srv.start()
+            with pytest.raises(DeadlineExceeded):
+                f_dead.result(timeout=30)
+            t_dead = time.perf_counter() - t0
+            with pytest.raises(ShardFailedError):
+                f_live.result(timeout=30)
+            # the deadline resolution never waited for the retry
+            # budget to drain (3 retries x 60 ms + attempts)
+            assert t_dead < 0.18, f"deadline resolved late: {t_dead}"
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.serve.deadline.total") == 1
+            assert _cdiff(before, after,
+                          "raft.serve.retry.exhausted.total") == 1
+        finally:
+            srv.close()
+
+    def test_abort_status_is_typed_batch_failure(self):
+        """Satellite: a comms sync_stream ABORT surfaced by a plan is
+        converted to ShardFailedError (futures fail typed), and the
+        dispatcher survives to serve the next request."""
+        abort = types.SimpleNamespace(name="ABORT")
+        plan_by_shape = {}
+
+        def factory(s):
+            p = _FlakyPlan(s, fail_n=1, status=abort)
+            plan_by_shape[s] = p
+            return p
+
+        ladder = _ladder_of(factory)
+        srv = SearchServer(ladder, ServeConfig(batch_sizes=(1, 4),
+                                               max_wait_ms=0.0))
+        try:
+            with pytest.raises(ShardFailedError):
+                srv.search(_rows(1, base=7), timeout=30)
+            # dispatcher alive: the same plan now succeeds
+            d, i = srv.search(_rows(1, base=9), timeout=30)
+            assert i[0, 0] == 9
+        finally:
+            srv.close()
+
+    def test_dispatcher_crash_guard(self):
+        """An exception OUTSIDE the dispatch path (here: plan_for
+        poisoned) fails that batch's futures with a typed
+        DispatchError, counts under raft.serve.dispatcher.errors, and
+        the dispatcher keeps serving."""
+        class PoisonedLadder(PlanLadder):
+            boom = 1
+
+            def plan_for(self, rows, rung):
+                if self.boom:
+                    self.boom -= 1
+                    raise RuntimeError("poisoned ladder")
+                return super().plan_for(rows, rung)
+
+        plans = {(s, 0): _FlakyPlan(s) for s in (1, 4)}
+        ladder = PoisonedLadder(shapes=(1, 4), rungs=(8,), plans=plans,
+                                dim=4, k=4)
+        srv = SearchServer(ladder, ServeConfig(batch_sizes=(1, 4),
+                                               max_wait_ms=0.0))
+        before = obs.snapshot()
+        try:
+            with pytest.raises(DispatchError):
+                srv.search(_rows(1), timeout=30)
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.serve.dispatcher.errors") == 1
+            d, i = srv.search(_rows(1, base=3), timeout=30)
+            assert i[0, 0] == 3
+        finally:
+            srv.close()
+
+    def test_injected_execute_delay_trips_watchdog(self):
+        """The harness's serve.execute site runs INSIDE the watchdog
+        scope: injected latency above the timeout is detected exactly
+        like a real hang."""
+        ladder = _ladder_of(lambda s: _FlakyPlan(s))
+        cfg = ServeConfig(batch_sizes=(1, 4), max_wait_ms=0.0,
+                          dispatch_timeout_ms=50.0, max_retries=1,
+                          retry_backoff_ms=1.0)
+        srv = SearchServer(ladder, cfg)
+        try:
+            with faults.delay_execute(500.0, max_hits=1):
+                d, i = srv.search(_rows(1, base=11), timeout=30)
+                assert i[0, 0] == 11    # retry after the timed-out hit
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# distributed tier: partial-mesh failover on the 8-way CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def _clear_suspect_gauges():
+    """Zero any suspect_rank gauges a previous test left raised (the
+    failover exclusion reads the global registry)."""
+    for lbl, v in obs.snapshot().get("gauges", {}).items():
+        if not lbl.startswith("raft.comms.health.suspect_rank{") \
+                or v <= 0:
+            continue
+        labels = dict(kv.split("=", 1) for kv in
+                      lbl.split("{", 1)[1].rstrip("}").split(","))
+        obs.gauge("raft.comms.health.suspect_rank",
+                  session=labels.get("session", "default"),
+                  rank=int(labels["rank"])).set(0)
+
+
+class TestDistFailover:
+    @pytest.fixture(scope="class")
+    def failover_server(self, devices):
+        from raft_tpu.parallel import shard_ivf_flat
+        from raft_tpu.parallel.mesh import make_mesh
+        x, _ = make_blobs(n_samples=4000, n_features=32, centers=20,
+                          cluster_std=2.0, seed=0)
+        q, _ = make_blobs(n_samples=64, n_features=32, centers=20,
+                          cluster_std=2.0, seed=1)
+        x, q = np.asarray(x), np.asarray(q)
+        idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16,
+                                                     kmeans_n_iters=4))
+        mesh = make_mesh(devices=devices)
+        sindex = shard_ivf_flat(idx, mesh)
+        cfg = ServeConfig(batch_sizes=(1, 4), max_wait_ms=1.0,
+                          dispatch_timeout_ms=500.0, max_retries=2,
+                          retry_backoff_ms=5.0, failover=True,
+                          failover_probe_ms=150.0)
+        _clear_suspect_gauges()
+        srv = serve.DistributedSearchServer.from_sharded_index(
+            sindex, q[:8], 8,
+            params=ivf_flat.SearchParams(n_probes=2), mesh=mesh,
+            config=cfg)
+        yield srv, x, q
+        srv.close()
+
+    def test_stall_partial_zero_compiles_and_recovery(
+            self, failover_server):
+        from raft_tpu.obs.endpoint import _health_body
+        srv, x, q = failover_server
+        # healthy baseline: a full (non-partial) answer
+        res = srv.search(q[:2], timeout=60)
+        assert not getattr(res, "partial", False)
+        before = obs.snapshot()
+        with faults.stall_shard(3, seconds=30.0):
+            res = srv.search(q[:2], timeout=60)
+            d, i = res
+            # explicitly-flagged partial result over the healthy subset
+            assert res.partial and 0.0 < res.coverage < 1.0
+            assert d.shape == (2, 8) and i.shape == (2, 8)
+            assert (np.asarray(i) >= 0).all()
+            assert srv.excluded_ranks == (3,)
+            assert _gauge("raft.serve.failover.engaged") == 1
+            body = _health_body(obs.snapshot())
+            assert body["status"] == "degraded"
+            assert body["serve"]["failover"]["engaged"] == 1
+            assert 3 in body["serve"]["dist"]["suspect_ranks"]
+            # steady degraded traffic — no further timeouts, no errors
+            res2 = srv.search(q[2:4], timeout=60)
+            assert res2.partial
+            assert res2.coverage == res.coverage
+        # fault cleared → after the probe interval the exclusion lifts
+        time.sleep(0.25)
+        deadline = time.monotonic() + 20.0
+        recovered = False
+        while time.monotonic() < deadline:
+            res3 = srv.search(q[:1], timeout=60)
+            if not getattr(res3, "partial", False):
+                recovered = True
+                break
+            time.sleep(0.1)
+        assert recovered, "full-mesh serving did not resume"
+        assert srv.excluded_ranks == ()
+        assert _gauge("raft.serve.failover.engaged") == 0
+        after = obs.snapshot()
+        # the failure/recovery cycle is fully counted...
+        assert _cdiff(before, after,
+                      "raft.serve.dispatch.timeouts.total") >= 1
+        assert _cdiff(before, after, "raft.serve.retry.total") >= 1
+        assert _cdiff(before, after, "raft.serve.failover.total") == 1
+        assert _cdiff(before, after,
+                      "raft.serve.failover.recovered.total") == 1
+        assert _cdiff(before, after,
+                      "raft.serve.failover.partial.total") >= 2
+        # ...and NEVER compiled: the degraded ladder was pre-warmed at
+        # construction, the full-mesh ladder stayed warm through the
+        # exclusion (the zero-steady-state-compile contract holds
+        # through failover AND recovery)
+        assert _cdiff(before, after, "raft.plan.cache.misses") == 0
+        assert _cdiff(before, after, "raft.plan.build.total") == 0
+        assert _cdiff(before, after, "raft.parallel.plan.misses") == 0
+
+    def test_partial_results_match_healthy_subset_brute_force(
+            self, failover_server):
+        """Degraded answers are the exact per-request truth over the
+        surviving shards' rows: equal to brute force restricted to the
+        healthy lists' membership (n_probes=2 scans every local list,
+        so the sub-plans are exhaustive over their shard)."""
+        from raft_tpu.neighbors.brute_force import brute_force_knn
+        srv, x, q = failover_server
+        fol = srv._failover
+        with faults.stall_shard(5, seconds=30.0):
+            res = srv.search(q[:4], timeout=60)
+            assert res.partial
+            d, i = res
+        time.sleep(0.25)
+        while True:     # drain the exclusion for the next test
+            if not getattr(srv.search(q[:1], timeout=60), "partial",
+                           False):
+                break
+            time.sleep(0.1)
+        # membership of the healthy shards = every row except the ones
+        # living in shard 5's lists (read off the sharded index)
+        li = np.asarray(
+            srv.ladder.plan_for(1, 0)[1]._index.lists_indices)
+        nl_local = li.shape[0] // fol.n_shards
+        healthy = np.ones(len(x), bool)
+        dead = li[5 * nl_local:(5 + 1) * nl_local].reshape(-1)
+        healthy[dead[dead >= 0]] = False
+        xs = np.where(healthy)[0]
+        d_bf, i_bf = brute_force_knn(x[healthy], q[:4], 8,
+                                     mode="exact")
+        i_bf = xs[np.asarray(i_bf)]
+        for r in range(4):
+            assert set(np.asarray(i)[r].tolist()) == \
+                set(i_bf[r].tolist()), f"row {r}"
+
+
+# ---------------------------------------------------------------------------
+# mutation-side failure handling
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_flat():
+    x, _ = make_blobs(n_samples=1200, n_features=16, centers=8,
+                      cluster_std=2.0, seed=0)
+    x = np.asarray(x)
+    return x, ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                     kmeans_n_iters=3))
+
+
+def _wait_until(pred, timeout_s=15.0, step=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+class TestCompactorCrashGuard:
+    def test_crash_loop_counted_degraded_then_recovers(self, small_flat):
+        from raft_tpu import mutate
+        from raft_tpu.obs.endpoint import _health_body
+        x, idx = small_flat
+        m = mutate.MutableIndex(
+            idx, k=4, config=mutate.MutateConfig(
+                delta_capacities=(8, 16, 32),
+                compact_trigger_frac=0.5))
+        m.upsert(x[:20] + 0.01)    # past the trigger: every poll fires
+        before = obs.snapshot()
+        comp = mutate.Compactor(m, poll_ms=5.0, fail_threshold=2,
+                                max_backoff_s=0.05)
+        try:
+            with faults.kill_compactor():
+                assert _wait_until(lambda: _cdiff(
+                    before, obs.snapshot(),
+                    "raft.mutate.compactor.errors") >= 2)
+                assert _gauge("raft.mutate.compactor.failing") == 1
+                body = _health_body(obs.snapshot())
+                assert body["status"] == "degraded"
+                assert body["mutate"]["compactor_failing"] == 1
+                # the delta is untouched by failed attempts
+                assert m.stats()["delta_used"] == 20
+            # fault cleared: the guarded loop retries and succeeds
+            assert _wait_until(lambda: _cdiff(
+                before, obs.snapshot(),
+                "raft.mutate.compact.total") >= 1)
+            assert _wait_until(
+                lambda: _gauge("raft.mutate.compactor.failing") == 0)
+            assert m.stats()["delta_used"] == 0
+        finally:
+            comp.close()
+
+    def test_concurrent_writers_racing_stalled_compactor(self,
+                                                         small_flat):
+        """Satellite: N writer threads race a crash-looping compactor
+        into the DeltaFullError wall — exactly the top-rung capacity is
+        acked (no lost or over-committed slots), every writer sees the
+        typed error, internal state stays consistent, and draining the
+        fault recovers write availability."""
+        from raft_tpu import mutate
+        x, idx = small_flat
+        top = 64
+        m = mutate.MutableIndex(
+            idx, k=4, config=mutate.MutateConfig(
+                delta_capacities=(8, 16, top),
+                compact_trigger_frac=0.9))
+        comp = mutate.Compactor(m, poll_ms=5.0, fail_threshold=2,
+                                max_backoff_s=0.02)
+        acked, errs = [], []
+        lock = threading.Lock()
+
+        def writer(tid):
+            rng = np.random.default_rng(tid)
+            while True:
+                row = rng.standard_normal((1, 16)).astype(np.float32)
+                try:
+                    ids = m.upsert(row)
+                except mutate.DeltaFullError:
+                    with lock:
+                        errs.append(tid)
+                    return
+                with lock:
+                    acked.append(int(ids[0]))
+
+        try:
+            with faults.kill_compactor():
+                threads = [threading.Thread(target=writer, args=(t,))
+                           for t in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+                assert not any(t.is_alive() for t in threads)
+                # exactly the top rung was acked; every writer hit the
+                # wall; the slot map agrees with the ack count
+                assert len(acked) == top
+                assert len(set(acked)) == top
+                assert sorted(errs) == list(range(6))
+                st = m.stats()
+                assert st["delta_used"] == top
+                assert st["delta_live"] == top
+                # a full delta with a dead compactor degrades /healthz
+                assert _gauge("raft.mutate.delta.stalled") == 1
+            # compactor recovers → writes become available again
+            assert _wait_until(
+                lambda: m.stats()["delta_used"] < top)
+            m.upsert(np.zeros((1, 16), np.float32))
+        finally:
+            comp.close()
+
+    def test_failed_transfer_is_counted_and_recoverable(self,
+                                                        small_flat):
+        from raft_tpu import mutate
+        x, idx = small_flat
+        m = mutate.MutableIndex(idx, k=4)
+        before = obs.snapshot()
+        with faults.fail_transfer(times=1):
+            with pytest.raises(faults.FaultError):
+                m.upsert(x[:1] + 0.5)
+        assert _cdiff(before, obs.snapshot(),
+                      "raft.mutate.transfer.errors") == 1
+        # host state applied (at-least-once semantics); the next
+        # successful mutation refreshes the device view with BOTH rows
+        ids = m.upsert(x[1:2] + 0.5)
+        d, i = m.search(x[:1] + 0.5, block=True)
+        assert int(np.asarray(i)[0, 0]) == int(ids[0]) - 1
+
+
+# ---------------------------------------------------------------------------
+# mutation WAL: durability + recovery parity
+# ---------------------------------------------------------------------------
+
+
+class TestWal:
+    def test_round_trip_and_order(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=True)
+        rows = np.arange(8, dtype=np.float32).reshape(2, 4)
+        w.append_upsert([5, 6], rows)
+        w.append_delete([3])
+        w.close()
+        recs = MutationWAL(p, sync=False).replay()
+        assert [r.op for r in recs] == [1, 2]
+        np.testing.assert_array_equal(recs[0].ids, [5, 6])
+        np.testing.assert_array_equal(recs[0].rows, rows)
+        np.testing.assert_array_equal(recs[1].ids, [3])
+
+    def test_torn_tail_detected_and_repaired(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=False)
+        w.append_delete([1])
+        w.close()
+        with open(p, "ab") as f:    # crash mid-append: torn record
+            f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefjunk")
+        before = obs.snapshot()
+        w2 = MutationWAL(p, sync=False)
+        assert w2.torn_bytes > 0
+        assert _cdiff(before, obs.snapshot(),
+                      "raft.mutate.wal.torn.total") >= 1
+        recs = w2.replay()
+        assert [r.op for r in recs] == [2]
+        # the reopen truncated the torn bytes: appends continue cleanly
+        w2.append_delete([2])
+        w2.close()
+        assert [r.op for r in MutationWAL(p, sync=False).replay()] \
+            == [2, 2]
+
+    def test_corrupt_payload_stops_replay(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=False)
+        w.append_delete([1])
+        w.append_delete([2])
+        w.close()
+        data = bytearray(open(p, "rb").read())
+        data[-1] ^= 0xFF            # flip a byte in the LAST record
+        open(p, "wb").write(bytes(data))
+        recs = MutationWAL(p, sync=False).replay()
+        assert [r.ids.tolist() for r in recs] == [[1]]
+
+
+class TestWalRecovery:
+    def _mutate_some(self, m, x, seed=0):
+        rng = np.random.default_rng(seed)
+        ids = m.upsert(x[:10] + 0.01)
+        m.delete(ids[:3])
+        m.delete([2, 5])
+        m.upsert(x[10:12] + 0.02, ids=ids[3:5])   # replace
+        m.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+        return ids
+
+    def test_acked_mutations_replay_100_percent(self, small_flat,
+                                                tmp_path):
+        from raft_tpu import mutate
+        x, idx = small_flat
+        wal_p = str(tmp_path / "m.wal")
+        m = mutate.MutableIndex(idx, k=4)
+        m.attach_wal(MutationWAL(wal_p))
+        self._mutate_some(m, x)
+        # crash: the process dies with the object — nothing is closed
+        m2 = mutate.MutableIndex.recover(wal_p, k=4, base_index=idx)
+        s1, s2 = m.stats(), m2.stats()
+        for key in ("delta_used", "delta_live", "tombstones",
+                    "next_id", "id_base"):
+            assert s1[key] == s2[key], key
+        q = x[:16]
+        d1, i1 = m.search(q, block=True)
+        d2, i2 = m2.search(q, block=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-6)
+
+    def test_checkpointed_compaction_truncates_and_recovers(
+            self, small_flat, tmp_path):
+        from raft_tpu import mutate
+        x, idx = small_flat
+        wal_p = str(tmp_path / "m.wal")
+        ckpt_p = str(tmp_path / "m.ckpt")
+        m = mutate.MutableIndex(idx, k=4)
+        m.attach_wal(MutationWAL(wal_p), checkpoint_path=ckpt_p)
+        self._mutate_some(m, x)
+        before = obs.snapshot()
+        assert m.compact()
+        assert os.path.exists(ckpt_p)
+        assert _cdiff(before, obs.snapshot(),
+                      "raft.mutate.wal.truncations.total") == 1
+        # post-compaction log holds only the meta record
+        assert len(MutationWAL(wal_p, sync=False).replay()) == 1
+        # more acked traffic after the fold, then crash
+        ids = m.upsert(x[20:24] + 0.03)
+        m.delete([int(ids[0]), 9])
+        m2 = mutate.MutableIndex.recover(wal_p, k=4,
+                                         checkpoint_path=ckpt_p)
+        s1, s2 = m.stats(), m2.stats()
+        for key in ("epoch", "delta_used", "delta_live", "tombstones",
+                    "next_id", "id_base"):
+            assert s1[key] == s2[key], key
+        q = x[:16]
+        _, i1 = m.search(q, block=True)
+        _, i2 = m2.search(q, block=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_replay_overflow_compacts_inline(self, small_flat,
+                                             tmp_path):
+        from raft_tpu import mutate
+        x, idx = small_flat
+        wal_p = str(tmp_path / "m.wal")
+        cfg_big = mutate.MutateConfig(delta_capacities=(64, 256))
+        cfg_small = mutate.MutateConfig(delta_capacities=(8, 32))
+        m = mutate.MutableIndex(idx, k=4, config=cfg_big)
+        m.attach_wal(MutationWAL(wal_p, sync=False))
+        rng = np.random.default_rng(3)
+        acked = m.upsert(rng.standard_normal((100, 16))
+                         .astype(np.float32))
+        # recovery under a SMALLER delta budget must compact inline
+        # rather than fail on volume
+        m2 = mutate.MutableIndex.recover(wal_p, k=4, base_index=idx,
+                                         config=cfg_small, sync=False)
+        assert m2.size == m.size
+        assert m2.epoch >= 1        # at least one inline fold happened
+        assert int(np.asarray(m2.search(
+            rng.standard_normal((1, 16)).astype(np.float32),
+            block=True)[1])[0].min()) >= 0
+        assert acked.shape[0] == 100

@@ -46,7 +46,7 @@ class TestFramework:
     def test_registry_has_contracted_rules(self):
         rules = core.all_rules()
         for code in ("GL001", "GL002", "GL003", "GL004", "GL005",
-                     "GL010", "GL011"):
+                     "GL006", "GL010", "GL011"):
             assert code in rules, f"rule {code} missing from registry"
 
     def test_syntax_error_reported_not_crashed(self, tmp_path):
@@ -296,6 +296,53 @@ class TestGL005Clock:
         assert findings == []
 
 
+class TestGL006Swallow:
+    SILENT = "try:\n    x()\nexcept Exception:\n    pass\n"
+    BARE = "try:\n    x()\nexcept:\n    cleanup()\n"
+    COUNTED = ("try:\n    x()\nexcept:\n    obs." +
+               'counter("raft.serve.dispatcher.errors").inc()\n')
+    RERAISED = ("try:\n    x()\nexcept Exception:\n"
+                "    log.error('x failed')\n    raise\n")
+    HANDLED = ("try:\n    x()\nexcept ValueError as e:\n"
+               "    y = fallback(e)\n")
+
+    def test_flags_silent_pass_and_bare_except(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/x.py", self.SILENT + self.BARE)
+        findings, _ = _run(tmp_path, select=["GL006"])
+        assert _codes(findings) == ["GL006", "GL006"]
+
+    def test_counted_reraised_and_typed_handlers_silent(self, tmp_path):
+        _write(tmp_path, "raft_tpu/mutate/x.py",
+               self.COUNTED + self.RERAISED + self.HANDLED)
+        findings, _ = _run(tmp_path, select=["GL006"])
+        assert findings == []
+
+    def test_out_of_scope_tree_not_checked(self, tmp_path):
+        # ops/ has legitimate best-effort handlers; the rule's contract
+        # covers the failure-handling trees only
+        _write(tmp_path, "raft_tpu/ops/x.py", self.SILENT)
+        findings, _ = _run(tmp_path, select=["GL006"])
+        assert findings == []
+
+    def test_serve_and_mutate_carry_zero_gl006(self):
+        """ISSUE 10 satellite acceptance: the failure-handling trees
+        themselves swallow nothing silently — serve/ and mutate/ are
+        clean outright (modulo justified suppression pragmas); comms'
+        grandfathered heartbeat sites ride the baseline instead."""
+        findings, _ = engine.run(
+            REPO, files=[os.path.join(REPO, "raft_tpu", "serve"),
+                         os.path.join(REPO, "raft_tpu", "mutate")],
+            select=["GL006"])
+        assert findings == []
+
+    def test_comms_grandfathered_sites_are_baselined(self):
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        gl006 = [k for k in allow if k[0] == "GL006"]
+        assert gl006, "expected grandfathered GL006 comms entries"
+        assert all(k[1].startswith("raft_tpu/comms/") for k in gl006)
+
+
 class TestGL010GL011Metrics:
     # assembled so this file's own literals don't trip the tree scan
     _C = "obs." + "{fn}({q}{name}{q})"
@@ -409,7 +456,7 @@ class TestCLI:
         r = self._cli("--list-rules")
         assert r.returncode == 0
         for code in ("GL001", "GL002", "GL003", "GL004", "GL005",
-                     "GL010", "GL011"):
+                     "GL006", "GL010", "GL011"):
             assert code in r.stdout
 
     def test_seeded_bug_fails_the_gate(self, tmp_path):

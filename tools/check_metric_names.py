@@ -127,6 +127,25 @@ REQUIRED_NAMES = (
     "raft.mutate.compact.total",
     "raft.mutate.compact.inflight",
     "raft.mutate.delta.overflow.total",
+    # failure handling (ISSUE 10): the retry budget's lifecycle, the
+    # watchdog's hang→typed-error conversions, the dispatcher crash
+    # guard, the partial-mesh failover engage/recover cycle /healthz
+    # folds in, the mutation WAL durability counters the recovery
+    # parity test keys on, and the compactor crash-loop guard
+    "raft.serve.retry.total",
+    "raft.serve.retry.exhausted.total",
+    "raft.serve.dispatch.timeouts.total",
+    "raft.serve.dispatcher.errors",
+    "raft.serve.failover.total",
+    "raft.serve.failover.partial.total",
+    "raft.serve.failover.engaged",
+    "raft.serve.failover.recovered.total",
+    "raft.mutate.wal.appends.total",
+    "raft.mutate.wal.replayed.total",
+    "raft.mutate.wal.truncations.total",
+    "raft.mutate.wal.torn.total",
+    "raft.mutate.compactor.errors",
+    "raft.mutate.compactor.failing",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -161,6 +180,10 @@ REQUIRED_SPAN_NAMES = (
     # live mutable indexes (ISSUE 9): the compaction fold/prewarm/swap
     # lifecycle span (epoch + row/tombstone counts ride as attrs)
     "raft.mutate.compact",
+    # failure handling (ISSUE 10): every retry is a span under the
+    # batch root (attempt, backoff, error class as attrs) so a traced
+    # request shows its failure story, not only its latency
+    "raft.serve.retry",
 )
 
 

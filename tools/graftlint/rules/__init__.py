@@ -6,3 +6,4 @@ from tools.graftlint.rules import locks  # noqa: F401
 from tools.graftlint.rules import metrics  # noqa: F401
 from tools.graftlint.rules import precision  # noqa: F401
 from tools.graftlint.rules import retrace  # noqa: F401
+from tools.graftlint.rules import swallow  # noqa: F401

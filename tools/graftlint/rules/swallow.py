@@ -1,0 +1,106 @@
+"""GL006 — silent exception swallowing in the failure-handling tree.
+
+The bug class ISSUE 10 fixed twice in one PR: the compactor's
+``except`` handler itself raised (``log.warning`` on a logger that
+only has ``warn``) and killed the daemon forever, and the serving
+dispatcher let a bare exception escape the batch path and die with
+every queued future hung behind it. The common shape is an exception
+handler that makes a failure *disappear* — no re-raise, no
+``raft.*.errors`` counter — so the failure is invisible to both the
+caller and the dashboards.
+
+Flagged in ``serve/``, ``comms/`` and ``mutate/`` (the trees whose
+failures have contracts):
+
+* a handler whose body is effect-free (only ``pass`` / ``...`` /
+  ``continue`` / a docstring) — the literal ``except ...: pass``;
+* a **bare** ``except:`` whose body neither re-raises nor increments
+  an errors counter (a counter call whose literal metric name contains
+  ``.errors``) — catching ``KeyboardInterrupt``/``SystemExit`` by
+  accident AND hiding the outcome is two bugs in one line.
+
+A justified swallow stays allowed via ``# graftlint: disable=GL006``
+with a comment (e.g. a dropped heartbeat that is indistinguishable
+from latency), and pre-existing sites ride the checked-in baseline —
+strict on new code, like every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import FileContext, Finding, Rule, register
+
+
+def _is_noop_stmt(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue)):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                 ast.Constant):
+        return True     # docstring / bare `...`
+    return False
+
+
+def _body_is_noop(handler: ast.ExceptHandler) -> bool:
+    return all(_is_noop_stmt(s) for s in handler.body)
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for n in ast.walk(ast.Module(body=handler.body,
+                                            type_ignores=[])))
+
+
+def _counts_errors(handler: ast.ExceptHandler) -> bool:
+    """True when the body increments a counter whose literal metric
+    name carries ``.errors`` (``obs.counter("raft.x.y.errors").inc()``
+    and the ``raft.*.errors.total`` spelling both match)."""
+    for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if not isinstance(n, ast.Call):
+            continue
+        for arg in n.args:
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and ".errors" in arg.value):
+                return True
+    return False
+
+
+@register
+class SilentSwallow(Rule):
+    code = "GL006"
+    name = "silent-except"
+    description = ("exception handlers that make failures disappear: "
+                   "`except ...: pass` bodies, and bare `except:` "
+                   "without a re-raise or a raft.*.errors counter "
+                   "increment (the crashed-compactor / dead-dispatcher "
+                   "bug class of ISSUE 10)")
+    paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if _body_is_noop(handler):
+                    caught = ("bare" if handler.type is None else
+                              ast.unparse(handler.type))
+                    yield ctx.finding(
+                        self.code, handler,
+                        f"silent `except {caught}: pass` — the failure "
+                        f"vanishes (no re-raise, no raft.*.errors "
+                        f"counter); count it, raise it, or justify a "
+                        f"disable pragma")
+                elif handler.type is None and not (
+                        _has_raise(handler) or _counts_errors(handler)):
+                    yield ctx.finding(
+                        self.code, handler,
+                        "bare `except:` without re-raise or a "
+                        "raft.*.errors counter increment — catches "
+                        "KeyboardInterrupt/SystemExit and hides the "
+                        "outcome; name the exception and surface the "
+                        "failure")

@@ -55,6 +55,81 @@ def percentile(xs, q: float) -> float:
     return xs[rank]
 
 
+def parse_chaos_spec(spec: str, default_duration_s: float = 5.0):
+    """Parse a chaos schedule like ``stall_shard:3@t+10s,
+    kill_compactor@t+20s`` → sorted ``(t_offset_s, kind, arg,
+    duration_s)`` events. Grammar per event:
+    ``<kind>[:<arg>]@t+<seconds>s[+<duration>s]`` with kinds
+    ``stall_shard`` (arg = rank), ``kill_compactor``,
+    ``fail_transfer`` (arg = times, default 1) and ``delay_execute``
+    (arg = ms)."""
+    known = ("stall_shard", "kill_compactor", "fail_transfer",
+             "delay_execute")
+    events = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name_arg, _, when = part.partition("@")
+        if not when.startswith("t+"):
+            raise ValueError(f"chaos event {part!r}: need '@t+<sec>s'")
+        when = when[2:]
+        dur = default_duration_s
+        if "+" in when:
+            when, dur_s = when.split("+", 1)
+            dur = float(dur_s.rstrip("s"))
+        t_off = float(when.rstrip("s"))
+        kind, _, arg = name_arg.partition(":")
+        if kind not in known:
+            raise ValueError(f"chaos event {part!r}: unknown kind "
+                             f"{kind!r} (known: {', '.join(known)})")
+        events.append((t_off, kind, arg or None, dur))
+    return sorted(events)
+
+
+def run_chaos_schedule(events, stop: threading.Event) -> threading.Thread:
+    """Drive the fault harness on a schedule: a daemon thread enters
+    each event's scope at its offset and exits it after its duration
+    (or when ``stop`` is set — faults never outlive the run)."""
+    from contextlib import ExitStack
+    from raft_tpu.testing import faults
+
+    def _enter(stack, kind, arg, dur):
+        if kind == "stall_shard":
+            return stack.enter_context(
+                faults.stall_shard(int(arg), seconds=max(dur, 30.0)))
+        if kind == "kill_compactor":
+            return stack.enter_context(faults.kill_compactor())
+        if kind == "fail_transfer":
+            return stack.enter_context(
+                faults.fail_transfer(times=int(arg or 1)))
+        return stack.enter_context(
+            faults.delay_execute(float(arg or 10.0)))
+
+    def loop():
+        t0 = time.perf_counter()
+        live = []      # (deadline, stack)
+        pending = list(events)
+        while (pending or live) and not stop.is_set():
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                t_off, kind, arg, dur = pending.pop(0)
+                stack = ExitStack()
+                _enter(stack, kind, arg, dur)
+                live.append((t_off + dur, stack))
+            for deadline, stack in list(live):
+                if now >= deadline:
+                    stack.close()
+                    live.remove((deadline, stack))
+            time.sleep(0.02)
+        for _, stack in live:
+            stack.close()
+
+    t = threading.Thread(target=loop, daemon=True, name="raft-chaos")
+    t.start()
+    return t
+
+
 def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
                   duration_s: float, nq: int = 1,
                   k: Optional[int] = None,
@@ -75,8 +150,8 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
     rng = random.Random(seed)
     pool_n = query_pool.shape[0]
     lock = threading.Lock()
-    latencies, outcomes = [], {"ok": 0, "shed": 0, "deadline": 0,
-                               "error": 0}
+    latencies, outcomes = [], {"ok": 0, "partial": 0, "shed": 0,
+                               "deadline": 0, "error": 0}
     writes = {"upserts": 0, "deletes": 0, "write_rejects": 0}
     written_ids = []
     pending = []
@@ -115,7 +190,7 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
 
         def _done(f, t_sub=t_sub):
             try:
-                f.result()
+                res = f.result()
             except RejectedError:
                 kind = "shed"
             except DeadlineExceeded:
@@ -123,10 +198,13 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
             except Exception:
                 kind = "error"
             else:
-                kind = "ok"
+                # a flagged-partial answer (degraded mesh, ISSUE 10) is
+                # availability, counted separately from full results
+                kind = ("partial" if getattr(res, "partial", False)
+                        else "ok")
             with lock:
                 outcomes[kind] += 1
-                if kind == "ok":
+                if kind in ("ok", "partial"):
                     latencies.append(time.perf_counter() - t_sub)
 
         fut.add_done_callback(_done)
@@ -142,14 +220,21 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
     wall = time.perf_counter() - t0
     diff = obs.snapshot_diff(before, obs.snapshot())
     with lock:
+        answered = outcomes["ok"] + outcomes["partial"]
         report = {
             "offered": offered,
             "offered_qps": round(offered / wall, 1),
-            "completed": outcomes["ok"],
+            "completed": answered,
+            "partial": outcomes["partial"],
             "shed": outcomes["shed"],
             "deadline_expired": outcomes["deadline"],
             "errors": outcomes["error"],
-            "achieved_qps": round(outcomes["ok"] * nq / wall, 1),
+            # availability = answered (full or flagged-partial) over
+            # everything offered — the ISSUE 10 chaos acceptance figure
+            "availability": round(answered / max(1, offered), 6),
+            "partial_fraction": round(
+                outcomes["partial"] / max(1, answered), 6),
+            "achieved_qps": round(answered * nq / wall, 1),
             "p50_ms": round(percentile(latencies, 50) * 1e3, 2),
             "p99_ms": round(percentile(latencies, 99) * 1e3, 2),
             "serve_metrics": {
@@ -180,7 +265,8 @@ def measure_sustainable_qps(server, query_pool: np.ndarray, nq: int = 1,
 def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
                        probes_ladder, deadline_ms: float,
                        server: str = "single",
-                       mutate_frac: float = 0.0):
+                       mutate_frac: float = 0.0,
+                       chaos: bool = False):
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.random import make_blobs
@@ -195,7 +281,13 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
         probes_ladder=tuple(probes_ladder),
         default_deadline_ms=deadline_ms,
         degrade_watermark_ms=200.0, upgrade_watermark_ms=20.0,
-        degrade_cooldown_ms=50.0)
+        degrade_cooldown_ms=50.0,
+        # chaos runs exercise the failure handling: watchdog + retry
+        # budget, and (dist) the pre-warmed partial-mesh failover
+        dispatch_timeout_ms=500.0 if chaos else 0.0,
+        max_retries=2 if chaos else 0,
+        failover=bool(chaos and server == "dist"),
+        failover_probe_ms=500.0)
     if server == "dist":
         # the mesh-wide tier (ISSUE 8): list-shard the index over every
         # local device, serve through the distributed plan ladder with
@@ -274,17 +366,40 @@ def main(argv=None) -> int:
                     help="overload demo: offer 2x the calibrated "
                          "sustainable rate and show the ladder holding "
                          "p99 while recall steps down")
+    ap.add_argument("--chaos", type=str, default=None,
+                    help="fault schedule driven during the run, e.g. "
+                         "'stall_shard:3@t+10s,kill_compactor@t+20s' "
+                         "(ISSUE 10; kinds: stall_shard:<rank>, "
+                         "kill_compactor, fail_transfer[:times], "
+                         "delay_execute:<ms>). Enables the watchdog + "
+                         "retry budget, and partial-mesh failover on "
+                         "--server dist; the report carries "
+                         "availability, partial fraction and the "
+                         "raft.serve.retry/failover.* diffs")
+    ap.add_argument("--chaos-duration", type=float, default=5.0,
+                    help="default duration (s) of each chaos event "
+                         "without an explicit '+<dur>s' suffix")
     args = ap.parse_args(argv)
     if args.mutate_frac and args.server == "dist":
         ap.error("--mutate-frac rides the single-device server "
                  "(DistributedSearchServer.from_mutable is the "
                  "library-level mesh path)")
+    chaos_events = (parse_chaos_spec(args.chaos, args.chaos_duration)
+                    if args.chaos else None)
+    if chaos_events and any(e[1] in ("kill_compactor", "fail_transfer")
+                            for e in chaos_events) \
+            and not args.mutate_frac:
+        ap.error("--chaos kill_compactor/fail_transfer need a mutable "
+                 "serving path — add --mutate-frac (> 0)")
+    if chaos_events and args.demo:
+        ap.error("--chaos rides the plain open-loop run (the demo's "
+                 "calibration phase would skew the event offsets)")
 
     ladder = tuple(int(s) for s in args.probes_ladder.split(","))
     srv, q, mindex = _build_demo_server(
         args.n, args.dim, args.n_lists, args.k, ladder,
         args.deadline_ms, server=args.server,
-        mutate_frac=args.mutate_frac)
+        mutate_frac=args.mutate_frac, chaos=bool(chaos_events))
     comp = None
     if mindex is not None:
         from raft_tpu import mutate
@@ -325,11 +440,30 @@ def main(argv=None) -> int:
                               "degrade_level": lvl,
                               "recovered": lvl == 0}), flush=True)
         else:
-            report = run_open_loop(
-                srv, q, rate_qps=args.rate, duration_s=args.duration,
-                nq=args.nq, deadline_ms=args.deadline_ms or None,
-                seed=args.seed, mutator=mindex,
-                mutate_frac=args.mutate_frac)
+            stop = threading.Event()
+            chaos_t = (run_chaos_schedule(chaos_events, stop)
+                       if chaos_events else None)
+            try:
+                report = run_open_loop(
+                    srv, q, rate_qps=args.rate,
+                    duration_s=args.duration, nq=args.nq,
+                    deadline_ms=args.deadline_ms or None,
+                    seed=args.seed, mutator=mindex,
+                    mutate_frac=args.mutate_frac)
+            finally:
+                stop.set()
+                if chaos_t is not None:
+                    chaos_t.join(timeout=10.0)
+            if chaos_events:
+                from raft_tpu import obs
+                g = obs.snapshot()["gauges"]
+                report["chaos"] = {
+                    "schedule": args.chaos,
+                    "failover_engaged_at_end": g.get(
+                        "raft.serve.failover.engaged", 0.0),
+                    "compactor_failing_at_end": g.get(
+                        "raft.mutate.compactor.failing", 0.0),
+                }
             print(json.dumps(report), flush=True)
     finally:
         if comp is not None:

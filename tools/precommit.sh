@@ -80,6 +80,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_mutate.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# fault injection + failure handling (ISSUE 10): watchdog/retry/
+# deadline ordering, dispatcher + compactor crash guards, partial-mesh
+# failover with the zero-failure-path-compile contract, and the
+# mutation-WAL crash-recovery parity.
+echo "precommit: fault-injection + failure-handling tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # distributed serving tier (ISSUE 8): the int8 merge codec round-trip
 # + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
 # mesh, pad-row non-leakage through the distributed scatter, and the

@@ -14,6 +14,9 @@
 #        rerun — the compression's QPS/recall cost measured same-round
 #   mu0  mutable-index row (ISSUE 9): fold-vs-rebuild recall parity
 #        after 10k mutations + serving QPS under a mutation stream
+#   ch0  chaos row (ISSUE 10): one shard stalled mid-load on real
+#        hardware — availability / partial fraction / bounded p99 /
+#        zero failure-path compiles through failover + recovery
 #   h1   headline bench (driver format) so the round has fresh
 #        single-device context for the dist comparison
 #   g0   full gated suite (PERF/RECALL/GAP gates end-to-end on TPU)
@@ -69,6 +72,15 @@ mu0() {  # mutable-index row (ISSUE 9): recall parity of fold-vs-
   cp -f "$OUT/mutate_r6.log" docs/measurements/
 }
 
+ch0() {  # chaos row (ISSUE 10): stalled shard → watchdog → retry →
+         # partial-mesh failover → recovery, measured on hardware (the
+         # first multi-chip round WILL see stragglers — this is the row
+         # that says the serving tier survives them)
+  BENCH_CHAOS_N=200000 python bench_suite.py chaos \
+    2>&1 | tee "$OUT/chaos_r6.log"
+  cp -f "$OUT/chaos_r6.log" docs/measurements/
+}
+
 h1() {  # headline bench rows (driver format, embedded measured_at)
   python bench.py 2>&1 | tee "$OUT/headline_r6.log"
   cp -f "$OUT/headline_r6.log" docs/measurements/
@@ -82,6 +94,7 @@ g0() {  # the full gated suite, end-to-end on hardware
 run ds0 ds0
 run ds1 ds1
 run mu0 mu0
+run ch0 ch0
 run h1 h1
 run g0 g0
 echo "[$(stamp)] == r6 campaign complete"
