@@ -394,6 +394,31 @@ def child_main():
         except Exception as e:
             out["chaos_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # quality row (ISSUE 11): the live shadow-exact recall estimate
+        # vs the offline recall at the same operating point, with the
+        # zero-steady-state-compile + unchanged-shed contracts and the
+        # SLO burn verdicts
+        try:
+            rows = []
+            bench_suite.bench_quality(rows, n=min(n_ivf, 200_000))
+            for r in rows:
+                if "live_recall" in r:
+                    out["quality_live_recall"] = r["live_recall"]
+                    out["quality_offline_recall"] = \
+                        r["offline_recall"]
+                    out["quality_recall_gap"] = r["recall_gap"]
+                    out["quality_recall_gap_ok"] = r["recall_gap_ok"]
+                    out["quality_sampled_queries"] = \
+                        r["sampled_queries"]
+                    out["quality_steady_state_compiles"] = \
+                        r["steady_state_compiles"]
+                    out["quality_shed"] = r["shed"]
+                    out["quality_slo_breaches"] = r["slo_breaches"]
+                elif "error" in r:
+                    out.setdefault("quality_error", r["error"])
+        except Exception as e:
+            out["quality_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

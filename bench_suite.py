@@ -145,14 +145,19 @@ def _chain_reps() -> int:
     return 8 if jax.default_backend() in ("tpu", "axon") else 2
 
 
+def _recall_vs(i_got, i_exact, k):
+    """Recall of ``i_got`` against a given exact id table."""
+    f, e = np.asarray(i_got), np.asarray(i_exact)
+    return float(np.mean([len(set(f[r][:k]) & set(e[r][:k])) / k
+                          for r in range(len(f))]))
+
+
 def _ivf_recall(i_got, db, q, k):
     """Recall vs the exact scan (reference eval_neighbours role,
     cpp/test/neighbors/ann_utils.cuh:201)."""
     from raft_tpu.neighbors.brute_force import brute_force_knn
     _, i_e = brute_force_knn(db, q, k, mode="exact")
-    f, e = np.asarray(i_got), np.asarray(i_e)
-    return float(np.mean([len(set(f[r]) & set(e[r])) / k
-                          for r in range(len(f))]))
+    return _recall_vs(i_got, i_e, k)
 
 
 def _chained_search_time(search_fn, q_batches, reps, *operands):
@@ -354,6 +359,18 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
     d_e, i_e = ivf_pq.search(  # estimator-only recall, for the record
         index, q, k, dataclasses.replace(sp, rescore_factor=0))
     rec_est = _ivf_recall(i_e, db, q, k)
+    # shadow-exact calibration (ISSUE 11 satellite): the SAME exact
+    # scorer the online quality monitor replays through produces the
+    # ground truth here, so the 0.13+ estimator drift ROADMAP item 5
+    # cites is a tracked bench key (recall_estimator_error) instead of
+    # folklore — and the scorer itself is cross-validated against the
+    # brute-force recall of the row (recall vs recall_shadow_exact)
+    from raft_tpu.obs import quality as _quality
+    _scorer = _quality.ExactScorer(np.asarray(db), metric=index.metric,
+                                   kmax=k, max_rows=n, batch=250)
+    i_x = _scorer.topk(np.asarray(q), k)
+    rec_shadow = _recall_vs(i_f, i_x, k)
+    rec_est_shadow = _recall_vs(i_e, i_x, k)
     t = _time(lambda: ivf_pq.search(index, q, k, sp), reps=3)
     spp = dataclasses.replace(sp, probe_cap=_cached_cap(index, nq, n_probes))
     reps = _chain_reps()
@@ -392,6 +409,10 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
         "value": round(nq / t, 1), "unit": "queries/s",
         "recall": round(rec, 4),              # rescored (the headline)
         "recall_estimator": round(rec_est, 4),
+        "recall_shadow_exact": round(rec_shadow, 4),
+        # the calibration key: rescored-vs-estimator recall gap against
+        # ONE shared exact ground truth (the online monitor's scorer)
+        "recall_estimator_error": round(rec_shadow - rec_est_shadow, 4),
         "rescore_factor": sp.rescore_factor,
         "marginal_qps": round(nq / t_marg, 1),
         "plan_qps": round(nq / t_plan, 1),
@@ -1266,6 +1287,127 @@ def bench_chaos(results, n=None, nlists=64):
         srv.close()
 
 
+def bench_quality(results, n=None, nlists=256, n_probes=None):
+    """Online quality observability bench (ISSUE 11 acceptance): a
+    closed-loop serving run with shadow-exact sampling ON must report
+    a live recall estimate within 0.05 of the offline recall at the
+    SAME operating point, with zero steady-state compiles and the
+    shed/deadline behavior unchanged — all asserted from ``raft.*``
+    counters. An SLO tracker (availability + recall floor) runs over
+    the window and its burn verdicts ride in the row.
+
+    Knobs: ``BENCH_QUALITY_N`` (rows, default 100k),
+    ``BENCH_QUALITY_SECONDS`` (measure window, 2.0),
+    ``BENCH_QUALITY_CLIENTS`` (closed-loop callers, 8)."""
+    import threading
+    from raft_tpu import obs, serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import quality as quality_mod
+    from raft_tpu.obs import slo as slo_mod
+    n = int(os.environ.get("BENCH_QUALITY_N", n or 100_000))
+    if n_probes is None:
+        n_probes = min(FLAT_PROBES, nlists)
+    d, nq_pool, k = 128, 256, 32
+    db, q = _ann_dataset(n, d, nq_pool)
+    q_np, db_np = np.asarray(q), np.asarray(db)
+    seconds = float(os.environ.get("BENCH_QUALITY_SECONDS", 2.0))
+    clients = int(os.environ.get("BENCH_QUALITY_CLIENTS", 8))
+    index = ivf_flat.build(db, ivf_flat.IndexParams(
+        n_lists=nlists, kmeans_n_iters=10))
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    cfg = serve.ServeConfig(batch_sizes=(1, 8, 32), max_queue=512,
+                            max_wait_ms=2.0, quality_sample_rate=0.5)
+    server = serve.SearchServer.from_index(index, q_np[:32], k,
+                                           params=sp, config=cfg)
+    metric = (f"quality_live_recall_{n//1000}kx{d}_q1_k{k}"
+              f"_p{n_probes}")
+    tracker = None
+    try:
+        # max_rows=n: the bench point stays EXACT ground truth (the
+        # default bound would sample past 256k and turn the comparison
+        # into estimator-vs-estimator); big window so the whole run's
+        # samples land in one mean
+        mon = server.enable_quality(db_np, qconfig=quality_mod.
+                                    QualityConfig(max_rows=n,
+                                                  window=8192))
+        # offline recall THROUGH the server at the same operating
+        # point — the yardstick the live estimate must track
+        served = np.concatenate(
+            [np.asarray(server.search(q_np[s:s + 1])[1])
+             for s in range(nq_pool)])
+        offline = _ivf_recall(served, db, q, k)
+        mon.drain()
+        tracker = slo_mod.SLOTracker(
+            [slo_mod.Objective("availability", "availability",
+                               target=0.999, windows=(5.0, 15.0)),
+             slo_mod.Objective("recall_floor", "recall",
+                               target=max(0.05, offline - 0.1),
+                               tolerance=0.05, windows=(5.0, 15.0))],
+            poll_s=0.25)
+        before = obs.snapshot()
+        stop = time.perf_counter() + seconds
+        counts, lock = [], threading.Lock()
+
+        def client(tid):
+            i, done = tid, 0
+            while time.perf_counter() < stop:
+                server.search(q_np[i % nq_pool:i % nq_pool + 1])
+                i += clients
+                done += 1
+            with lock:
+                counts.append(done)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        mon.drain(30.0)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+
+        def csum(name):
+            return sum(v for k_, v in cnt.items()
+                       if k_ == name or k_.startswith(name + "{"))
+
+        compiles = (csum("raft.plan.cache.misses")
+                    + csum("raft.plan.build.total"))
+        live = mon.stats()
+        slo_rep = tracker.tick()
+        gap = (abs(live["recall"] - offline)
+               if live["recall"] is not None else None)
+        results.append({
+            "metric": metric,
+            "value": live["recall"], "unit": "recall",
+            "live_recall": live["recall"],
+            "offline_recall": round(offline, 4),
+            "recall_gap": None if gap is None else round(gap, 4),
+            "recall_gap_ok": gap is not None and gap <= 0.05,
+            "sampled_queries": int(csum(
+                "raft.obs.quality.samples.total")),
+            "shadow_batches": int(csum(
+                "raft.obs.quality.shadow.total")),
+            "calibration_gap": live.get("calibration_gap"),
+            "steady_state_compiles": int(compiles),
+            # shed/deadline behavior unchanged: a closed loop must not
+            # shed, and sampling must not make it start
+            "shed": int(csum("raft.serve.shed.total")),
+            "deadline_expired": int(csum("raft.serve.deadline.total")),
+            "serve_qps": round(sum(counts) / wall, 1),
+            "slo_recall_burn": slo_rep["recall_floor"]["burn"],
+            "slo_breaches": sorted(nm for nm, o in slo_rep.items()
+                                   if o["breach"])})
+    except Exception as e:
+        results.append({"metric": metric, "error": repr(e)[:200]})
+    finally:
+        if tracker is not None:
+            tracker.close()
+        server.close()
+
+
 def bench_brute_500k(results):
     # the IVF bench point's brute baseline, default-on so the
     # bfknn_fused_500k gate (wall-QPS floor 35k — see PERF_GATES) has
@@ -1392,7 +1534,7 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_flat, bench_ivf_flat_100k, bench_ivf_pq,
           bench_ivf_pq4,
           bench_ivf_bq, bench_serve, bench_serve_sharded,
-          bench_mutate, bench_chaos,
+          bench_mutate, bench_chaos, bench_quality,
           bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,

@@ -119,6 +119,10 @@ class Logger:
     def debug(self, msg, *a): self._log(DEBUG, msg, *a)
     def info(self, msg, *a): self._log(INFO, msg, *a)
     def warn(self, msg, *a): self._log(WARN, msg, *a)
+    # stdlib-logging spelling: half the ecosystem writes log.warning —
+    # a failure handler calling the missing alias once killed the
+    # compactor daemon (ISSUE 11 satellite; regression-tested)
+    def warning(self, msg, *a): self._log(WARN, msg, *a)
     def error(self, msg, *a): self._log(ERROR, msg, *a)
     def critical(self, msg, *a): self._log(CRITICAL, msg, *a)
 
@@ -154,6 +158,8 @@ class ChildLogger:
     def debug(self, msg, *a): self._log(DEBUG, msg, *a)
     def info(self, msg, *a): self._log(INFO, msg, *a)
     def warn(self, msg, *a): self._log(WARN, msg, *a)
+    # stdlib-logging spelling (see Logger.warning)
+    def warning(self, msg, *a): self._log(WARN, msg, *a)
     def error(self, msg, *a): self._log(ERROR, msg, *a)
     def critical(self, msg, *a): self._log(CRITICAL, msg, *a)
 

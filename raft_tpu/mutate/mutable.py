@@ -105,7 +105,7 @@ class MutableIndex:
                   "_delta_map", "_tomb", "_tomb_ids", "_next_id",
                   "_compacting", "_frozen_id_base", "_pending_tombs",
                   "_rep", "_rungs", "_grid", "_dist_cfg", "_wal",
-                  "_wal_ckpt")
+                  "_wal_ckpt", "_epoch_listeners")
 
     def __init__(self, index, k: int, params=None,
                  config: Optional[MutateConfig] = None):
@@ -147,6 +147,7 @@ class MutableIndex:
             self._dist_cfg: Optional[dict] = None
             self._wal: Optional[MutationWAL] = None
             self._wal_ckpt: Optional[str] = None
+            self._epoch_listeners: Tuple = ()
             self._dev: Optional[_DeviceState] = None
             self._push_dev_locked()
 
@@ -613,6 +614,35 @@ class MutableIndex:
                 "mutate: no mesh registered (register_dist)")
         return dist["plans"][(nq, rung_idx)]
 
+    # -- epoch listeners (ISSUE 11: quality observability) -----------------
+    def add_epoch_listener(self, fn) -> "MutableIndex":
+        """Register ``fn(new_epoch_number)`` to run after every
+        compaction epoch swap (on the compacting thread, OUTSIDE the
+        lock — listeners may touch this index). The quality monitor
+        subscribes its :meth:`~raft_tpu.obs.quality.QualityMonitor.
+        note_epoch` here so recall windows split exactly where the
+        fold did and ``raft.obs.quality.drift`` compares epoch against
+        epoch, not a smear across the swap."""
+        with self._cond:
+            self._epoch_listeners = self._epoch_listeners + (fn,)
+        return self
+
+    def _notify_epoch_listeners(self, number: int) -> None:
+        with self._cond:
+            listeners = self._epoch_listeners
+        from raft_tpu.core.logger import get_logger
+        for fn in listeners:
+            try:
+                fn(number)
+            except Exception as e:
+                obs.counter("raft.mutate.epoch_listener.errors").inc()
+                # warning(): the stdlib-spelling alias (ISSUE 11
+                # satellite) — the PR 10 compactor died calling it
+                # before the alias existed
+                get_logger("mutate").warning(
+                    "mutate: epoch listener %r failed for epoch %d: "
+                    "%r", fn, number, e)
+
     # -- compaction --------------------------------------------------------
     def compact(self, mode: Optional[str] = None, mesh=None,
                 axis: str = "data") -> bool:
@@ -669,6 +699,7 @@ class MutableIndex:
             self._swap_epoch(new_epoch, freeze_used, new_id_base,
                              ckpt_tmp=ckpt_tmp)
             obs.counter("raft.mutate.compact.total").inc()
+            self._notify_epoch_listeners(new_epoch.number)
             return True
         except BaseException:
             obs.counter("raft.mutate.compact.errors").inc()

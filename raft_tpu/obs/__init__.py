@@ -63,6 +63,8 @@ from raft_tpu.obs.spans import (
     add_stage_spans,
     set_trace_enabled,
     trace_enabled,
+    set_trace_sample_rate,
+    trace_sample_rate,
 )
 from raft_tpu.obs.recorder import FlightRecorder, RECORDER, to_chrome_trace
 from raft_tpu.obs.endpoint import DebugServer, serve
@@ -95,6 +97,8 @@ __all__ = [
     "add_stage_spans",
     "set_trace_enabled",
     "trace_enabled",
+    "set_trace_sample_rate",
+    "trace_sample_rate",
     "FlightRecorder",
     "RECORDER",
     "to_chrome_trace",

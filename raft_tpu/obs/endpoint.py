@@ -24,6 +24,12 @@ needs inspectable:
   ``format=chrome`` renders it (or, without ``trace``, the most
   recent) as Chrome-trace JSON — save the body and load it in
   Perfetto.
+* ``GET /debug/slo`` — the declarative SLO verdict
+  (:mod:`raft_tpu.obs.slo`): every objective's per-window burn rates
+  and breach flags, from the in-process :class:`~raft_tpu.obs.slo.
+  SLOTracker` when one runs (full report) or the exported
+  ``raft.slo.*`` gauges otherwise. Breached objectives also degrade
+  ``/healthz``.
 
 Use::
 
@@ -92,13 +98,39 @@ def _health_body(snapshot: dict) -> dict:
     mutate_stalled = _gsum("raft.mutate.delta.stalled")
     compactor_failing = _gsum("raft.mutate.compactor.failing")
     mutate_degraded = mutate_stalled > 0 or compactor_failing > 0
+    # SLO plane (ISSUE 11): a breached declared objective — p99 burn,
+    # availability burn, or the live recall floor — is a degraded box
+    # by definition: the operator declared what "acceptable" means,
+    # /healthz must honor it
+    slo_breaches = {k: v for k, v in gauges.items()
+                    if k.split("{")[0] == "raft.slo.breach" and v > 0}
+    slo_degraded = bool(slo_breaches)
     body = {
         "status": ("degraded" if (comms_degraded or serve_degraded
-                                  or mutate_degraded)
+                                  or mutate_degraded or slo_degraded)
                    else "ok"),
         "suspects": suspects,
         "max_staleness_seconds": staleness,
     }
+    if any(k.split("{")[0].startswith("raft.slo.") for k in gauges):
+        body["slo"] = {
+            "objectives": _gsum("raft.slo.objectives"),
+            "breaches": sorted(slo_breaches),
+        }
+    # quality plane (ISSUE 11): surface the live shadow-exact recall
+    # windows informationally (the recall FLOOR verdict rides the SLO
+    # plane above — raw recall being low is context, not by itself
+    # a health verdict)
+    quality = {k: v for k, v in gauges.items()
+               if k.split("{")[0] == "raft.obs.quality.recall"}
+    if quality:
+        body["quality"] = {
+            "recall": quality,
+            "drift": {k: v for k, v in gauges.items()
+                      if k.split("{")[0] in ("raft.obs.quality.drift",
+                                             "raft.obs.quality.drift"
+                                             ".alarm")},
+        }
     if any(k.split("{")[0].startswith("raft.mutate.") for k in gauges):
         body["mutate"] = {
             "epoch": _gsum("raft.mutate.epoch"),
@@ -173,10 +205,18 @@ class _Handler(BaseHTTPRequestHandler):
                                 body)
             elif path == "/debug/requests":
                 self._debug_requests(q)
+            elif path == "/debug/slo":
+                # lazy import: slo pulls the serve counter taxonomy —
+                # keep the endpoint importable without it resolved
+                from raft_tpu.obs import slo as _slo
+                body = _slo.endpoint_body(self.server.registry
+                                          .snapshot())
+                self._send_json(200, body)
             else:
                 self._send_json(404, {"error": f"no route {path!r}",
                                       "routes": ["/metrics", "/healthz",
-                                                 "/debug/requests"]})
+                                                 "/debug/requests",
+                                                 "/debug/slo"]})
         except BrokenPipeError:
             pass
 

@@ -1,0 +1,360 @@
+"""Declarative SLOs — multi-window burn rates over the obs registry.
+
+The serving metrics say what happened; an SLO says whether it was
+ACCEPTABLE — and "acceptable" must be declared once, not re-derived in
+every dashboard. An :class:`Objective` declares one contract:
+
+* ``kind="latency"`` — fraction of requests completing within
+  ``threshold_ms`` must be ≥ ``target`` (e.g. p99 under the 200 ms
+  watermark → ``threshold_ms=200, target=0.99``), read from the
+  ``raft.serve.request.seconds`` histogram buckets (pick a threshold
+  on a bucket edge — ``serve.SERVE_LATENCY_BUCKETS`` — or the check
+  conservatively rounds DOWN to the nearest edge);
+* ``kind="availability"`` — fraction of offered requests answered
+  (shed + deadline + error are the failures) must be ≥ ``target``,
+  from the ``raft.serve.{requests,shed,deadline,errors}`` counters;
+* ``kind="recall"`` — the live shadow-exact recall estimate
+  (``raft.obs.quality.recall`` full-coverage gauges, worst series)
+  must stay ≥ ``target``; burn = shortfall / ``tolerance``.
+
+Each objective is evaluated as **burn rates over multiple windows**
+(the SRE multi-window multi-burn pattern): burn = error rate ÷ error
+budget (``1 − target``), so burn 1.0 = exactly consuming budget,
+burn 10 = burning it 10× too fast. A **breach** requires EVERY window
+of the objective to burn ≥ ``burn_threshold`` — the short window
+proves it is happening NOW, the long window proves it is not a blip.
+
+Exported as ``raft.slo.burn_rate{objective,window}`` /
+``raft.slo.breach{objective}`` gauges (written into the same registry
+the tracker reads, so ``/healthz`` folds breaches into its degraded
+verdict and ``/debug/slo`` serves the full report — endpoint.py).
+
+Use::
+
+    from raft_tpu.obs import slo
+    tracker = slo.SLOTracker([
+        slo.Objective("p99_latency", "latency", target=0.99,
+                      threshold_ms=200.0),
+        slo.Objective("availability", "availability", target=0.999),
+        slo.Objective("recall_floor", "recall", target=0.85),
+    ])                      # polling daemon; tracker.close() to stop
+    tracker.report()        # {objective: {windows, burn, breach}, ...}
+
+Deterministic tests drive :meth:`SLOTracker.tick` with an injected
+clock instead of the polling thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+from raft_tpu.core.error import expects
+from raft_tpu.obs import registry as _registry
+
+__all__ = ["Objective", "SLOTracker", "active", "endpoint_body"]
+
+_KINDS = ("latency", "availability", "recall")
+_FAIL_COUNTERS = ("raft.serve.shed.total", "raft.serve.deadline.total",
+                  "raft.serve.errors.total")
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declared service objective (module docstring for kinds).
+
+    ``windows`` are seconds, ascending; ``burn_threshold`` is the
+    burn-rate level EVERY window must reach before the objective
+    breaches (1.0 = budget consumed exactly at the sustainable rate).
+    ``tolerance`` applies to ``recall`` only: the shortfall that
+    counts as burn 1.0."""
+
+    name: str
+    kind: str
+    target: float
+    threshold_ms: float = 0.0
+    tolerance: float = 0.02
+    windows: Tuple[float, ...] = (60.0, 300.0)
+    burn_threshold: float = 1.0
+    description: str = ""
+
+    def __post_init__(self):
+        expects(bool(self.name) and all(
+            c.isascii() and (c.islower() or c.isdigit() or c == "_")
+            for c in self.name),
+            "Objective: name %r must be a [a-z0-9_]+ token (it rides "
+            "as a metric label)", self.name)
+        expects(self.kind in _KINDS,
+                "Objective %r: kind must be one of %s", self.name,
+                _KINDS)
+        expects(0.0 < self.target < 1.0 if self.kind != "recall"
+                else 0.0 < self.target <= 1.0,
+                "Objective %r: target must be in (0, 1)", self.name)
+        expects(self.kind != "latency" or self.threshold_ms > 0,
+                "Objective %r: latency objectives need threshold_ms",
+                self.name)
+        expects(len(self.windows) >= 1
+                and list(self.windows) == sorted(set(self.windows))
+                and min(self.windows) > 0,
+                "Objective %r: windows must be ascending positive "
+                "seconds", self.name)
+        expects(self.tolerance > 0,
+                "Objective %r: tolerance must be > 0", self.name)
+
+
+def _sum_series(table: dict, name: str) -> float:
+    return sum(v for k, v in table.items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _latency_counts(snapshot: dict, threshold_s: float
+                    ) -> Tuple[float, float]:
+    """(total, over-threshold) request counts across every
+    ``raft.serve.request.seconds`` series. Bucket edges are inclusive
+    upper bounds; a threshold between edges rounds DOWN (conservative:
+    borderline-fast requests count as slow, never the reverse)."""
+    total = over = 0.0
+    for series, h in snapshot.get("histograms", {}).items():
+        base = series.split("{")[0]
+        if base != "raft.serve.request.seconds":
+            continue
+        total += h["count"]
+        good = 0.0
+        for edge, c in h["buckets"].items():
+            if edge != "+Inf" and float(edge) <= threshold_s + 1e-12:
+                good += c
+        over += h["count"] - good
+    return total, over
+
+
+def _recall_floor_value(snapshot: dict) -> Optional[float]:
+    """Worst full-coverage live recall across families/epochs (partial
+    failover series are availability, not quality — excluded)."""
+    vals = [v for k, v in snapshot.get("gauges", {}).items()
+            if k.split("{")[0] == "raft.obs.quality.recall"
+            and "coverage=partial" not in k]
+    return min(vals) if vals else None
+
+
+class SLOTracker:
+    """Evaluates a set of :class:`Objective`\\ s against periodic
+    registry snapshots and publishes ``raft.slo.*`` gauges. Runs a
+    polling daemon by default; tests call :meth:`tick` with an
+    injected ``clock``. Reads AND writes ``registry`` (default: the
+    process registry) so one snapshot carries signal and verdict."""
+
+    def __init__(self, objectives: Sequence[Objective],
+                 registry=None, poll_s: float = 1.0, clock=None,
+                 start: bool = True, install: bool = True):
+        objectives = tuple(objectives)
+        expects(len(objectives) > 0, "SLOTracker: need >= 1 objective")
+        expects(len({o.name for o in objectives}) == len(objectives),
+                "SLOTracker: objective names must be unique")
+        self.objectives = objectives
+        self._reg = registry if registry is not None \
+            else _registry.REGISTRY
+        self._poll_s = float(poll_s)
+        self._clock = clock if clock is not None else time.monotonic
+        horizon = max(max(o.windows) for o in objectives)
+        # ring of (t, snapshot-derived cumulative signals); one extra
+        # slot so a full window always has a sample at/behind its start
+        slots = int(horizon / max(self._poll_s, 1e-3)) + 2
+        self._ring: deque = deque(maxlen=min(slots, 100_000))
+        self._lock = threading.Lock()
+        self._report: Dict[str, dict] = {}
+        self._breached: set = set()
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # local alias named like the module-level registry facade so
+        # instrument call sites read (and lint) like every other
+        # instrumented module's
+        obs = self._reg
+        obs.gauge("raft.slo.objectives").set(len(objectives))
+        if install:
+            _install(self)
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "SLOTracker":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="raft-slo-tracker")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        _uninstall(self)
+
+    def __enter__(self) -> "SLOTracker":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _loop(self) -> None:
+        while not self._closed.wait(self._poll_s):
+            try:
+                self.tick()
+            except Exception:
+                self._reg.counter("raft.slo.errors.total").inc()
+
+    # -- evaluation --------------------------------------------------------
+    def _signals(self) -> dict:
+        snap = self._reg.snapshot()
+        counters = snap.get("counters", {})
+        sig = {
+            "requests": _sum_series(counters,
+                                    "raft.serve.requests.total"),
+            "failed": sum(_sum_series(counters, n)
+                          for n in _FAIL_COUNTERS),
+        }
+        for o in self.objectives:
+            if o.kind == "latency":
+                total, over = _latency_counts(snap,
+                                              o.threshold_ms / 1e3)
+                sig[f"lat_total:{o.name}"] = total
+                sig[f"lat_over:{o.name}"] = over
+            elif o.kind == "recall":
+                sig[f"recall:{o.name}"] = _recall_floor_value(snap)
+        return sig
+
+    def _window_start(self, now: float, w: float) -> Optional[dict]:
+        """The newest ring sample at or before ``now - w`` (None until
+        the ring covers the window — a cold tracker must not breach on
+        a half-filled window)."""
+        best = None
+        for t, sig in self._ring:
+            if t <= now - w + 1e-9:
+                best = sig
+            else:
+                break
+        return best
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Sample signals, evaluate every (objective × window) burn
+        rate, publish gauges, return the report dict."""
+        now = self._clock() if now is None else float(now)
+        sig = self._signals()
+        obs = self._reg      # lint-visible instrument call sites
+        with self._lock:
+            self._ring.append((now, sig))
+            report: Dict[str, dict] = {}
+            for o in self.objectives:
+                burns: Dict[str, Optional[float]] = {}
+                for w in o.windows:
+                    base = self._window_start(now, w)
+                    burns[f"{int(w)}s"] = self._burn(o, w, now, sig,
+                                                     base)
+                breach = (all(b is not None and b >= o.burn_threshold
+                              for b in burns.values())
+                          and len(burns) > 0)
+                for wl, b in burns.items():
+                    # -1 = no data yet (cold window / zero traffic) —
+                    # distinguishable from a genuine burn of 0
+                    obs.gauge("raft.slo.burn_rate", objective=o.name,
+                              window=wl).set(
+                        -1.0 if b is None else round(b, 6))
+                obs.gauge("raft.slo.breach", objective=o.name).set(
+                    1.0 if breach else 0.0)
+                if breach and o.name not in self._breached:
+                    obs.counter("raft.slo.breach.total",
+                                objective=o.name).inc()
+                (self._breached.add(o.name) if breach
+                 else self._breached.discard(o.name))
+                report[o.name] = {
+                    "kind": o.kind,
+                    "target": o.target,
+                    "burn_threshold": o.burn_threshold,
+                    "burn": {wl: (None if b is None else round(b, 4))
+                             for wl, b in burns.items()},
+                    "breach": breach,
+                }
+                if o.kind == "latency":
+                    report[o.name]["threshold_ms"] = o.threshold_ms
+                if o.kind == "recall":
+                    report[o.name]["live_recall"] = sig.get(
+                        f"recall:{o.name}")
+            obs.counter("raft.slo.evaluations.total").inc()
+            self._report = report
+            return report
+
+    def _burn(self, o: Objective, w: float, now: float,
+              now_sig: dict, base_sig: Optional[dict]
+              ) -> Optional[float]:
+        """Burn rate of one objective over one window → None while the
+        window has no data (cold start, zero traffic)."""
+        if o.kind == "recall":
+            # gauges are already windowed by the quality monitor; the
+            # SLO window uses the worst value sampled INSIDE it
+            vals = [v for t, sig in self._ring
+                    if t >= now - w - 1e-9
+                    for v in [sig.get(f"recall:{o.name}")]
+                    if v is not None]
+            if not vals:
+                return None
+            return max(0.0, o.target - min(vals)) / o.tolerance
+        if base_sig is None:
+            return None
+        if o.kind == "latency":
+            total = (now_sig[f"lat_total:{o.name}"]
+                     - base_sig.get(f"lat_total:{o.name}", 0.0))
+            bad = (now_sig[f"lat_over:{o.name}"]
+                   - base_sig.get(f"lat_over:{o.name}", 0.0))
+        else:  # availability
+            total = now_sig["requests"] - base_sig.get("requests", 0.0)
+            bad = now_sig["failed"] - base_sig.get("failed", 0.0)
+        if total <= 0:
+            return None
+        return (bad / total) / max(1e-9, 1.0 - o.target)
+
+    def report(self) -> Dict[str, dict]:
+        """Last :meth:`tick` result (evaluates once if never run)."""
+        with self._lock:
+            rep = dict(self._report)
+        return rep if rep else self.tick()
+
+
+# -- endpoint integration (one active tracker per process) ----------------
+_active_lock = threading.Lock()
+_active: Optional[SLOTracker] = None
+
+
+def _install(tracker: SLOTracker) -> None:
+    global _active
+    with _active_lock:
+        _active = tracker
+
+
+def _uninstall(tracker: SLOTracker) -> None:
+    global _active
+    with _active_lock:
+        if _active is tracker:
+            _active = None
+
+
+def active() -> Optional[SLOTracker]:
+    """The most recently constructed (still-open) tracker — what
+    ``/debug/slo`` serves."""
+    with _active_lock:
+        return _active
+
+
+def endpoint_body(snapshot: dict) -> dict:
+    """The ``/debug/slo`` response: the active tracker's full report
+    when one runs in-process, else the ``raft.slo.*`` gauges already
+    in ``snapshot`` (a scraped box whose tracker lives elsewhere)."""
+    tracker = active()
+    if tracker is not None:
+        return {"source": "tracker", "objectives": tracker.report()}
+    gauges = {k: v for k, v in snapshot.get("gauges", {}).items()
+              if k.split("{")[0].startswith("raft.slo.")}
+    return {"source": "gauges" if gauges else "none",
+            "gauges": gauges}

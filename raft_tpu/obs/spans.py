@@ -42,6 +42,14 @@ Semantics and caveats:
   no-ops the whole layer: ``span()`` returns one shared null object
   (nothing is allocated or recorded), runtime toggle via
   :func:`set_trace_enabled`.
+* **sampling** — ``RAFT_TPU_TRACE_SAMPLE`` (0.0–1.0, default 1.0)
+  admits only that fraction of REQUESTS into the recorder, keeping the
+  flight recorder affordable at high QPS: the decision happens once,
+  at the would-be root span; sampled-out requests reuse the shared
+  null span (a thread-local veto depth makes their nested ``span()``
+  calls share it too — a child can never start an orphan trace).
+  Runtime setter :func:`set_trace_sample_rate` (seedable for
+  deterministic tests).
 * **threads** — the active trace is thread-local; a trace never leaks
   across requests served on different threads.
 """
@@ -50,6 +58,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -66,6 +75,8 @@ __all__ = [
     "add_child_span",
     "set_trace_enabled",
     "trace_enabled",
+    "set_trace_sample_rate",
+    "trace_sample_rate",
 ]
 
 
@@ -74,7 +85,17 @@ def _env_enabled() -> bool:
         "0", "false", "off", "no")
 
 
+def _env_sample_rate() -> float:
+    try:
+        v = float(os.environ.get("RAFT_TPU_TRACE_SAMPLE", "1.0"))
+    except ValueError:
+        return 1.0
+    return min(max(v, 0.0), 1.0)
+
+
 _enabled = _env_enabled()
+_sample_rate = _env_sample_rate()
+_sample_rng = random.Random()
 _tls = threading.local()
 # itertools.count is atomic in CPython; ids only need process-local
 # uniqueness (the pid prefixes exported traces where it matters)
@@ -89,6 +110,21 @@ def set_trace_enabled(on: bool = True) -> None:
 
 def trace_enabled() -> bool:
     return _enabled
+
+
+def set_trace_sample_rate(rate: float, seed: Optional[int] = None
+                          ) -> None:
+    """Runtime per-request sampling rate (initial state from
+    ``RAFT_TPU_TRACE_SAMPLE``). ``seed`` re-seeds the admission RNG —
+    deterministic tests only."""
+    global _sample_rate
+    _sample_rate = min(max(float(rate), 0.0), 1.0)
+    if seed is not None:
+        _sample_rng.seed(seed)
+
+
+def trace_sample_rate() -> float:
+    return _sample_rate
 
 
 def _new_id() -> str:
@@ -232,11 +268,42 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+class _VetoSpan(_NullSpan):
+    """The shared null span of a SAMPLED-OUT request: state-free (all
+    bookkeeping lives in a thread-local depth counter), so one shared
+    instance serves every suppressed scope. The veto depth keeps every
+    nested ``span()`` of the rejected request on this same object —
+    without it, a child opened inside a sampled-out root would roll
+    its own admission and could record an orphan fragment trace."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _tls.veto = getattr(_tls, "veto", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.veto = max(0, getattr(_tls, "veto", 1) - 1)
+        return False
+
+
+_VETO_SPAN = _VetoSpan()
+
+
 def span(name: str, **attrs) -> Span:
     """Open a span named under the ``raft.<module>.<op>`` taxonomy.
-    Returns the shared null object when tracing is disabled."""
+    Returns the shared null object when tracing is disabled, or when
+    this would start a new trace and per-request sampling
+    (``RAFT_TPU_TRACE_SAMPLE``) rejects it."""
     if not _enabled:
         return _NULL_SPAN
+    if getattr(_tls, "trace", None) is None:
+        # root-span admission: one Bernoulli draw per request; the
+        # veto depth extends a rejection to the whole request
+        if getattr(_tls, "veto", 0):
+            return _VETO_SPAN
+        if _sample_rate < 1.0 and _sample_rng.random() >= _sample_rate:
+            return _VETO_SPAN
     return Span(name, attrs)
 
 

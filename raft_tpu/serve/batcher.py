@@ -150,6 +150,13 @@ class SearchServer:
         # watchdog helper (dispatcher-thread-only state, like the
         # LoadController: no lock because there is no sharing)
         self._worker: Optional[_DispatchWorker] = None
+        # quality observability (ISSUE 11): None until enable_quality
+        # attaches a monitor — with sampling off the hot path reads
+        # exactly this one flag; _quality_src/_quality_meta carry the
+        # mutable-epoch / family / metric context from_index learned
+        self._quality = None
+        self._quality_src = None
+        self._quality_meta: dict = {}
         obs.gauge("raft.serve.queue.max").set(self._cfg.max_queue)
         obs.gauge("raft.serve.queue.depth").set(0)
         obs.gauge("raft.serve.shed.rate").set(0.0)
@@ -170,7 +177,9 @@ class SearchServer:
         live epoch per call)."""
         config = config if config is not None else ServeConfig()
         from raft_tpu.mutate import MutableIndex, build_serve_ladder
+        meta = {"metric": getattr(index, "metric", None)}
         if isinstance(index, MutableIndex):
+            meta["family"] = index.family
             expects(k == index.k,
                     "serve.from_index: k=%d != MutableIndex k=%d "
                     "(fixed at its construction)", k, index.k)
@@ -182,11 +191,19 @@ class SearchServer:
                 probes_ladder=config.probes_ladder,
                 prewarm=config.prewarm)
         else:
+            # same resolver PlanLadder.build uses — an unsupported
+            # index fails identically either way, so no guard needed
+            from raft_tpu.neighbors import plan as plan_mod
+            meta["family"], _ = plan_mod._resolve_builder(index)
             ladder = PlanLadder.build(index, rep_queries, k, params,
                                       shapes=config.batch_sizes,
                                       probes_ladder=config.probes_ladder,
                                       prewarm=config.prewarm)
-        return cls(ladder, config, start=start)
+        srv = cls(ladder, config, start=start)
+        srv._quality_meta = meta
+        if isinstance(index, MutableIndex):
+            srv._quality_src = index
+        return srv
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "SearchServer":
@@ -210,6 +227,8 @@ class SearchServer:
         if self._worker is not None:
             self._worker.stop()
             self._worker = None
+        if self._quality is not None:
+            self._quality.close()
         # a never-started server still owes its queue explicit errors
         self._drain_closed()
 
@@ -232,6 +251,69 @@ class SearchServer:
     @property
     def config(self) -> ServeConfig:
         return self._cfg
+
+    # -- quality observability (ISSUE 11) ----------------------------------
+    @property
+    def quality(self):
+        """The attached :class:`raft_tpu.obs.quality.QualityMonitor`
+        (None while sampling is off)."""
+        return self._quality
+
+    def enable_quality(self, corpus, ids=None, metric=None,
+                       estimator=None, qconfig=None, family=None):
+        """Attach shadow-exact recall estimation: live queries are
+        reservoir-sampled at ``ServeConfig.quality_sample_rate`` and
+        replayed off the serving path through a pre-warmed exact
+        scorer over ``corpus`` (the index's rows — or a representative
+        bounded sample; ``raft_tpu.obs.quality`` docstring for the
+        sampled-corpus caveat). Returns the monitor, or None when the
+        configured rate is 0 (nothing is constructed — the hot path
+        stays at one flag read). For a mutable index the compaction
+        epoch listener is wired automatically, so recall is tracked
+        per epoch and ``raft.obs.quality.drift`` fires on a degrading
+        fold."""
+        rate = self._cfg.quality_sample_rate
+        if rate <= 0:
+            get_logger("serve").info(
+                "enable_quality: quality_sample_rate=0 — no monitor "
+                "attached (set it on ServeConfig to sample)")
+            return None
+        from raft_tpu.obs import quality as _quality
+        metric = metric if metric is not None \
+            else self._quality_meta.get("metric")
+        kwargs = {} if metric is None else {"metric": metric}
+        qcfg = qconfig if qconfig is not None \
+            else _quality.QualityConfig()
+        scorer = _quality.ExactScorer(
+            corpus, ids=ids, kmax=self._ladder.k,
+            max_rows=qcfg.max_rows, chunk=qcfg.chunk,
+            batch=qcfg.shadow_batch, seed=qcfg.seed, **kwargs)
+        monitor = _quality.QualityMonitor(
+            scorer, sample_rate=rate, config=qcfg,
+            family=(family if family is not None
+                    else self._quality_meta.get("family", "index")),
+            estimator=estimator)
+        return self.attach_quality(monitor)
+
+    def attach_quality(self, monitor):
+        """Attach an already-built monitor (tests inject fakes). Wires
+        the mutable-epoch listener when the server fronts a
+        :class:`~raft_tpu.mutate.MutableIndex`."""
+        src = self._quality_src
+        if src is not None:
+            src.add_epoch_listener(monitor.note_epoch)
+        self._quality = monitor
+        return monitor
+
+    def _quality_epoch(self) -> int:
+        src = self._quality_src
+        return int(src.epoch) if src is not None else 0
+
+    def _quality_detail(self) -> str:
+        """Shard attribution for coverage-flagged samples — the
+        distributed tier returns its current exclusion so a degraded
+        recall series names the missing shards."""
+        return ""
 
     # -- admission ---------------------------------------------------------
     def submit(self, queries, k: Optional[int] = None,
@@ -550,6 +632,12 @@ class SearchServer:
                       buckets=OCCUPANCY_BUCKETS).observe(rows / shape)
         partial = bool(getattr(plan, "partial", False))
         coverage = float(getattr(plan, "coverage", 1.0))
+        # quality sampling (ISSUE 11): ONE flag read per batch — None
+        # means sampling is off and nothing below allocates or runs
+        qm = self._quality
+        if qm is not None and err is None:
+            q_epoch = self._quality_epoch()
+            q_excl = self._quality_detail() if partial else ""
         off = 0
         for r in batch:
             if id(r) in dead:   # already failed with DeadlineExceeded
@@ -586,3 +674,9 @@ class SearchServer:
             r.future.set_result(
                 SearchResult(d_r, i_r, partial=True, coverage=coverage)
                 if partial else (d_r, i_r))
+            if qm is not None:
+                # shadow-exact sampling: a Bernoulli draw + bounded
+                # copy on this thread; the exact replay happens on the
+                # monitor's background thread, never in a batch slot
+                qm.offer(r.queries, i_r, r.k, epoch=q_epoch,
+                         coverage=coverage, excluded=q_excl)

@@ -503,6 +503,15 @@ class DistributedSearchServer(SearchServer):
         self._engage_failover(suspects)
         return self._failover.bind(shape, self._excluded)[1]
 
+    def _quality_detail(self) -> str:
+        """Shard attribution for coverage-flagged quality samples
+        (ISSUE 11): while failover is engaged, sampled partial results
+        carry the excluded ranks as a label, so a degraded
+        ``raft.obs.quality.recall`` series names WHICH shards' rows
+        were missing — explainable, not mysterious. Dispatcher-thread
+        state, read on the dispatcher thread."""
+        return ",".join(str(r) for r in self._excluded)
+
     @property
     def mesh(self):
         return self.ladder.plan_for(self.ladder.shapes[0], 0)[1].mesh
@@ -534,7 +543,11 @@ class DistributedSearchServer(SearchServer):
             fol = build_failover_ladder(
                 index, rep_queries, k, params, mesh=mesh, axis=axis,
                 shapes=config.batch_sizes, prewarm=config.prewarm)
-        return cls(ladder, config, start=start, failover_ladder=fol)
+        srv = cls(ladder, config, start=start, failover_ladder=fol)
+        srv._quality_meta = {"metric": getattr(index, "metric", None),
+                             "family": type(index).__module__
+                             .rsplit(".", 1)[-1]}
+        return srv
 
     @classmethod
     def from_mutable(cls, mindex, rep_queries, mesh=None,
@@ -562,4 +575,8 @@ class DistributedSearchServer(SearchServer):
             mindex, rep_queries, mesh=mesh, axis=axis,
             shapes=config.batch_sizes,
             probes_ladder=config.probes_ladder, merge=merge)
-        return cls(ladder, config, start=start)
+        srv = cls(ladder, config, start=start)
+        srv._quality_meta = {"metric": mindex.metric,
+                             "family": mindex.family}
+        srv._quality_src = mindex
+        return srv

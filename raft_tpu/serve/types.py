@@ -134,6 +134,18 @@ class ServeConfig:
     * ``failover_probe_ms`` — while failover is engaged, how often the
       dispatcher re-reads the suspect-rank gauges to decide whether
       the exclusion can be cleared (recovery back to the full mesh).
+
+    Quality observability (ISSUE 11 — docs/observability.md):
+
+    * ``quality_sample_rate`` — probability each served query is
+      reservoir-sampled for shadow-exact recall estimation
+      (``SearchServer.enable_quality`` + ``raft_tpu.obs.quality``).
+      ``0`` (the default) keeps the hot path at exactly one flag read:
+      no monitor is constructed, no thread runs, nothing allocates.
+      With sampling on, the shadow replay runs on a background thread
+      through a pre-warmed fixed-shape exact scorer — it never
+      occupies a serving batch slot and never compiles in steady
+      state.
     """
 
     batch_sizes: Tuple[int, ...] = (1, 8, 32, 128)
@@ -152,6 +164,7 @@ class ServeConfig:
     retry_backoff_mult: float = 2.0
     failover: bool = False
     failover_probe_ms: float = 1000.0
+    quality_sample_rate: float = 0.0
 
     def __post_init__(self):
         if not self.batch_sizes or list(self.batch_sizes) != sorted(
@@ -175,6 +188,9 @@ class ServeConfig:
         if self.retry_backoff_ms < 0 or self.retry_backoff_mult < 1.0:
             raise ValueError("ServeConfig: retry_backoff_ms must be >= 0 "
                              "and retry_backoff_mult >= 1.0")
+        if not 0.0 <= self.quality_sample_rate <= 1.0:
+            raise ValueError("ServeConfig: quality_sample_rate must be "
+                             "in [0, 1]")
 
 
 @dataclass

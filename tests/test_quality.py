@@ -1,0 +1,639 @@
+"""Quality observability tests (ISSUE 11): shadow-exact scorer
+exactness (planted ground truth, chunk tiling, metric orderings,
+bounded-sample mode), QualityMonitor semantics (known-overlap recall
+values, window roll-over, coverage attribution, calibration gap,
+epoch-tagged drift firing exactly past the budget boundary), the
+serving integration contracts (rate 0 = one flag read / no monitor;
+sampling ON = zero steady-state compiles and unchanged shed/deadline
+behavior, asserted from ``raft.*`` counters), the mutable-epoch
+listener wiring, the SLO tracker's multi-window burn/breach math and
+its /healthz + /debug/slo surfaces, and the satellites: the
+``logger.warning`` alias and ``RAFT_TPU_TRACE_SAMPLE`` per-request
+trace sampling."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import importlib
+
+from raft_tpu import obs
+
+# the raft_tpu.core package re-exports the singleton under the same
+# name as the submodule, shadowing it for attribute-style imports —
+# resolve the MODULE explicitly
+logger_mod = importlib.import_module("raft_tpu.core.logger")
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.obs import quality, slo, spans
+from raft_tpu.obs.registry import MetricsRegistry
+
+
+def _csum(snap, name):
+    return sum(v for k, v in snap["counters"].items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _gauges(name):
+    return {k: v for k, v in obs.snapshot()["gauges"].items()
+            if k.split("{")[0] == name}
+
+
+def _gauge_with(name, *label_frags):
+    for k, v in _gauges(name).items():
+        if all(f in k for f in label_frags):
+            return v
+    return None
+
+
+# ---------------------------------------------------------------------------
+# ExactScorer
+
+
+class TestExactScorer:
+    def test_matches_numpy_brute_force_across_chunks(self):
+        """Chunk tiling + tail padding must be invisible: the scorer's
+        ids equal a full numpy brute force at every query."""
+        rng = np.random.default_rng(0)
+        corpus = rng.normal(size=(777, 24)).astype(np.float32)  # ragged
+        sc = quality.ExactScorer(corpus, kmax=10, chunk=256, batch=8)
+        q = rng.normal(size=(13, 24)).astype(np.float32)
+        got = sc.topk(q, 7)
+        d = ((q[:, None, :] - corpus[None, :, :]) ** 2).sum(-1)
+        ref = np.argsort(d, axis=1, kind="stable")[:, :7]
+        # compare as sets per row (ties may order differently)
+        for r in range(len(q)):
+            assert set(got[r].tolist()) == set(ref[r].tolist())
+
+    def test_inner_product_ordering(self):
+        corpus = np.asarray([[1.0, 0.0], [0.0, 1.0], [3.0, 3.0],
+                             [-5.0, -5.0]], np.float32)
+        sc = quality.ExactScorer(corpus, kmax=4,
+                                 metric=DistanceType.InnerProduct,
+                                 batch=2, chunk=4)
+        ids = sc.topk(np.asarray([[1.0, 1.0]], np.float32), 2)
+        assert ids[0, 0] == 2          # largest dot product first
+        assert 3 not in ids[0]
+
+    def test_cosine_normalizes(self):
+        corpus = np.asarray([[10.0, 0.0], [0.0, 1.0],
+                             [0.7, 0.7]], np.float32)
+        sc = quality.ExactScorer(corpus, kmax=3,
+                                 metric=DistanceType.CosineExpanded,
+                                 batch=2, chunk=4)
+        ids = sc.topk(np.asarray([[0.1, 0.1]], np.float32), 1)
+        assert ids[0, 0] == 2          # direction, not magnitude
+
+    def test_custom_ids_ride_through(self):
+        corpus = np.eye(4, dtype=np.float32)
+        ids = np.asarray([100, 200, 300, 400])
+        sc = quality.ExactScorer(corpus, ids=ids, kmax=2, batch=2,
+                                 chunk=4)
+        got = sc.topk(corpus[2:3], 1)
+        assert got[0, 0] == 300
+
+    def test_bounded_sample_mode(self):
+        rng = np.random.default_rng(1)
+        corpus = rng.normal(size=(600, 8)).astype(np.float32)
+        sc = quality.ExactScorer(corpus, kmax=4, max_rows=128,
+                                 chunk=64, batch=4)
+        assert sc.sampled and sc.rows == 128
+        ids = sc.topk(corpus[:3], 4)
+        assert ids.shape == (3, 4) and np.all(ids >= 0)
+
+
+# ---------------------------------------------------------------------------
+# QualityMonitor (fake scorer: exact ids are always 0..k-1)
+
+
+class _FakeScorer:
+    def __init__(self, k=10):
+        self.k = k
+        self.calls = 0
+
+    def topk(self, queries, k):
+        self.calls += 1
+        return np.tile(np.arange(k, dtype=np.int64),
+                       (np.asarray(queries).shape[0], 1))
+
+
+def _served(k, hits):
+    """One served id row with exactly ``hits`` of the exact top-k."""
+    row = np.arange(k, dtype=np.int64)
+    row[hits:] = 10_000 + np.arange(k - hits)
+    return row[None, :]
+
+
+_Q = np.zeros((1, 4), np.float32)
+
+
+def _mon(**cfg_kw):
+    defaults = dict(window=64, min_window=4, drift_budget=0.1,
+                    poll_ms=5.0)
+    defaults.update(cfg_kw)
+    return quality.QualityMonitor(
+        _FakeScorer(), sample_rate=1.0, family="fake",
+        config=quality.QualityConfig(**defaults))
+
+
+class TestQualityMonitor:
+    def test_planted_recall_value(self):
+        """Hand-computable: 2 samples at 7/10 and 9/10 overlap →
+        windowed recall exactly 0.8."""
+        with _mon() as mon:
+            mon.offer(_Q, _served(10, 7), 10)
+            mon.offer(_Q, _served(10, 9), 10)
+            assert mon.drain(10.0)
+        assert mon.stats()["recall"] == pytest.approx(0.8)
+        assert _gauge_with("raft.obs.quality.recall", "family=fake",
+                           "epoch=0") == pytest.approx(0.8)
+
+    def test_window_roll_over(self):
+        """window=4: after 4 full-recall then 4 half-recall samples
+        the gauge reflects ONLY the last 4."""
+        with _mon(window=4) as mon:
+            for _ in range(4):
+                mon.offer(_Q, _served(10, 10), 10)
+            for _ in range(4):
+                mon.offer(_Q, _served(10, 5), 10)
+            assert mon.drain(10.0)
+            assert mon.stats()["recall"] == pytest.approx(0.5)
+
+    def test_coverage_attribution(self):
+        """Partial-coverage samples land in their own labeled series —
+        with the excluded shards named — and never touch the
+        full-coverage window."""
+        with _mon() as mon:
+            mon.offer(_Q, _served(10, 10), 10)
+            mon.offer(_Q, _served(10, 2), 10, coverage=0.75,
+                      excluded="1,3")
+            assert mon.drain(10.0)
+            assert mon.stats()["recall"] == pytest.approx(1.0)
+        v = _gauge_with("raft.obs.quality.recall", "coverage=partial",
+                        "excluded=1,3")
+        assert v == pytest.approx(0.2)
+
+    def test_calibration_gap(self):
+        """Estimator returning 6/10 of the exact set while serving
+        returns 10/10 → calibration gap exactly 0.4 — the online
+        version of the 0.13 bench drift."""
+        est = lambda q, k: np.tile(                       # noqa: E731
+            np.concatenate([np.arange(6), 10_000 + np.arange(k - 6)]),
+            (np.asarray(q).shape[0], 1))
+        mon = quality.QualityMonitor(
+            _FakeScorer(), sample_rate=1.0, family="cal",
+            estimator=est,
+            config=quality.QualityConfig(window=16, min_window=2,
+                                         poll_ms=5.0))
+        try:
+            for _ in range(3):
+                mon.offer(_Q, _served(10, 10), 10)
+            assert mon.drain(10.0)
+            st = mon.stats()
+            assert st["estimator_recall"] == pytest.approx(0.6)
+            assert st["calibration_gap"] == pytest.approx(0.4)
+            assert _gauge_with("raft.obs.quality.calibration.gap",
+                               "family=cal") == pytest.approx(0.4)
+        finally:
+            mon.close()
+
+    def test_drift_fires_exactly_past_budget(self):
+        """budget=0.1, epoch-0 baseline 1.0: an epoch-1 window at
+        recall 0.9 (drift == budget) must NOT fire; pushing the window
+        mean to 0.85 (drift 0.15 > budget) fires gauge + counter."""
+        before = obs.snapshot()
+        with _mon(min_window=4, drift_budget=0.1) as mon:
+            for _ in range(4):
+                mon.offer(_Q, _served(10, 10), 10, epoch=0)
+            assert mon.drain(10.0)
+            mon.note_epoch(1)
+            for _ in range(4):
+                mon.offer(_Q, _served(10, 9), 10, epoch=1)
+            assert mon.drain(10.0)
+            st = mon.stats()
+            assert st["drift"] == pytest.approx(0.1)
+            assert st["drift_alarm"] is False
+            assert _csum(obs.snapshot(), "raft.obs.quality.drift.total") \
+                == _csum(before, "raft.obs.quality.drift.total")
+            for _ in range(4):
+                mon.offer(_Q, _served(10, 8), 10, epoch=1)
+            assert mon.drain(10.0)
+            st = mon.stats()
+            assert st["drift"] == pytest.approx(0.15)
+            assert st["drift_alarm"] is True
+            assert _gauge_with("raft.obs.quality.drift.alarm",
+                               "family=fake") == 1.0
+            # one alarm per epoch, however many samples follow
+            mon.offer(_Q, _served(10, 8), 10, epoch=1)
+            assert mon.drain(10.0)
+            assert (_csum(obs.snapshot(),
+                          "raft.obs.quality.drift.total")
+                    - _csum(before, "raft.obs.quality.drift.total")) \
+                == 1.0
+
+    def test_epoch_rolls_implicitly_from_samples(self):
+        """A sample tagged with a newer epoch rolls the baseline even
+        without a note_epoch listener call."""
+        with _mon(min_window=2) as mon:
+            for _ in range(2):
+                mon.offer(_Q, _served(10, 10), 10, epoch=0)
+            assert mon.drain(10.0)
+            mon.offer(_Q, _served(10, 5), 10, epoch=3)
+            mon.offer(_Q, _served(10, 5), 10, epoch=3)
+            assert mon.drain(10.0)
+            st = mon.stats()
+            assert st["epoch"] == 3
+            assert st["drift"] == pytest.approx(0.5)
+
+    def test_reservoir_bounds_pending(self):
+        """max_pending bounds held samples; overflow reservoir-replaces
+        and counts evictions — memory can never grow with load."""
+        before = obs.snapshot()
+        mon = quality.QualityMonitor(
+            _FakeScorer(), sample_rate=1.0, family="rsv", start=False,
+            config=quality.QualityConfig(max_pending=8, poll_ms=5.0))
+        q = np.zeros((50, 4), np.float32)
+        ids = np.tile(np.arange(10, dtype=np.int64), (50, 1))
+        mon.offer(q, ids, 10)
+        assert len(mon._pending) == 8
+        evicted = (_csum(obs.snapshot(), "raft.obs.quality.evicted.total")
+                   - _csum(before, "raft.obs.quality.evicted.total"))
+        assert evicted == 42
+        mon.close()
+
+    def test_sample_rate_thins(self):
+        """rate=0.2 with a seeded RNG admits roughly that fraction."""
+        mon = quality.QualityMonitor(
+            _FakeScorer(), sample_rate=0.2, family="thin", start=False,
+            config=quality.QualityConfig(max_pending=4096, seed=7))
+        q = np.zeros((1000, 4), np.float32)
+        ids = np.tile(np.arange(10, dtype=np.int64), (1000, 1))
+        mon.offer(q, ids, 10)
+        assert 120 <= len(mon._pending) <= 300
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# Serving integration
+
+
+@pytest.fixture(scope="module")
+def served_setup():
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.random import make_blobs
+    x, _ = make_blobs(n_samples=2000, n_features=16, centers=12,
+                      seed=0)
+    q, _ = make_blobs(n_samples=64, n_features=16, centers=12, seed=1)
+    x, q = np.asarray(x), np.asarray(q)
+    index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                   kmeans_n_iters=3))
+    return x, q, index
+
+
+class TestServingIntegration:
+    def test_rate_zero_attaches_nothing(self, served_setup):
+        """quality_sample_rate=0: enable_quality is a no-op — the hot
+        path keeps reading one None flag, no monitor/thread/metrics."""
+        from raft_tpu import serve
+        from raft_tpu.neighbors import ivf_flat
+        x, q, index = served_setup
+        srv = serve.SearchServer.from_index(
+            index, q[:8], 8, params=ivf_flat.SearchParams(n_probes=8),
+            config=serve.ServeConfig(batch_sizes=(1, 8)))
+        try:
+            before = obs.snapshot()
+            assert srv.enable_quality(x) is None
+            assert srv.quality is None
+            srv.search(q[:1])
+            after = obs.snapshot()
+            assert _csum(after, "raft.obs.quality.sampled.total") == \
+                _csum(before, "raft.obs.quality.sampled.total")
+        finally:
+            srv.close()
+
+    def test_zero_compiles_and_unchanged_shed_with_sampling(
+            self, served_setup):
+        """The acceptance contract: sampling ON, a warmed serving loop
+        shows ZERO plan compiles, zero shed/deadline, and a live
+        recall of exactly 1.0 at exhaustive probes (served == exact ==
+        scorer) — all from ``raft.*`` counters."""
+        from raft_tpu import serve
+        from raft_tpu.neighbors import ivf_flat
+        x, q, index = served_setup
+        cfg = serve.ServeConfig(batch_sizes=(1, 4, 16),
+                                quality_sample_rate=1.0)
+        srv = serve.SearchServer.from_index(
+            index, q[:16], 8, params=ivf_flat.SearchParams(n_probes=8),
+            config=cfg)
+        try:
+            mon = srv.enable_quality(
+                x, qconfig=quality.QualityConfig(window=256,
+                                                 shadow_batch=8,
+                                                 poll_ms=5.0))
+            assert mon is srv.quality
+            # warm: every ladder shape + the scorer program ran
+            for s in range(4):
+                srv.search(q[s:s + 1])
+            assert mon.drain(30.0)
+            before = obs.snapshot()
+            for s in range(32):
+                srv.search(q[s % 64:s % 64 + 1])
+            assert mon.drain(30.0)
+            diff_after = obs.snapshot()
+            for name in ("raft.plan.cache.misses",
+                         "raft.plan.build.total",
+                         "raft.serve.shed.total",
+                         "raft.serve.deadline.total"):
+                assert _csum(diff_after, name) == _csum(before, name), \
+                    name
+            sampled = (_csum(diff_after, "raft.obs.quality.samples.total")
+                       - _csum(before, "raft.obs.quality.samples.total"))
+            assert sampled == 32
+            # exhaustive probes: served ids ARE exact → recall 1.0
+            assert mon.stats()["recall"] == pytest.approx(1.0)
+        finally:
+            srv.close()
+
+    def test_mutable_epoch_listener_fires_on_compact(self, served_setup):
+        """The mutate/ wiring: compaction epoch swaps invoke
+        registered listeners with the new epoch number; a broken
+        listener is contained (counted, compaction still succeeds)."""
+        from raft_tpu import mutate
+        x, q, index = served_setup
+        m = mutate.MutableIndex(index, k=8)
+        calls = []
+        m.add_epoch_listener(calls.append)
+        m.upsert(x[:4] + 0.25)
+        assert m.compact() is True
+        assert calls == [1]
+        before = obs.snapshot()
+
+        def bad(_epoch):
+            raise RuntimeError("boom")
+
+        m.add_epoch_listener(bad)
+        m.upsert(x[4:8] + 0.25)
+        assert m.compact() is True
+        assert calls == [1, 2]
+        assert (_csum(obs.snapshot(),
+                      "raft.mutate.epoch_listener.errors")
+                - _csum(before, "raft.mutate.epoch_listener.errors")) \
+            == 1.0
+
+    def test_serve_config_validates_rate(self):
+        from raft_tpu import serve
+        with pytest.raises(ValueError):
+            serve.ServeConfig(quality_sample_rate=1.5)
+        with pytest.raises(ValueError):
+            serve.ServeConfig(quality_sample_rate=-0.1)
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+
+
+def _tracker(objectives, reg, clock):
+    return slo.SLOTracker(objectives, registry=reg, poll_s=1.0,
+                          clock=clock, start=False, install=False)
+
+
+class TestSLO:
+    def test_availability_burn_and_breach(self):
+        """5 failures per 10 offered at target 0.9 → error rate 0.5 /
+        budget 0.1 = burn 5.0 on the short window; breach only once
+        the LONG window burns too (multi-window rule)."""
+        reg = MetricsRegistry(enabled=True)
+        t = [0.0]
+        tr = _tracker([slo.Objective("avail", "availability",
+                                     target=0.9,
+                                     windows=(10.0, 30.0))],
+                      reg, lambda: t[0])
+        reg.counter("raft.serve.requests.total").inc(10)
+        tr.tick()
+        for step in range(1, 16):
+            t[0] = float(step)
+            reg.counter("raft.serve.requests.total").inc(10)
+            reg.counter("raft.serve.shed.total", reason="x").inc(5)
+            rep = tr.tick()
+        # 15 s of burning: 10 s window saturated, 30 s window not yet
+        # coverable → burn None there, so NOT breached
+        assert rep["avail"]["burn"]["10s"] == pytest.approx(5.0)
+        assert rep["avail"]["burn"]["30s"] is None
+        assert rep["avail"]["breach"] is False
+        for step in range(16, 40):
+            t[0] = float(step)
+            reg.counter("raft.serve.requests.total").inc(10)
+            reg.counter("raft.serve.shed.total", reason="x").inc(5)
+            rep = tr.tick()
+        assert rep["avail"]["burn"]["30s"] == pytest.approx(5.0)
+        assert rep["avail"]["breach"] is True
+        snap = reg.snapshot()
+        assert snap["gauges"]["raft.slo.breach{objective=avail}"] == 1.0
+        assert _csum(snap, "raft.slo.breach.total") == 1.0
+
+    def test_latency_burn_from_histogram(self):
+        """10 fast + 10 slow requests at target 0.5/100 ms → half over
+        threshold, budget 0.5 → burn exactly 1.0."""
+        from raft_tpu.serve import SERVE_LATENCY_BUCKETS
+        reg = MetricsRegistry(enabled=True)
+        t = [0.0]
+        tr = _tracker([slo.Objective("lat", "latency", target=0.5,
+                                     threshold_ms=100.0,
+                                     windows=(10.0,))],
+                      reg, lambda: t[0])
+        tr.tick()
+        h = reg.histogram("raft.serve.request.seconds",
+                          buckets=SERVE_LATENCY_BUCKETS)
+        for _ in range(10):
+            h.observe(0.02)
+        for _ in range(10):
+            h.observe(0.4)
+        t[0] = 10.0
+        rep = tr.tick()
+        assert rep["lat"]["burn"]["10s"] == pytest.approx(1.0)
+        assert rep["lat"]["breach"] is True
+
+    def test_recall_objective_reads_quality_gauge(self):
+        """Live recall 0.5 under a 0.75 floor at tolerance 0.05 →
+        burn 5; partial-coverage series are ignored."""
+        reg = MetricsRegistry(enabled=True)
+        t = [0.0]
+        reg.gauge("raft.obs.quality.recall", family="f",
+                  epoch="0").set(0.5)
+        reg.gauge("raft.obs.quality.recall", family="f", epoch="0",
+                  coverage="partial").set(0.01)
+        tr = _tracker([slo.Objective("floor", "recall", target=0.75,
+                                     tolerance=0.05, windows=(10.0,))],
+                      reg, lambda: t[0])
+        rep = tr.tick()
+        assert rep["floor"]["burn"]["10s"] == pytest.approx(5.0)
+        assert rep["floor"]["live_recall"] == pytest.approx(0.5)
+        assert rep["floor"]["breach"] is True
+        # recovery clears the breach
+        reg.gauge("raft.obs.quality.recall", family="f",
+                  epoch="0").set(0.9)
+        t[0] = 20.0  # old low samples age out of the 10 s window
+        t[0] = 31.0
+        rep = tr.tick()
+        t[0] = 42.0
+        rep = tr.tick()
+        assert rep["floor"]["burn"]["10s"] == pytest.approx(0.0)
+        assert rep["floor"]["breach"] is False
+
+    def test_no_data_windows_do_not_breach(self):
+        reg = MetricsRegistry(enabled=True)
+        tr = _tracker([slo.Objective("avail", "availability",
+                                     target=0.99, windows=(10.0,))],
+                      reg, lambda: 0.0)
+        rep = tr.tick()
+        assert rep["avail"]["burn"]["10s"] is None
+        assert rep["avail"]["breach"] is False
+
+    def test_objective_validation(self):
+        with pytest.raises(Exception):
+            slo.Objective("Bad Name", "latency", target=0.9,
+                          threshold_ms=10.0)
+        with pytest.raises(Exception):
+            slo.Objective("x", "latency", target=0.9)  # no threshold
+        with pytest.raises(Exception):
+            slo.Objective("x", "nope", target=0.9)
+
+    def test_endpoint_slo_route_and_healthz_fold(self):
+        """/debug/slo serves the active tracker's report; a breach
+        gauge flips /healthz to 503 relative to its own baseline."""
+        def get(url):
+            try:
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        srv = obs.serve(port=0)
+        try:
+            code_before, _ = get(srv.url + "/healthz")
+            tr = slo.SLOTracker(
+                [slo.Objective("route_obj", "availability",
+                               target=0.9, windows=(5.0,))],
+                start=False)     # installs as the active tracker
+            try:
+                code, body = get(srv.url + "/debug/slo")
+                assert code == 200 and body["source"] == "tracker"
+                assert "route_obj" in body["objectives"]
+                obs.gauge("raft.slo.breach", objective="route_obj") \
+                    .set(1.0)
+                code, body = get(srv.url + "/healthz")
+                assert code == 503 and body["status"] == "degraded"
+                assert ("raft.slo.breach{objective=route_obj}"
+                        in body["slo"]["breaches"])
+                obs.gauge("raft.slo.breach", objective="route_obj") \
+                    .set(0.0)
+                code, _ = get(srv.url + "/healthz")
+                assert code == code_before
+            finally:
+                tr.close()
+            # tracker gone: the route falls back to exported gauges
+            code, body = get(srv.url + "/debug/slo")
+            assert code == 200 and body["source"] == "gauges"
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: logger.warning alias + trace sampling
+
+
+class TestLoggerWarningAlias:
+    def test_warning_alias_on_singleton_and_children(self):
+        """The PR 10 compactor died calling log.warning on a logger
+        that only had warn() — both spellings must now log at WARN."""
+        records = []
+        logger_mod.set_callback(lambda lvl, msg: records.append(
+            (lvl, msg)))
+        try:
+            logger_mod.logger.warning("top %s", "x")
+            logger_mod.get_logger("qtest").warning("child %d", 2)
+        finally:
+            logger_mod.set_callback(None)
+        assert any(lvl == logger_mod.WARN and "top x" in msg
+                   for lvl, msg in records)
+        assert any(lvl == logger_mod.WARN and "child 2" in msg
+                   for lvl, msg in records)
+
+    def test_warning_respects_level(self):
+        records = []
+        logger_mod.set_callback(lambda lvl, msg: records.append(msg))
+        old = logger_mod.logger.get_level()
+        try:
+            logger_mod.set_level(logger_mod.ERROR)
+            logger_mod.get_logger("qtest").warning("dropped")
+        finally:
+            logger_mod.set_level(old)
+            logger_mod.set_callback(None)
+        assert not any("dropped" in m for m in records)
+
+
+class TestTraceSampling:
+    def teardown_method(self):
+        spans.set_trace_sample_rate(1.0)
+
+    def test_sampled_out_reuses_shared_null_span(self):
+        """rate=0: every would-be root is the ONE shared veto span,
+        nested spans inherit the rejection, and nothing is recorded."""
+        spans.set_trace_sample_rate(0.0)
+        n_before = len(obs.RECORDER.requests())
+        root = spans.span("raft.serve.request")
+        assert root is spans._VETO_SPAN
+        with root:
+            child = spans.span("raft.serve.execute")
+            assert child is spans._VETO_SPAN      # no orphan traces
+            with child:
+                child.set_attr("x", 1)            # null API accepted
+        assert getattr(spans._tls, "veto", 0) == 0
+        assert len(obs.RECORDER.requests()) == n_before
+
+    def test_full_rate_records(self):
+        spans.set_trace_sample_rate(1.0)
+        n_before = len(obs.RECORDER.requests())
+        with spans.span("raft.serve.request"):
+            with spans.span("raft.serve.execute"):
+                pass
+        assert len(obs.RECORDER.requests()) >= min(n_before + 1, 1)
+
+    def test_partial_rate_admits_a_fraction(self):
+        spans.set_trace_sample_rate(0.5, seed=1234)
+        admitted = sum(
+            1 for _ in range(200)
+            if spans.span("raft.serve.request") is not
+            spans._VETO_SPAN)
+        assert 60 <= admitted <= 140
+
+    def test_active_trace_is_never_resampled(self):
+        """Children of an ADMITTED trace record even at rate 0 — the
+        decision is per-request, made once at the root."""
+        spans.set_trace_sample_rate(1.0)
+        with spans.span("raft.serve.request"):
+            spans.set_trace_sample_rate(0.0)
+            child = spans.span("raft.serve.execute")
+            assert child is not spans._VETO_SPAN
+            with child:
+                pass
+
+    def test_env_parse(self):
+        import os
+        old = os.environ.get("RAFT_TPU_TRACE_SAMPLE")
+        try:
+            os.environ["RAFT_TPU_TRACE_SAMPLE"] = "0.25"
+            assert spans._env_sample_rate() == pytest.approx(0.25)
+            os.environ["RAFT_TPU_TRACE_SAMPLE"] = "junk"
+            assert spans._env_sample_rate() == 1.0
+            os.environ["RAFT_TPU_TRACE_SAMPLE"] = "7"
+            assert spans._env_sample_rate() == 1.0   # clamped
+        finally:
+            if old is None:
+                os.environ.pop("RAFT_TPU_TRACE_SAMPLE", None)
+            else:
+                os.environ["RAFT_TPU_TRACE_SAMPLE"] = old

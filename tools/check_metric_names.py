@@ -146,6 +146,19 @@ REQUIRED_NAMES = (
     "raft.mutate.wal.torn.total",
     "raft.mutate.compactor.errors",
     "raft.mutate.compactor.failing",
+    # quality observability (ISSUE 11): the live shadow-exact recall
+    # window gauges, the online estimator-calibration gap, the
+    # epoch-drift trigger ROADMAP item 5's fold→rebuild policy
+    # consumes, and the declarative SLO burn/breach gauges /healthz
+    # and /debug/slo read
+    "raft.obs.quality.recall",
+    "raft.obs.quality.samples.total",
+    "raft.obs.quality.sampled.total",
+    "raft.obs.quality.calibration.gap",
+    "raft.obs.quality.drift",
+    "raft.obs.quality.drift.total",
+    "raft.slo.burn_rate",
+    "raft.slo.breach",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -184,6 +197,10 @@ REQUIRED_SPAN_NAMES = (
     # batch root (attempt, backoff, error class as attrs) so a traced
     # request shows its failure story, not only its latency
     "raft.serve.retry",
+    # quality observability (ISSUE 11): each shadow-exact replay batch
+    # opens one span (family, query count) — off the serving path, so
+    # it roots its own trace
+    "raft.obs.quality.shadow",
 )
 
 

@@ -266,7 +266,8 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
                        probes_ladder, deadline_ms: float,
                        server: str = "single",
                        mutate_frac: float = 0.0,
-                       chaos: bool = False):
+                       chaos: bool = False,
+                       quality_sample: float = 0.0):
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.random import make_blobs
@@ -287,7 +288,10 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
         dispatch_timeout_ms=500.0 if chaos else 0.0,
         max_retries=2 if chaos else 0,
         failover=bool(chaos and server == "dist"),
-        failover_probe_ms=500.0)
+        failover_probe_ms=500.0,
+        # quality observability (ISSUE 11): reservoir-sample served
+        # queries for shadow-exact recall — the live-recall column
+        quality_sample_rate=quality_sample)
     if server == "dist":
         # the mesh-wide tier (ISSUE 8): list-shard the index over every
         # local device, serve through the distributed plan ladder with
@@ -304,6 +308,8 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
         params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
         srv = serve.DistributedSearchServer.from_sharded_index(
             sindex, q[:32], k=k, params=params, mesh=mesh, config=cfg)
+        if quality_sample > 0:
+            srv.enable_quality(x)
         return srv, q, None
     index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
                                                    kmeans_n_iters=4))
@@ -316,9 +322,16 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
         mindex = mutate.MutableIndex(index, k=k, params=params)
         srv = serve.SearchServer.from_index(mindex, q[:32], k=k,
                                             config=cfg)
+        if quality_sample > 0:
+            # ground truth snapshots the pre-mutation corpus (module
+            # docstring caveat); epoch drift still compares fold
+            # against fold via the auto-wired epoch listener
+            srv.enable_quality(x)
         return srv, q, mindex
     srv = serve.SearchServer.from_index(index, q[:32], k=k,
                                         params=params, config=cfg)
+    if quality_sample > 0:
+        srv.enable_quality(x)
     return srv, q, None
 
 
@@ -362,10 +375,17 @@ def main(argv=None) -> int:
                          "(upsert/delete against a MutableIndex with a "
                          "background compactor) instead of searches — "
                          "mixed read/write traffic; single server only")
+    ap.add_argument("--quality-sample", type=float, default=None,
+                    help="shadow-exact recall sampling rate in [0, 1] "
+                         "(ISSUE 11): sampled queries replay through "
+                         "an exact scorer off the serving path and the "
+                         "report gains a live_recall column (default: "
+                         "0, or 0.25 under --demo)")
     ap.add_argument("--demo", action="store_true",
                     help="overload demo: offer 2x the calibrated "
                          "sustainable rate and show the ladder holding "
-                         "p99 while recall steps down")
+                         "p99 while recall steps down — the report "
+                         "includes live recall and the SLO burn rates")
     ap.add_argument("--chaos", type=str, default=None,
                     help="fault schedule driven during the run, e.g. "
                          "'stall_shard:3@t+10s,kill_compactor@t+20s' "
@@ -396,14 +416,35 @@ def main(argv=None) -> int:
                  "calibration phase would skew the event offsets)")
 
     ladder = tuple(int(s) for s in args.probes_ladder.split(","))
+    quality_sample = (args.quality_sample if args.quality_sample
+                      is not None else (0.25 if args.demo else 0.0))
     srv, q, mindex = _build_demo_server(
         args.n, args.dim, args.n_lists, args.k, ladder,
         args.deadline_ms, server=args.server,
-        mutate_frac=args.mutate_frac, chaos=bool(chaos_events))
+        mutate_frac=args.mutate_frac, chaos=bool(chaos_events),
+        quality_sample=quality_sample)
     comp = None
     if mindex is not None:
         from raft_tpu import mutate
         comp = mutate.Compactor(mindex)
+    slo_tracker = None
+    if args.demo:
+        # declarative SLOs over the run (ISSUE 11): the p99 watermark,
+        # availability, and — when sampling is on — the recall floor,
+        # each as multi-window burn rates in the final report
+        from raft_tpu.obs import slo as _slo
+        objectives = [
+            _slo.Objective("p99_watermark", "latency", target=0.99,
+                           threshold_ms=srv.config.degrade_watermark_ms,
+                           windows=(5.0, 15.0)),
+            _slo.Objective("availability", "availability",
+                           target=0.999, windows=(5.0, 15.0)),
+        ]
+        if srv.quality is not None:
+            objectives.append(_slo.Objective(
+                "recall_floor", "recall", target=0.5, tolerance=0.05,
+                windows=(5.0, 15.0)))
+        slo_tracker = _slo.SLOTracker(objectives, poll_s=0.5)
     try:
         if args.demo:
             from raft_tpu import obs
@@ -422,6 +463,15 @@ def main(argv=None) -> int:
             report["watermark_ms"] = srv.config.degrade_watermark_ms
             report["p99_under_watermark"] = (
                 report["p99_ms"] <= srv.config.degrade_watermark_ms)
+            if srv.quality is not None:
+                # live recall column: shadow-exact estimate over the
+                # sampled window, next to the p99 it was bought at
+                srv.quality.drain(10.0)
+                report["live_recall"] = srv.quality.stats()
+            if slo_tracker is not None:
+                report["slo"] = {
+                    name: {"burn": o["burn"], "breach": o["breach"]}
+                    for name, o in slo_tracker.tick().items()}
             if args.server == "dist":
                 # what each degradation rung cost on the wire, next to
                 # the p99 it bought (ISSUE 8 satellite)
@@ -454,6 +504,9 @@ def main(argv=None) -> int:
                 stop.set()
                 if chaos_t is not None:
                     chaos_t.join(timeout=10.0)
+            if srv.quality is not None:
+                srv.quality.drain(10.0)
+                report["live_recall"] = srv.quality.stats()
             if chaos_events:
                 from raft_tpu import obs
                 g = obs.snapshot()["gauges"]
@@ -466,6 +519,8 @@ def main(argv=None) -> int:
                 }
             print(json.dumps(report), flush=True)
     finally:
+        if slo_tracker is not None:
+            slo_tracker.close()
         if comp is not None:
             comp.close()
         srv.close()

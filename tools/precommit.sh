@@ -89,6 +89,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# quality observability (ISSUE 11): shadow-exact scorer exactness,
+# estimator windowing/drift-at-the-budget-boundary semantics, the
+# zero-compile-with-sampling-active contract, SLO burn/breach math,
+# and the logger.warning / trace-sampling satellites.
+echo "precommit: quality observability tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # distributed serving tier (ISSUE 8): the int8 merge codec round-trip
 # + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
 # mesh, pad-row non-leakage through the distributed scatter, and the

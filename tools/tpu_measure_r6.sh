@@ -17,6 +17,9 @@
 #   ch0  chaos row (ISSUE 10): one shard stalled mid-load on real
 #        hardware — availability / partial fraction / bounded p99 /
 #        zero failure-path compiles through failover + recovery
+#   q0   quality row (ISSUE 11): live shadow-exact recall estimate vs
+#        the offline recall at the same operating point (gap ≤ 0.05),
+#        zero steady-state compiles with sampling active
 #   h1   headline bench (driver format) so the round has fresh
 #        single-device context for the dist comparison
 #   g0   full gated suite (PERF/RECALL/GAP gates end-to-end on TPU)
@@ -81,6 +84,12 @@ ch0() {  # chaos row (ISSUE 10): stalled shard → watchdog → retry →
   cp -f "$OUT/chaos_r6.log" docs/measurements/
 }
 
+q0() {  # quality-observability row (ISSUE 11): live vs offline recall
+  BENCH_QUALITY_N=500000 python bench_suite.py quality \
+    2>&1 | tee "$OUT/quality_r6.log"
+  cp -f "$OUT/quality_r6.log" docs/measurements/
+}
+
 h1() {  # headline bench rows (driver format, embedded measured_at)
   python bench.py 2>&1 | tee "$OUT/headline_r6.log"
   cp -f "$OUT/headline_r6.log" docs/measurements/
@@ -95,6 +104,7 @@ run ds0 ds0
 run ds1 ds1
 run mu0 mu0
 run ch0 ch0
+run q0 q0
 run h1 h1
 run g0 g0
 echo "[$(stamp)] == r6 campaign complete"
