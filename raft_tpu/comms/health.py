@@ -140,10 +140,22 @@ class HealthMonitor:
                     try:
                         self._client.key_value_delete(
                             self._key(self.rank, r))
-                    except Exception:
+                    except Exception:  # graftlint: disable=GL006
+                        # justified swallow: key retirement is
+                        # best-effort by design — transports without
+                        # delete support raise on EVERY beat, and the
+                        # _CHECKPOINT multiples bound the KV footprint
+                        # regardless; counting here would page on a
+                        # non-failure
                         pass
             except Exception:
-                pass  # a dropped beat is indistinguishable from latency
+                # a dropped beat is indistinguishable from latency to
+                # the PEERS (their staleness clock judges), but the
+                # publisher itself must not hide the failure: a
+                # persistently erroring transport looks exactly like
+                # our own death from outside
+                obs.counter("raft.comms.health.errors",
+                            op="beat").inc()
         else:
             self._board.publish(self.session, self.rank, self._seq)
 

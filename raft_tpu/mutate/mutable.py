@@ -256,8 +256,12 @@ class MutableIndex:
                 # an append that made it to disk without the apply
                 # (crash in between) replays harmlessly — the caller
                 # was never acked, and at-least-once replay of explicit
-                # ids reproduces the same logical state
-                self._wal.append_upsert(ids_arr, x)
+                # ids reproduces the same logical state.  The fsync
+                # MUST happen under the mutation lock (GL008): the log
+                # must preserve the total mutation order the lock
+                # defines, and durable-before-apply is only atomic
+                # while the lock pins the apply.
+                self._wal.append_upsert(ids_arr, x)  # graftlint: disable=GL008
             slots = np.arange(self._delta_used, self._delta_used + n)
             self._delta_data[slots] = x
             self._delta_norms[slots] = (x * x).sum(axis=1)
@@ -287,7 +291,10 @@ class MutableIndex:
         hit = 0
         with self._cond:
             if self._wal is not None:
-                self._wal.append_delete(ids_arr)
+                # same justified hold as upsert's append (GL008): the
+                # WAL's total-order + durable-before-apply contract is
+                # defined BY this lock
+                self._wal.append_delete(ids_arr)  # graftlint: disable=GL008
             for id_ in ids_arr:
                 id_ = int(id_)
                 dead = False
@@ -333,9 +340,14 @@ class MutableIndex:
         cap = self.cfg.delta_capacities[rung]
         try:
             faults.inject("mutate.transfer", epoch=self._epoch.number)
+            # justified hold (GL008): these host->device transfers are
+            # bounded by the delta rung capacity (a few MB, never a
+            # compile) and MUST be atomic with the host-state change —
+            # publishing _dev outside the lock would let an older
+            # refresh overwrite a newer one (ABA on the snapshot)
             self._dev = _DeviceState(
                 epoch_number=self._epoch.number, rung=rung,
-                delta_data=jnp.asarray(self._delta_data[:cap]),
+                delta_data=jnp.asarray(self._delta_data[:cap]),  # graftlint: disable=GL008
                 delta_norms=jnp.asarray(self._delta_norms[:cap]),
                 delta_ids=jnp.asarray(self._delta_ids[:cap]),
                 tomb=jnp.asarray(self._tomb))
@@ -760,7 +772,12 @@ class MutableIndex:
                 # checkpoint — at-least-once, same logical state)
                 os.replace(ckpt_tmp, self._wal_ckpt)
                 live = self._delta_ids[:self._delta_used] >= 0
-                self._wal.rewrite(
+                # justified hold (GL008): the checkpoint promotion and
+                # the log truncation to the still-pending tail must be
+                # atomic with the epoch swap itself — a mutation landing
+                # between swap and rewrite would be lost from the log;
+                # this runs once per compaction, on the compactor thread
+                self._wal.rewrite(  # graftlint: disable=GL008
                     meta={"epoch": new_epoch.number,
                           "id_base": new_epoch.id_base,
                           "next_id": self._next_id},

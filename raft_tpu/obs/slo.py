@@ -146,6 +146,11 @@ class SLOTracker:
     injected ``clock``. Reads AND writes ``registry`` (default: the
     process registry) so one snapshot carries signal and verdict."""
 
+    # static race contract (tools/graftlint GL003): the polling daemon
+    # (tick) and report() readers share the burn-rate ring and the
+    # last report under self._lock
+    GUARDED_BY = ("_ring", "_report", "_breached")
+
     def __init__(self, objectives: Sequence[Objective],
                  registry=None, poll_s: float = 1.0, clock=None,
                  start: bool = True, install: bool = True):
@@ -226,10 +231,11 @@ class SLOTracker:
                 sig[f"recall:{o.name}"] = _recall_floor_value(snap)
         return sig
 
-    def _window_start(self, now: float, w: float) -> Optional[dict]:
+    def _window_start_locked(self, now: float,
+                             w: float) -> Optional[dict]:
         """The newest ring sample at or before ``now - w`` (None until
         the ring covers the window — a cold tracker must not breach on
-        a half-filled window)."""
+        a half-filled window). Caller holds ``self._lock``."""
         best = None
         for t, sig in self._ring:
             if t <= now - w + 1e-9:
@@ -250,9 +256,9 @@ class SLOTracker:
             for o in self.objectives:
                 burns: Dict[str, Optional[float]] = {}
                 for w in o.windows:
-                    base = self._window_start(now, w)
-                    burns[f"{int(w)}s"] = self._burn(o, w, now, sig,
-                                                     base)
+                    base = self._window_start_locked(now, w)
+                    burns[f"{int(w)}s"] = self._burn_locked(
+                        o, w, now, sig, base)
                 breach = (all(b is not None and b >= o.burn_threshold
                               for b in burns.values())
                           and len(burns) > 0)
@@ -286,11 +292,12 @@ class SLOTracker:
             self._report = report
             return report
 
-    def _burn(self, o: Objective, w: float, now: float,
-              now_sig: dict, base_sig: Optional[dict]
-              ) -> Optional[float]:
+    def _burn_locked(self, o: Objective, w: float, now: float,
+                     now_sig: dict, base_sig: Optional[dict]
+                     ) -> Optional[float]:
         """Burn rate of one objective over one window → None while the
-        window has no data (cold start, zero traffic)."""
+        window has no data (cold start, zero traffic). Caller holds
+        ``self._lock`` (the ring is read here)."""
         if o.kind == "recall":
             # gauges are already windowed by the quality monitor; the
             # SLO window uses the worst value sampled INSIDE it

@@ -46,7 +46,8 @@ class TestFramework:
     def test_registry_has_contracted_rules(self):
         rules = core.all_rules()
         for code in ("GL001", "GL002", "GL003", "GL004", "GL005",
-                     "GL006", "GL010", "GL011"):
+                     "GL006", "GL007", "GL008", "GL009", "GL010",
+                     "GL011"):
             assert code in rules, f"rule {code} missing from registry"
 
     def test_syntax_error_reported_not_crashed(self, tmp_path):
@@ -324,23 +325,29 @@ class TestGL006Swallow:
         findings, _ = _run(tmp_path, select=["GL006"])
         assert findings == []
 
-    def test_serve_and_mutate_carry_zero_gl006(self):
-        """ISSUE 10 satellite acceptance: the failure-handling trees
-        themselves swallow nothing silently — serve/ and mutate/ are
-        clean outright (modulo justified suppression pragmas); comms'
-        grandfathered heartbeat sites ride the baseline instead."""
+    def test_failure_handling_trees_carry_zero_gl006(self):
+        """ISSUE 12 satellite acceptance: the GL006 baseline is
+        DRAINED — serve/, mutate/ AND comms/ are clean outright
+        (modulo justified suppression pragmas); the former
+        grandfathered comms sites were fixed (health.py's dropped
+        beat now counts under raft.comms.health.errors) or justified
+        (launcher env sniffing, health key retirement)."""
         findings, _ = engine.run(
             REPO, files=[os.path.join(REPO, "raft_tpu", "serve"),
-                         os.path.join(REPO, "raft_tpu", "mutate")],
+                         os.path.join(REPO, "raft_tpu", "mutate"),
+                         os.path.join(REPO, "raft_tpu", "comms")],
             select=["GL006"])
         assert findings == []
 
-    def test_comms_grandfathered_sites_are_baselined(self):
-        allow = engine.load_baseline(
-            os.path.join(REPO, engine.DEFAULT_BASELINE))
-        gl006 = [k for k in allow if k[0] == "GL006"]
-        assert gl006, "expected grandfathered GL006 comms entries"
-        assert all(k[1].startswith("raft_tpu/comms/") for k in gl006)
+    def test_baseline_is_empty(self):
+        """ISSUE 12 satellite acceptance: tools/graftlint_baseline.json
+        carries ZERO findings — and stays that way (new findings are
+        fixed or justified, never grandfathered)."""
+        with open(os.path.join(REPO, engine.DEFAULT_BASELINE)) as f:
+            obj = json.load(f)
+        assert obj["findings"] == []
+        assert engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE)) == {}
 
 
 class TestGL010GL011Metrics:
@@ -427,15 +434,21 @@ class TestJsonOutput:
     def test_schema(self, tmp_path):
         _write(tmp_path, "raft_tpu/a.py",
                "import time\nt = time.time()\n")
-        findings, suppressed = _run(tmp_path, select=["GL005"])
-        obj = engine.to_json(findings, [], suppressed)
+        timings = {}
+        findings, suppressed = engine.run(str(tmp_path),
+                                          select=["GL005"],
+                                          timings=timings)
+        obj = engine.to_json(findings, [], suppressed, timings)
         assert obj["version"] == engine.JSON_VERSION
         assert set(obj) == {"version", "findings", "counts",
-                            "grandfathered", "suppressed"}
+                            "grandfathered", "suppressed",
+                            "timings_ms"}
         f = obj["findings"][0]
         assert set(f) == {"rule", "file", "line", "col", "message",
                           "context"}
         assert obj["counts"] == {"GL005": 1}
+        # per-rule wall time is attributable (ISSUE 12 satellite)
+        assert obj["timings_ms"].get("GL005", -1) >= 0
         # round-trips through json
         assert json.loads(json.dumps(obj)) == obj
 
@@ -456,7 +469,8 @@ class TestCLI:
         r = self._cli("--list-rules")
         assert r.returncode == 0
         for code in ("GL001", "GL002", "GL003", "GL004", "GL005",
-                     "GL006", "GL010", "GL011"):
+                     "GL006", "GL007", "GL008", "GL009", "GL010",
+                     "GL011"):
             assert code in r.stdout
 
     def test_seeded_bug_fails_the_gate(self, tmp_path):
@@ -538,6 +552,40 @@ class TestBaselineContract:
         from tools.graftlint.rules.locks import LockDiscipline
         assert "raft_tpu/mutate" in LockDiscipline.paths
 
+    def test_gl003_scope_covers_post_pr6_threaded_modules(self):
+        """ISSUE 12 satellite: the modules that grew locks/threads
+        after PR 6 fixed the scoping are now inside it — and the
+        shadow/SLO classes declare their contracts."""
+        from tools.graftlint.rules.locks import LockDiscipline
+        for p in ("raft_tpu/obs/quality.py", "raft_tpu/obs/slo.py",
+                  "raft_tpu/testing/faults.py"):
+            assert p in LockDiscipline.paths
+        from raft_tpu.obs.quality import QualityMonitor
+        from raft_tpu.obs.slo import SLOTracker
+        assert set(QualityMonitor.GUARDED_BY) >= {
+            "_pending", "_windows", "_epoch", "_closed"}
+        assert set(SLOTracker.GUARDED_BY) >= {"_ring", "_report"}
+
+    def test_gl003_live_in_quality_scope(self, tmp_path):
+        """A seeded unlocked GUARDED_BY write in the newly-scoped
+        quality module is a live finding; the same bug in an
+        unscoped obs module stays out of contract."""
+        bug = ("import threading\n"
+               "class M:\n"
+               "    GUARDED_BY = ('_pending',)\n"
+               "    def __init__(self):\n"
+               "        self._cond = threading.Condition()\n"
+               "        self._pending = []\n"
+               "    def bad(self):\n"
+               "        self._pending.append(1)\n")
+        _write(tmp_path, "raft_tpu/obs/quality.py", bug)
+        findings, _ = _run(tmp_path, select=["GL003"])
+        assert _codes(findings) == ["GL003"]
+        _write(tmp_path, "raft_tpu/obs/quality.py", "x = 1\n")
+        _write(tmp_path, "raft_tpu/obs/registry.py", bug)
+        findings, _ = _run(tmp_path, select=["GL003"])
+        assert findings == []
+
     def test_no_grandfathered_findings_in_parallel(self):
         """ISSUE 7 satellite: the per-build shard_map sites in
         parallel/ now ride the keyed _shmap_plan cache — their GL002
@@ -552,6 +600,26 @@ class TestBaselineContract:
         findings, _ = engine.run(
             REPO, files=[os.path.join(REPO, "raft_tpu", "parallel")])
         assert [f for f in findings if f.rule == "GL002"] == []
+
+
+class TestLockOrderContract:
+    """ISSUE 12 tentpole acceptance (the full interprocedural fixture
+    suite lives in tests/test_graftlint_concurrency.py)."""
+
+    def test_lock_order_graph_is_acyclic(self):
+        from tools.graftlint import callgraph
+        program = callgraph.get_program({}, REPO)
+        assert program.lock_cycles() == [], \
+            "lock-order cycle in the real tree — potential deadlock"
+
+    def test_gl007_gl008_gl009_live_clean_with_empty_baseline(self):
+        findings, _ = engine.run(
+            REPO, select=["GL007", "GL008", "GL009"])
+        assert findings == []
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        assert not [k for k in allow
+                    if k[0] in ("GL007", "GL008", "GL009")]
 
 
 class TestShimDelegation:

@@ -1,0 +1,551 @@
+"""Interprocedural graftlint tests (ISSUE 12).
+
+Per-rule positive/negative fixtures for GL007 (lock-order cycles),
+GL008 (blocking-under-lock) and GL009 (callback-under-lock), the
+suppression + baseline round-trip for the new rules, a synthetic
+two-lock cycle (direct and transitive through the call graph), the
+call-graph resolution pins for the REAL batcher→quality→mutable
+epoch-listener chain, the ``--changed-only`` selection, and the
+CLI-level seeded lock-order inversion that must fail the precommit
+gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftlint import callgraph, engine  # noqa: E402
+
+
+def _write(tmp_path, rel, text):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+    return p
+
+
+def _run(root, select=None):
+    return engine.run(str(root), select=select)
+
+
+def _codes(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# GL007 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+class TestGL007LockOrder:
+    CYCLE_DIRECT = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def fwd(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n"
+        "    def rev(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n"
+        "                pass\n")
+
+    # the serve→quality→mutate listener *shape*: the inversion only
+    # exists interprocedurally, through typed-attribute call resolution
+    CYCLE_TRANSITIVE = (
+        "import threading\n"
+        "class Wal:\n"
+        "    def __init__(self):\n"
+        "        self._wal_lock = threading.Lock()\n"
+        "        self._idx = Index()\n"
+        "    def append(self):\n"
+        "        with self._wal_lock:\n"
+        "            pass\n"
+        "    def drain(self):\n"
+        "        with self._wal_lock:\n"
+        "            self._idx.poke()\n"
+        "class Index:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._wal = Wal()\n"
+        "    def poke(self):\n"
+        "        with self._cond:\n"
+        "            pass\n"
+        "    def mutate(self):\n"
+        "        with self._cond:\n"
+        "            self._wal.append()\n")
+
+    CONSISTENT = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n")
+
+    def test_flags_direct_inversion(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.CYCLE_DIRECT)
+        findings, _ = _run(tmp_path, select=["GL007"])
+        assert _codes(findings) == ["GL007"]
+        assert "lock-order cycle" in findings[0].message
+        assert "_a_lock" in findings[0].message
+        assert "_b_lock" in findings[0].message
+
+    def test_flags_transitive_inversion_through_calls(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.CYCLE_TRANSITIVE)
+        findings, _ = _run(tmp_path, select=["GL007"])
+        assert _codes(findings) == ["GL007"]
+        assert "Wal._wal_lock" in findings[0].message
+        assert "Index._cond" in findings[0].message
+
+    def test_consistent_order_silent(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.CONSISTENT)
+        findings, _ = _run(tmp_path, select=["GL007"])
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        # the finding anchors at the first edge's site (fwd's inner
+        # acquisition) — suppress there with a justification
+        src = self.CYCLE_DIRECT.replace(
+            "            with self._b_lock:\n"
+            "                pass\n"
+            "    def rev",
+            "            with self._b_lock:  "
+            "# graftlint: disable=GL007\n"
+            "                pass\n"
+            "    def rev")
+        _write(tmp_path, "raft_tpu/serve/a.py", src)
+        findings, suppressed = _run(tmp_path, select=["GL007"])
+        assert findings == []
+        assert _codes(suppressed) == ["GL007"]
+
+
+# ---------------------------------------------------------------------------
+# GL008 — blocking under a lock
+# ---------------------------------------------------------------------------
+
+class TestGL008Blocking:
+    BUG_DIRECT = (
+        "import os\n"
+        "import threading\n"
+        "import time\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def slow(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n")
+
+    BUG_TRANSITIVE = (
+        "import os\n"
+        "import threading\n"
+        "class Log:\n"
+        "    def flush_all(self):\n"
+        "        os.fsync(1)\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._log = Log()\n"
+        "    def commit(self):\n"
+        "        with self._lock:\n"
+        "            self._log.flush_all()\n")
+
+    BUG_LOCKED_ENTRY = (
+        "import threading\n"
+        "import time\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _sync_locked(self):\n"
+        "        time.sleep(0.1)\n")
+
+    OK = (
+        "import os\n"
+        "import threading\n"
+        "class OK:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def waiter(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait(timeout=1.0)\n"
+        "    def syncer(self):\n"
+        "        os.fsync(1)\n")
+
+    def test_flags_direct_blocking(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.BUG_DIRECT)
+        findings, _ = _run(tmp_path, select=["GL008"])
+        assert _codes(findings) == ["GL008"]
+        assert "time.sleep" in findings[0].message
+        assert "W._lock" in findings[0].message
+
+    def test_flags_transitive_blocking_with_chain(self, tmp_path):
+        _write(tmp_path, "raft_tpu/mutate/a.py", self.BUG_TRANSITIVE)
+        findings, _ = _run(tmp_path, select=["GL008"])
+        assert _codes(findings) == ["GL008"]
+        assert "os.fsync" in findings[0].message
+        assert "flush_all" in findings[0].message      # the chain
+        assert "S._lock" in findings[0].message
+
+    def test_flags_locked_entry_method(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.BUG_LOCKED_ENTRY)
+        findings, _ = _run(tmp_path, select=["GL008"])
+        assert _codes(findings) == ["GL008"]
+        assert "_sync_locked" in findings[0].message
+
+    def test_wait_and_unlocked_blocking_silent(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.OK)
+        findings, _ = _run(tmp_path, select=["GL008"])
+        assert findings == []
+
+    def test_out_of_scope_tree_not_reported(self, tmp_path):
+        # linalg/ has no concurrency contract — program-wide analysis
+        # still runs, findings are scoped to serve/mutate/obs/comms/
+        # testing
+        _write(tmp_path, "raft_tpu/linalg/a.py", self.BUG_DIRECT)
+        findings, _ = _run(tmp_path, select=["GL008"])
+        assert findings == []
+
+    def test_suppression_and_baseline_round_trip(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.BUG_DIRECT)
+        findings, _ = _run(tmp_path, select=["GL008"])
+        assert len(findings) == 1
+        # baseline round-trip: grandfathered once, strict on a second
+        # instance
+        bl = tmp_path / "baseline.json"
+        engine.write_baseline(str(bl), findings)
+        allow = engine.load_baseline(str(bl))
+        new, old = engine.split_new(findings, allow)
+        assert new == [] and len(old) == 1
+        bug2 = self.BUG_DIRECT + (
+            "    def slow2(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(0.5)\n")
+        _write(tmp_path, "raft_tpu/serve/a.py", bug2)
+        findings2, _ = _run(tmp_path, select=["GL008"])
+        new, old = engine.split_new(findings2, allow)
+        assert len(new) == 1 and len(old) == 1
+        # suppression with a justification silences the line
+        sup = self.BUG_DIRECT.replace(
+            "            time.sleep(0.5)",
+            "            time.sleep(0.5)  # graftlint: disable=GL008")
+        _write(tmp_path, "raft_tpu/serve/a.py", sup)
+        findings3, suppressed = _run(tmp_path, select=["GL008"])
+        assert findings3 == []
+        assert _codes(suppressed) == ["GL008"]
+
+
+# ---------------------------------------------------------------------------
+# GL009 — user callbacks under a lock
+# ---------------------------------------------------------------------------
+
+class TestGL009Callback:
+    BUG_LISTENERS = (
+        "import threading\n"
+        "class N:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._listeners = ()\n"
+        "    def add_listener(self, fn):\n"
+        "        with self._lock:\n"
+        "            self._listeners = self._listeners + (fn,)\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            for cb in self._listeners:\n"
+        "                cb(1)\n")
+
+    OK_SNAPSHOT = (
+        "import threading\n"
+        "class N:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._listeners = ()\n"
+        "    def add_listener(self, fn):\n"
+        "        with self._lock:\n"
+        "            self._listeners = self._listeners + (fn,)\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            listeners = self._listeners\n"
+        "        for cb in listeners:\n"
+        "            cb(1)\n")
+
+    BUG_PARAM = (
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def run_hook(self, hook):\n"
+        "        with self._lock:\n"
+        "            hook()\n")
+
+    BUG_ESTIMATOR = (
+        "import threading\n"
+        "from typing import Callable, Optional\n"
+        "class E:\n"
+        "    def __init__(self, estimator: Optional[Callable] = None):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._est = estimator\n"
+        "    def score(self):\n"
+        "        with self._lock:\n"
+        "            return self._est(1)\n")
+
+    BUG_TRANSITIVE = (
+        "import threading\n"
+        "def fire_hooks(fn):\n"
+        "    fn()\n"
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def locked_fire(self, fn):\n"
+        "        with self._lock:\n"
+        "            fire_hooks(fn)\n")
+
+    def test_flags_listener_loop_under_lock(self, tmp_path):
+        _write(tmp_path, "raft_tpu/mutate/a.py", self.BUG_LISTENERS)
+        findings, _ = _run(tmp_path, select=["GL009"])
+        assert _codes(findings) == ["GL009"]
+        assert "N._lock" in findings[0].message
+
+    def test_snapshot_idiom_silent(self, tmp_path):
+        _write(tmp_path, "raft_tpu/mutate/a.py", self.OK_SNAPSHOT)
+        findings, _ = _run(tmp_path, select=["GL009"])
+        assert findings == []
+
+    def test_flags_parameter_call_under_lock(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.BUG_PARAM)
+        findings, _ = _run(tmp_path, select=["GL009"])
+        assert _codes(findings) == ["GL009"]
+        assert "hook" in findings[0].message
+
+    def test_flags_callable_annotated_attr(self, tmp_path):
+        _write(tmp_path, "raft_tpu/obs/a.py", self.BUG_ESTIMATOR)
+        findings, _ = _run(tmp_path, select=["GL009"])
+        assert _codes(findings) == ["GL009"]
+        assert "_est" in findings[0].message
+
+    def test_flags_transitive_callback(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/a.py", self.BUG_TRANSITIVE)
+        findings, _ = _run(tmp_path, select=["GL009"])
+        assert _codes(findings) == ["GL009"]
+        assert "fire_hooks" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# the real tree: chain resolution pins + zero live findings
+# ---------------------------------------------------------------------------
+
+class TestRealTreeResolution:
+    @pytest.fixture(scope="class")
+    def program(self):
+        return callgraph.get_program({}, REPO)
+
+    def test_batcher_to_quality_chain_resolves(self, program):
+        """The serve→quality leg: the dispatcher's sampling call
+        resolves to QualityMonitor.offer and happens with NO lock
+        held — the shape GL007/GL009 must be able to see through."""
+        fi = program.functions[
+            "raft_tpu.serve.batcher.SearchServer._execute"]
+        offers = [c for c in fi.calls
+                  if c.target ==
+                  "raft_tpu.obs.quality.QualityMonitor.offer"]
+        assert offers, "qm.offer did not resolve to QualityMonitor"
+        assert all(c.held == () for c in offers)
+
+    def test_quality_to_mutable_listener_wiring_resolves(self, program):
+        """The quality→mutate leg: attach_quality wires note_epoch via
+        MutableIndex.add_epoch_listener (resolved through the
+        unique-method fallback)."""
+        fi = program.functions[
+            "raft_tpu.serve.batcher.SearchServer.attach_quality"]
+        assert any(
+            c.target ==
+            "raft_tpu.mutate.mutable.MutableIndex.add_epoch_listener"
+            for c in fi.calls)
+
+    def test_epoch_listeners_fire_outside_the_lock(self, program):
+        """PR 11's by-convention invariant, machine-checked: the
+        listener invocation in _notify_epoch_listeners is recognized
+        as a user callback AND carries an empty held-lock set — moving
+        it under `with self._cond` becomes a live GL009 finding."""
+        fi = program.functions[
+            "raft_tpu.mutate.mutable.MutableIndex."
+            "_notify_epoch_listeners"]
+        assert fi.callbacks, "listener call not recognized as callback"
+        assert all(ev.held == () for ev in fi.callbacks)
+
+    def test_offer_acquires_the_monitor_cond(self, program):
+        fi = program.functions[
+            "raft_tpu.obs.quality.QualityMonitor.offer"]
+        assert any(
+            ev.lock == "raft_tpu.obs.quality.QualityMonitor._cond"
+            for ev in fi.acquisitions)
+
+    def test_wal_fsync_chain_summarized(self, program):
+        """upsert's WAL append chains to os.fsync through three
+        frames — the summary the justified GL008 suppression covers."""
+        blocked = program.unguarded_blocking(
+            "raft_tpu.mutate.wal.MutationWAL.append_upsert")
+        assert "os.fsync" in blocked
+
+    def test_lock_order_graph_is_acyclic(self, program):
+        assert program.lock_cycles() == []
+
+    def test_lock_order_graph_has_the_registry_star(self, program):
+        """The real edges: every serving/mutation/quality/SLO lock
+        feeds the metrics-registry lock (instrument calls under the
+        hold) — present, attributed, and acyclic."""
+        edges = program.lock_edges()
+        reg = "raft_tpu.obs.registry.MetricsRegistry._lock"
+        holders = {a for (a, b) in edges if b == reg}
+        assert "raft_tpu.serve.batcher.SearchServer._cond" in holders
+        assert "raft_tpu.mutate.mutable.MutableIndex._cond" in holders
+        assert "raft_tpu.obs.quality.QualityMonitor._cond" in holders
+
+    def test_zero_live_findings_across_concurrent_trees(self):
+        """ISSUE 12 acceptance: GL007/GL008/GL009 report nothing live
+        in serve/, mutate/, obs/, comms/ — every real finding was
+        fixed or carries a written justification, with an EMPTY
+        baseline."""
+        findings, suppressed = engine.run(
+            REPO, files=[os.path.join(REPO, "raft_tpu", d)
+                         for d in ("serve", "mutate", "obs", "comms")],
+            select=["GL007", "GL008", "GL009"])
+        assert findings == []
+        # the justified mutate holds are suppressions, not silence
+        assert len([f for f in suppressed if f.rule == "GL008"]) >= 3
+
+    def test_new_rules_carry_empty_baseline(self):
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        assert not [k for k in allow
+                    if k[0] in ("GL007", "GL008", "GL009")]
+
+
+# ---------------------------------------------------------------------------
+# engine satellites: --changed-only, --lock-graph, seeded inversion
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    return subprocess.run(["git", *args], cwd=cwd,
+                          capture_output=True, text=True, check=True)
+
+
+class TestChangedOnly:
+    def _seed_repo(self, tmp_path):
+        _git(tmp_path, "init", "-q")
+        _git(tmp_path, "config", "user.email", "t@t")
+        _git(tmp_path, "config", "user.name", "t")
+        _write(tmp_path, "raft_tpu/a.py", "x = 1\n")
+        _write(tmp_path, "raft_tpu/clean.py", "import time\n"
+               "t = time.time()\n")      # committed, NOT changed later
+        _git(tmp_path, "add", "-A")
+        _git(tmp_path, "commit", "-qm", "seed")
+
+    def test_selects_modified_and_untracked(self, tmp_path):
+        self._seed_repo(tmp_path)
+        _write(tmp_path, "raft_tpu/a.py",
+               "import time\nx = time.time()\n")     # modified
+        _write(tmp_path, "raft_tpu/b.py",
+               "import time\ny = time.time()\n")     # untracked
+        changed = engine.changed_files(str(tmp_path))
+        assert changed == ["raft_tpu/a.py", "raft_tpu/b.py"]
+        findings, _ = engine.run(
+            str(tmp_path),
+            files=[os.path.join(str(tmp_path), r) for r in changed],
+            select=["GL005"], respect_scope=True)
+        # the unchanged GL005 site in clean.py is NOT visited
+        assert sorted(f.file for f in findings) == \
+            ["raft_tpu/a.py", "raft_tpu/b.py"]
+
+    def test_respects_rule_path_scope(self, tmp_path):
+        self._seed_repo(tmp_path)
+        # GL006 scope excludes ops/ — a changed file there must not
+        # enter the contract just because it changed
+        _write(tmp_path, "raft_tpu/ops/x.py",
+               "try:\n    x()\nexcept Exception:\n    pass\n")
+        changed = engine.changed_files(str(tmp_path))
+        assert "raft_tpu/ops/x.py" in changed
+        files = [os.path.join(str(tmp_path), r) for r in changed]
+        findings, _ = engine.run(str(tmp_path), files=files,
+                                 select=["GL006"], respect_scope=True)
+        assert findings == []
+        # ...while pointing at it explicitly still lints it
+        findings, _ = engine.run(str(tmp_path), files=files,
+                                 select=["GL006"])
+        assert _codes(findings) == ["GL006"]
+
+    def test_cli_changed_only_smoke(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint",
+             "--changed-only"], cwd=REPO, capture_output=True,
+            text=True)
+        # whatever the working tree holds must be lint-clean (strict
+        # on new code — this PR's own diff included)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestLockGraphCLI:
+    def _cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", *args],
+            cwd=REPO, capture_output=True, text=True)
+
+    def test_emits_dot(self):
+        r = self._cli("--lock-graph")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.startswith("digraph lock_order")
+        assert "SearchServer._cond" in r.stdout
+
+    def test_writes_file(self, tmp_path):
+        out = tmp_path / "locks.dot"
+        r = self._cli("--lock-graph", str(out))
+        assert r.returncode == 0
+        assert out.read_text().startswith("digraph lock_order")
+
+    def test_seeded_lock_order_inversion_fails_the_gate(self,
+                                                        tmp_path):
+        """ISSUE 12 CI satellite: a lock-order inversion seeded in a
+        scratch file fails the graftlint CLI (the precommit gate) with
+        a GL007 finding — even with the checked-in (empty) baseline."""
+        p = tmp_path / "seeded.py"
+        p.write_text(
+            "import threading\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def fwd():\n"
+            "    with _a_lock:\n"
+            "        with _b_lock:\n"
+            "            pass\n"
+            "def rev():\n"
+            "    with _b_lock:\n"
+            "        with _a_lock:\n"
+            "            pass\n")
+        r = self._cli(str(p))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "GL007" in r.stdout
+        assert "lock-order cycle" in r.stdout
+
+    def test_json_reports_per_rule_timings(self, tmp_path):
+        p = tmp_path / "seeded.py"
+        p.write_text("import time\nt = time.time()\n")
+        r = self._cli(str(p), "--json", "--no-baseline")
+        obj = json.loads(r.stdout)
+        assert "timings_ms" in obj
+        assert obj["timings_ms"].get("GL005", -1) >= 0

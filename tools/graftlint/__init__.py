@@ -16,14 +16,19 @@ Pieces:
 * :mod:`tools.graftlint.engine` — file iteration, baseline
   (strict-on-new-code) gate, text/JSON output, the CLI behind
   ``python -m tools.graftlint``.
+* :mod:`tools.graftlint.callgraph` — the whole-program model (import
+  graph, call graph with pragmatic method resolution, per-function
+  lock/blocking/callback summaries) behind the interprocedural rules
+  and the ``--lock-graph`` DOT export (ISSUE 12).
 * :mod:`tools.graftlint.rules` — the rules this codebase already paid
   for the hard way (GL001 host-sync-in-jit, GL002 retrace hazards,
   GL003 lock discipline, GL004 precision, GL005 monotonic clock,
-  GL010/GL011 metric-name taxonomy).
+  GL007 lock-order cycles, GL008 blocking-under-lock, GL009
+  callback-under-lock, GL010/GL011 metric-name taxonomy).
 
 ``docs/static_analysis.md`` has the rule catalog, the real PR 2/3/5
-bug each rule would have caught, and the suppression + baseline
-workflow.
+(and the PR 9–11 threading-hazard) bug each rule would have caught,
+and the suppression + baseline workflow.
 """
 
 from tools.graftlint.core import (  # noqa: F401

@@ -1,0 +1,1016 @@
+"""Whole-program model for the interprocedural graftlint rules.
+
+PRs 8–11 made raft-tpu genuinely concurrent — the serving dispatcher,
+its watchdog helper, the compactor daemon, the quality shadow thread,
+the SLO poller, the health monitor and the chaos driver all share
+state across ``serve/``, ``mutate/``, ``obs/`` and ``comms/`` — but
+the per-file rules (GL003) can only see one function at a time.  This
+module builds the program-wide view those deadlock classes need:
+
+* an **import graph** over ``raft_tpu/`` (module → alias → target,
+  ``from X import y`` re-exports followed one level through package
+  ``__init__``\\ s);
+* a **call graph** with pragmatic method resolution: ``self.m()`` /
+  ``cls.m()`` by enclosing class (program base classes walked),
+  ``x.m()`` by the receiver's inferred type (parameter annotations,
+  ``x = ClassName(...)`` / ``x = cls(...)`` locals, ``self._a = param``
+  attribute types collected class-wide), dotted module attributes via
+  the import map, and — when the receiver stays unknown — a
+  unique-method-name fallback (``qm.offer(...)`` resolves because
+  exactly one program class defines ``offer``);
+* **per-function summaries**: which locks a function acquires (lock
+  identities are class-qualified ``module.Class._field`` strings, the
+  GL003 naming conventions via :func:`core.is_lock_expr`), which
+  unbounded-blocking operations it performs, which user-supplied
+  callables it invokes — each event tagged with the set of locks
+  lexically held at that point (``_locked``-suffix methods start with
+  their class's locks held, per the GL003 contract).
+
+On top of the summaries three transitive sets are computed per
+function (memoized, cycle-safe): ``unguarded_acquires`` /
+``unguarded_blocking`` / ``unguarded_callbacks`` — what the function
+does when entered with NO lock held.  GL007 builds the global
+lock-order graph from (held × acquired) pairs and flags cycles; GL008
+flags blocking reachable under a lock; GL009 flags callbacks invoked
+under a lock.  Anything a function does under its OWN lock is reported
+inside that function, never re-reported at every caller.
+
+Known, deliberate imprecision (documented so findings are argued
+against the right model):
+
+* nested ``def``/``lambda`` bodies are not attributed to the enclosing
+  function (they run when *called*, not where defined — same stance as
+  GL003);
+* two instances of one class share a lock identity, so same-identity
+  self-edges are ignored for cycle detection (A→A is GL003's
+  re-entrancy territory, and cross-instance ordering of one class is
+  rarely expressible statically);
+* ``raft_tpu.testing.faults.inject`` is a trusted production no-op
+  (one module-flag read when no chaos rule is active) — its
+  scope-activated effects (sleeps, raises, hooks) are excluded from
+  propagation, otherwise every chaos injection point under a lock
+  would flag.
+
+Everything is stdlib-``ast`` only, like the rest of graftlint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.core import dotted_name, is_lock_expr
+
+__all__ = ["Program", "get_program", "TRUSTED_NOOPS"]
+
+# production no-op fast paths: excluded from transitive propagation
+TRUSTED_NOOPS = frozenset({"raft_tpu.testing.faults.inject"})
+
+# callback-suggestive names: parameters/attributes matching these are
+# treated as user-supplied callables when assigned from a parameter
+_CB_SUFFIXES = ("fn", "func", "cb", "callback", "hook", "listener",
+                "listeners", "estimator")
+
+
+def _is_cb_name(name: str) -> bool:
+    low = name.lower().rstrip("s") or name.lower()
+    if low.startswith("on_") or name.lower().startswith("on_"):
+        return True
+    for suf in _CB_SUFFIXES:
+        if low == suf or low.endswith("_" + suf):
+            return True
+    return False
+
+
+def _ann_mentions_callable(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == "Callable"
+               or isinstance(n, ast.Attribute) and n.attr == "Callable"
+               for n in ast.walk(ann))
+
+
+def _ann_class_name(ann: Optional[ast.AST]) -> Optional[str]:
+    """First plain dotted name inside an annotation (unwraps
+    ``Optional[X]`` / quoted forward refs / ``"mod.X"`` strings)."""
+    if ann is None:
+        return None
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            # forward reference: keep the last dotted segment pair
+            return n.value.strip("'\" ")
+        d = dotted_name(n)
+        if d is not None and d not in ("Optional", "Tuple", "List",
+                                       "Dict", "Sequence", "Set",
+                                       "typing"):
+            return d
+    return None
+
+
+# --------------------------------------------------------------------------
+# summary records
+# --------------------------------------------------------------------------
+
+@dataclass
+class Event:
+    """One summarized action with the locks held when it happens."""
+
+    held: Tuple[str, ...]       # lock ids lexically held (may be "?x")
+    line: int
+    desc: str = ""              # blocking/callback description
+    lock: str = ""              # acquisitions: the lock taken
+    target: Optional[str] = None  # calls: resolved callee qualname
+    text: str = ""              # rendered call text for messages
+
+
+@dataclass
+class FuncInfo:
+    qual: str
+    module: str
+    cls: Optional[str]          # owning class qualname
+    name: str
+    rel: str
+    lineno: int
+    entry_locks: Tuple[str, ...] = ()
+    acquisitions: List[Event] = field(default_factory=list)
+    calls: List[Event] = field(default_factory=list)
+    blocking: List[Event] = field(default_factory=list)
+    callbacks: List[Event] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    qual: str
+    module: str
+    name: str
+    bases: Tuple[str, ...] = ()
+    methods: Dict[str, str] = field(default_factory=dict)
+    lock_attrs: Set[str] = field(default_factory=set)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    callback_attrs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModInfo:
+    name: str
+    rel: str
+    imports: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, str] = field(default_factory=dict)
+    classes: Dict[str, str] = field(default_factory=dict)
+    lock_names: Set[str] = field(default_factory=set)
+
+
+def _module_name(rel: str) -> str:
+    rel = rel.replace("\\", "/")
+    if rel.startswith(".."):
+        # a file outside the scan root (explicit CLI path): standalone
+        return os.path.splitext(os.path.basename(rel))[0]
+    parts = rel[:-3].split("/") if rel.endswith(".py") else \
+        rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# --------------------------------------------------------------------------
+# pass 1: declarations (modules, classes, imports)
+# --------------------------------------------------------------------------
+
+def _collect_module(program: "Program", rel: str, tree: ast.AST) -> None:
+    mod = ModInfo(name=_module_name(rel), rel=rel)
+    program.modules[mod.name] = mod
+    program.rel_to_module[rel] = mod.name
+    for node in tree.body:
+        _collect_stmt(program, mod, node)
+
+
+def _collect_stmt(program: "Program", mod: ModInfo,
+                  node: ast.stmt) -> None:
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            alias = a.asname or a.name.split(".")[0]
+            mod.imports[alias] = a.name if a.asname else \
+                a.name.split(".")[0]
+    elif isinstance(node, ast.ImportFrom):
+        base = node.module or ""
+        if node.level:     # relative: resolve against this package
+            pkg = mod.name.split(".")
+            # a package __init__'s own name IS its package; a plain
+            # module must first drop its own segment
+            drop = node.level - (1 if mod.rel.endswith("__init__.py")
+                                 else 0)
+            if drop > 0:
+                pkg = pkg[:len(pkg) - drop]
+            base = ".".join(pkg + ([node.module] if node.module
+                                   else []))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            alias = a.asname or a.name
+            mod.imports[alias] = f"{base}.{a.name}" if base else a.name
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        qual = f"{mod.name}.{node.name}"
+        mod.functions[node.name] = qual
+        program.functions[qual] = FuncInfo(
+            qual=qual, module=mod.name, cls=None, name=node.name,
+            rel=mod.rel, lineno=node.lineno)
+        program._bodies[qual] = node
+    elif isinstance(node, ast.ClassDef):
+        _collect_class(program, mod, node)
+    elif isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and is_lock_expr(tgt):
+                mod.lock_names.add(tgt.id)
+    elif isinstance(node, (ast.If, ast.Try)):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                _collect_stmt(program, mod, child)
+
+
+def _collect_class(program: "Program", mod: ModInfo,
+                   node: ast.ClassDef) -> None:
+    qual = f"{mod.name}.{node.name}"
+    ci = ClassInfo(qual=qual, module=mod.name, name=node.name,
+                   bases=tuple(d for d in
+                               (dotted_name(b) for b in node.bases)
+                               if d))
+    mod.classes[node.name] = qual
+    program.classes[qual] = ci
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mqual = f"{qual}.{stmt.name}"
+            ci.methods[stmt.name] = mqual
+            program.functions[mqual] = FuncInfo(
+                qual=mqual, module=mod.name, cls=qual, name=stmt.name,
+                rel=mod.rel, lineno=stmt.lineno)
+            program._bodies[mqual] = stmt
+            _collect_attrs(program, ci, stmt)
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            cn = _ann_class_name(stmt.annotation)
+            if cn:
+                ci.attr_types.setdefault(stmt.target.id, cn)
+
+
+def _collect_attrs(program: "Program", ci: ClassInfo,
+                   fn: ast.AST) -> None:
+    """Scan one method for ``self.X = ...`` attribute facts: lock
+    attributes, inferred attribute types, callback sources."""
+    params: Dict[str, Optional[ast.AST]] = {}
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        if a.arg not in ("self", "cls"):
+            params[a.arg] = a.annotation
+    cb_params = {p for p, ann in params.items()
+                 if _is_cb_name(p) or _ann_mentions_callable(ann)}
+    for node in ast.walk(fn):
+        tgt = val = ann = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign):
+            tgt, val, ann = node.target, node.value, node.annotation
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        attr = tgt.attr
+        if is_lock_expr(tgt):
+            ci.lock_attrs.add(attr)
+        cn = _ann_class_name(ann)
+        if cn:
+            ci.attr_types.setdefault(attr, cn)
+        if isinstance(val, ast.Call):
+            d = dotted_name(val.func)
+            if d:
+                ci.attr_types.setdefault(attr, d)
+        elif isinstance(val, ast.Name) and val.id in params:
+            cn = _ann_class_name(params[val.id])
+            if cn:
+                ci.attr_types.setdefault(attr, cn)
+        # callback source: the assigned expression references a
+        # callback-ish parameter (directly, or inside a tuple/binop —
+        # the listener-accumulation shape)
+        if val is not None:
+            names = {n.id for n in ast.walk(val)
+                     if isinstance(n, ast.Name)}
+            if names & cb_params or (
+                    _is_cb_name(attr) and names & set(params)):
+                ci.callback_attrs.add(attr)
+
+
+# --------------------------------------------------------------------------
+# pass 2: per-function event extraction
+# --------------------------------------------------------------------------
+
+# unbounded-blocking operations by dotted name
+_BLOCKING_DOTTED = {
+    "os.fsync": "os.fsync",
+    "time.sleep": "time.sleep",
+    "jax.block_until_ready": "block_until_ready",
+    "jax.device_put": "host->device transfer (jax.device_put)",
+    "jax.device_get": "device->host transfer (jax.device_get)",
+    "jnp.asarray": "host->device transfer (jnp.asarray)",
+    "jnp.array": "host->device transfer (jnp.array)",
+}
+# ...and by attribute name (receiver-independent / heuristic receiver)
+_BLOCKING_ATTRS = {
+    "block_until_ready": "block_until_ready",
+    "sync_stream": "comms.sync_stream",
+}
+_SKIP_ATTRS = {"wait", "notify", "notify_all", "acquire", "release"}
+
+
+def _blocking_desc(node: ast.Call,
+                   resolved: Optional[str]) -> Optional[str]:
+    d = dotted_name(node.func)
+    if d in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[d]
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return _BLOCKING_ATTRS[attr]
+        try:
+            recv = ast.unparse(node.func.value).lower()
+        except Exception:
+            recv = ""
+        if attr == "result" and ("future" in recv or "fut" in recv):
+            return "Future.result"
+        if attr == "join" and "thread" in recv:
+            return "Thread.join"
+    if resolved is not None:
+        name = resolved.rsplit(".", 1)[-1]
+        if name.startswith("compile_") or name == "build_plan":
+            return f"plan compile ({name})"
+    return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Walk one function body tracking the lexical held-lock stack and
+    recording acquisition / call / blocking / callback events."""
+
+    def __init__(self, program: "Program", info: FuncInfo,
+                 fn: ast.AST):
+        self.p = program
+        self.info = info
+        self.fn = fn
+        self.held: List[str] = list(info.entry_locks)
+        mod = program.modules[info.module]
+        self.mod = mod
+        self.cls = program.classes.get(info.cls) if info.cls else None
+        args = fn.args
+        self.params: Dict[str, Optional[ast.AST]] = {
+            a.arg: a.annotation
+            for a in (args.posonlyargs + args.args + args.kwonlyargs)}
+        self.cb_params = {
+            p for p, ann in self.params.items()
+            if p not in ("self", "cls")
+            and (_is_cb_name(p) or _ann_mentions_callable(ann))}
+        # local type environment + callback-local tracking (one cheap
+        # pre-pass; order-insensitive approximation)
+        self.local_types: Dict[str, str] = {}
+        for p, ann in self.params.items():
+            cn = _ann_class_name(ann)
+            if cn:
+                cq = self._resolve_class(cn)
+                if cq:
+                    self.local_types[p] = cq
+        self.cb_locals: Set[str] = set()
+        self._prepass(fn)
+
+    # -- resolution helpers ------------------------------------------------
+    def _resolve_class(self, dotted: str) -> Optional[str]:
+        kind, qual = self.p.resolve_symbol(self.mod.name, dotted)
+        return qual if kind == "class" else None
+
+    def _self_cb_attr(self, node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and self.cls is not None
+                and node.attr in self.p.class_callback_attrs(
+                    self.cls.qual)):
+            return node.attr
+        return None
+
+    def _prepass(self, fn: ast.AST) -> None:
+        # iterated to a fixpoint: ast.walk is breadth-first, so a
+        # `for cb in listeners:` can precede the (deeper-nested)
+        # `listeners = self._listeners` assignment that marks it
+        while True:
+            n_cb = len(self.cb_locals)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name, val = node.targets[0].id, node.value
+                    t = self._expr_type(val)
+                    if t:
+                        self.local_types.setdefault(name, t)
+                    if self._is_cb_value(val):
+                        self.cb_locals.add(name)
+                elif isinstance(node, ast.For) and \
+                        isinstance(node.target, ast.Name) and \
+                        self._is_cb_value(node.iter):
+                    self.cb_locals.add(node.target.id)
+            if len(self.cb_locals) == n_cb:
+                break
+
+    def _is_cb_value(self, val: ast.AST) -> bool:
+        if self._self_cb_attr(val) is not None:
+            return True
+        return isinstance(val, ast.Name) and val.id in self.cb_locals
+
+    def _expr_type(self, val: ast.AST) -> Optional[str]:
+        """Inferred program-class type of an expression, or None."""
+        if isinstance(val, ast.Call):
+            f = val.func
+            if isinstance(f, ast.Name) and f.id == "cls" \
+                    and self.cls is not None:
+                return self.cls.qual
+            d = dotted_name(f)
+            if d:
+                return self._resolve_class(d)
+        elif isinstance(val, ast.Attribute) and \
+                isinstance(val.value, ast.Name) and \
+                val.value.id == "self" and self.cls is not None:
+            t = self.p.class_attr_type(self.cls.qual, val.attr)
+            if t:
+                return self._resolve_class_from(t, self.cls.module)
+        return None
+
+    def _resolve_class_from(self, dotted: str,
+                            module: str) -> Optional[str]:
+        kind, qual = self.p.resolve_symbol(module, dotted)
+        return qual if kind == "class" else None
+
+    def _receiver_type(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            if node.id == "self" and self.cls is not None:
+                return self.cls.qual
+            if node.id == "cls" and self.cls is not None:
+                return self.cls.qual
+            return self.local_types.get(node.id)
+        return self._expr_type(node)
+
+    def _lock_id(self, expr: ast.AST) -> str:
+        """Class-qualified identity of a lock expression; ``?name``
+        when the owner cannot be resolved (held-ness still tracked,
+        no lock-order edges built from it)."""
+        if isinstance(expr, ast.Name):
+            # bare name: a module-global lock of this module (a
+            # lock-named local over-merges onto the module id — benign)
+            return f"{self.mod.name}.{expr.id}"
+        if isinstance(expr, ast.Attribute):
+            t = self._receiver_type(expr.value)
+            if t is not None:
+                return f"{t}.{expr.attr}"
+            return f"?{expr.attr}"
+        return "?lock"
+
+    # -- call resolution ---------------------------------------------------
+    def _resolve_call(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            kind, qual = self.p.resolve_symbol(self.mod.name, f.id)
+            if kind == "func":
+                return qual
+            if kind == "class":
+                ci = self.p.classes[qual]
+                return ci.methods.get("__init__", qual + ".__init__") \
+                    if "__init__" in ci.methods else None
+            if f.id == "cls" and self.cls is not None:
+                return self.p.find_method(self.cls.qual, "__init__")
+            return None
+        if isinstance(f, ast.Attribute):
+            t = self._receiver_type(f.value)
+            if t is not None:
+                m = self.p.find_method(t, f.attr)
+                if m:
+                    return m
+            d = dotted_name(f)
+            if d:
+                kind, qual = self.p.resolve_symbol(self.mod.name, d)
+                if kind == "func":
+                    return qual
+            # unique-method-name fallback (receiver type unknown)
+            if t is None or self.p.find_method(t, f.attr) is None:
+                return self.p.unique_method(f.attr)
+        return None
+
+    # -- callback detection ------------------------------------------------
+    def _callback_desc(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in self.cb_params:
+                return f"parameter `{f.id}`"
+            if f.id in self.cb_locals:
+                return f"`{f.id}` (bound from a callback attribute)"
+            return None
+        if isinstance(f, ast.Attribute):
+            attr = self._self_cb_attr(f)
+            if attr is not None:
+                return f"`self.{attr}`"
+            # non-self receiver: a known callback attribute of the
+            # receiver's type, or a callback-named attribute that is
+            # not any program method
+            t = self._receiver_type(f.value)
+            if t is not None and f.attr in \
+                    self.p.class_callback_attrs(t):
+                return f"`.{f.attr}` of {t.rsplit('.', 1)[-1]}"
+            if t is None and _is_cb_name(f.attr) \
+                    and self.p.unique_method(f.attr) is None \
+                    and self.p.is_known_callback_attr(f.attr):
+                return f"`.{f.attr}`"
+        return None
+
+    # -- traversal ---------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        locked: List[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if is_lock_expr(item.context_expr):
+                lid = self._lock_id(item.context_expr)
+                self.info.acquisitions.append(Event(
+                    held=tuple(self.held), line=item.context_expr.lineno,
+                    lock=lid))
+                self.held.append(lid)
+                locked.append(lid)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in locked:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):
+        return          # nested defs run when called, not here
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        skip = (isinstance(f, ast.Attribute) and f.attr in _SKIP_ATTRS)
+        resolved = None if skip else self._resolve_call(node)
+        if not skip:
+            desc = _blocking_desc(node, resolved)
+            if desc is not None:
+                self.info.blocking.append(Event(
+                    held=tuple(self.held), line=node.lineno, desc=desc))
+            else:
+                cb = self._callback_desc(node)
+                if cb is not None:
+                    self.info.callbacks.append(Event(
+                        held=tuple(self.held), line=node.lineno,
+                        desc=cb))
+                elif resolved is not None:
+                    try:
+                        text = ast.unparse(f)
+                    except Exception:
+                        text = resolved
+                    self.info.calls.append(Event(
+                        held=tuple(self.held), line=node.lineno,
+                        target=resolved, text=text))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------
+# the program
+# --------------------------------------------------------------------------
+
+class Program:
+    """The whole-program index + summaries + transitive queries."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.rel_to_module: Dict[str, str] = {}
+        self._bodies: Dict[str, ast.AST] = {}
+        self._method_index: Dict[str, List[str]] = {}
+        self._cb_attr_names: Set[str] = set()
+        self._resolve_cache: Dict[Tuple[str, str], Tuple] = {}
+        self._ug_cache: Dict[Tuple[str, str], Dict] = {}
+        self._lock_edges: Optional[Dict] = None
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def build(cls, trees: Dict[str, ast.AST]) -> "Program":
+        """``trees``: repo-relative path → parsed module AST."""
+        p = cls()
+        for rel in sorted(trees):
+            _collect_module(p, rel, trees[rel])
+        for name, fi in p.functions.items():
+            if fi.cls is not None:
+                p._method_index.setdefault(fi.name, []).append(fi.cls)
+        for ci in p.classes.values():
+            p._cb_attr_names |= ci.callback_attrs
+        for qual, fi in p.functions.items():
+            body = p._bodies[qual]
+            if fi.name.endswith("_locked"):
+                ci = p.classes.get(fi.cls) if fi.cls else None
+                if ci is not None and ci.lock_attrs:
+                    fi.entry_locks = tuple(
+                        f"{ci.qual}.{a}" for a in sorted(ci.lock_attrs))
+                elif ci is not None:
+                    fi.entry_locks = (f"{ci.qual}._lock",)
+                else:
+                    fi.entry_locks = (f"{fi.module}._lock",)
+            v = _FuncVisitor(p, fi, body)
+            for stmt in body.body:
+                v.visit(stmt)
+        return p
+
+    # -- symbol/class queries ----------------------------------------------
+    def resolve_symbol(self, module: str, dotted: str,
+                       _depth: int = 0) -> Tuple[Optional[str],
+                                                 Optional[str]]:
+        """→ ("func"|"class"|"module", qualname) or (None, None)."""
+        key = (module, dotted)
+        if key in self._resolve_cache:
+            return self._resolve_cache[key]
+        self._resolve_cache[key] = (None, None)   # cycle guard
+        out = self._resolve_uncached(module, dotted, _depth)
+        self._resolve_cache[key] = out
+        return out
+
+    def _resolve_uncached(self, module: str, dotted: str,
+                          depth: int) -> Tuple[Optional[str],
+                                               Optional[str]]:
+        if depth > 8:
+            return (None, None)
+        mod = self.modules.get(module)
+        if mod is None:
+            return (None, None)
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        target: Optional[str] = None
+        if head in mod.functions and not rest:
+            return ("func", mod.functions[head])
+        if head in mod.classes:
+            return self._descend_class(mod.classes[head], rest)
+        if head in mod.imports:
+            target = mod.imports[head]
+        elif dotted in self.modules:
+            return ("module", dotted)
+        else:
+            return (None, None)
+        # target is a module name, or a "pkg.symbol" re-export
+        for _ in range(8):
+            if target in self.modules:
+                if not rest:
+                    return ("module", target)
+                return self.resolve_symbol(target, ".".join(rest),
+                                           depth + 1)
+            if "." in target:
+                base, sym = target.rsplit(".", 1)
+                if base in self.modules:
+                    got = self.resolve_symbol(base, sym, depth + 1)
+                    if got[0] == "class":
+                        return self._descend_class(got[1], rest)
+                    if got[0] == "func" and not rest:
+                        return got
+                    if got[0] == "module":
+                        target = got[1]
+                        continue
+                    return (None, None)
+                # maybe the whole dotted target is a module we know
+                cand = target + ("." + ".".join(rest) if rest else "")
+                if cand in self.modules:
+                    return ("module", cand)
+            return (None, None)
+        return (None, None)
+
+    def _descend_class(self, qual: str, rest: List[str]
+                       ) -> Tuple[Optional[str], Optional[str]]:
+        if not rest:
+            return ("class", qual)
+        if len(rest) == 1:
+            m = self.find_method(qual, rest[0])
+            if m:
+                return ("func", m)
+        return (None, None)
+
+    def find_method(self, class_qual: str, name: str,
+                    _seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Method lookup walking program base classes."""
+        seen = _seen if _seen is not None else set()
+        if class_qual in seen:
+            return None
+        seen.add(class_qual)
+        ci = self.classes.get(class_qual)
+        if ci is None:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            kind, qual = self.resolve_symbol(ci.module, b)
+            if kind == "class":
+                m = self.find_method(qual, name, seen)
+                if m:
+                    return m
+        return None
+
+    # method names shared with builtin collections/strings/files: a
+    # receiver-unknown `.get(...)` is a dict, not the one program class
+    # that happens to define `get` — excluded from the unique fallback
+    _COMMON_ATTRS = frozenset({
+        "get", "set", "items", "keys", "values", "append", "pop",
+        "popleft", "appendleft", "add", "discard", "clear", "copy",
+        "update", "remove", "extend", "insert", "sort", "reverse",
+        "split", "rsplit", "strip", "lstrip", "rstrip", "join",
+        "format", "startswith", "endswith", "read", "write", "close",
+        "open", "flush", "seek", "tell", "encode", "decode", "count",
+        "index", "setdefault", "union", "intersection", "difference",
+        "tobytes", "reshape", "astype", "sum", "mean", "max", "min",
+        "all", "any", "item", "fileno", "lower", "upper", "replace",
+        "find", "put", "get_nowait", "qsize", "is_set", "start",
+        "stop", "run", "search", "match", "group", "result",
+    })
+
+    def unique_method(self, name: str) -> Optional[str]:
+        """``module.Class.name`` when exactly ONE program class defines
+        ``name`` (the receiver-unknown fallback); None otherwise."""
+        if name.startswith("__") or name in self._COMMON_ATTRS:
+            return None
+        owners = self._method_index.get(name, ())
+        if len(owners) == 1:
+            return f"{owners[0]}.{name}"
+        return None
+
+    def class_attr_type(self, class_qual: str,
+                        attr: str) -> Optional[str]:
+        ci = self.classes.get(class_qual)
+        while ci is not None:
+            if attr in ci.attr_types:
+                return ci.attr_types[attr]
+            nxt = None
+            for b in ci.bases:
+                kind, qual = self.resolve_symbol(ci.module, b)
+                if kind == "class":
+                    nxt = self.classes.get(qual)
+                    break
+            ci = nxt
+        return None
+
+    def class_callback_attrs(self, class_qual: str) -> Set[str]:
+        out: Set[str] = set()
+        ci = self.classes.get(class_qual)
+        seen: Set[str] = set()
+        while ci is not None and ci.qual not in seen:
+            seen.add(ci.qual)
+            out |= ci.callback_attrs
+            nxt = None
+            for b in ci.bases:
+                kind, qual = self.resolve_symbol(ci.module, b)
+                if kind == "class":
+                    nxt = self.classes.get(qual)
+                    break
+            ci = nxt
+        return out
+
+    def is_known_callback_attr(self, name: str) -> bool:
+        """Some program class stores a callback under this attribute
+        name (the ``r.on_hit(...)`` shape, receiver type unknown)."""
+        return name in self._cb_attr_names
+
+    # -- transitive summaries ----------------------------------------------
+    def _unguarded(self, qual: str, kind: str,
+                   _stack: Optional[Set[str]] = None
+                   ) -> Dict[str, Tuple[Tuple[str, ...], int]]:
+        """What ``qual`` does when entered with no lock held →
+        {description-or-lock: (call chain, line)}.  ``kind`` is
+        "blocking" | "acquires" | "callbacks"."""
+        key = (qual, kind)
+        if key in self._ug_cache:
+            return self._ug_cache[key]
+        stack = _stack if _stack is not None else set()
+        if qual in stack or qual in TRUSTED_NOOPS:
+            return {}
+        stack.add(qual)
+        fi = self.functions.get(qual)
+        out: Dict[str, Tuple[Tuple[str, ...], int]] = {}
+        if fi is not None:
+            direct = {"blocking": fi.blocking,
+                      "acquires": fi.acquisitions,
+                      "callbacks": fi.callbacks}[kind]
+            for ev in direct:
+                if ev.held:
+                    continue
+                name = ev.lock if kind == "acquires" else ev.desc
+                if kind == "acquires" and name.startswith("?"):
+                    continue
+                out.setdefault(name, ((qual,), ev.line))
+            for call in fi.calls:
+                if call.held or call.target is None:
+                    continue
+                sub = self._unguarded(call.target, kind, stack)
+                for name, (chain, line) in sub.items():
+                    out.setdefault(name, ((qual,) + chain, line))
+        stack.discard(qual)
+        self._ug_cache[key] = out
+        return out
+
+    def unguarded_blocking(self, qual):
+        return self._unguarded(qual, "blocking")
+
+    def unguarded_acquires(self, qual):
+        return self._unguarded(qual, "acquires")
+
+    def unguarded_callbacks(self, qual):
+        return self._unguarded(qual, "callbacks")
+
+    # -- the lock-order graph ----------------------------------------------
+    def lock_edges(self) -> Dict[Tuple[str, str],
+                                 Tuple[str, int, str]]:
+        """held-lock → acquired-lock edges with one attributed site
+        each: {(A, B): (rel, line, via)}."""
+        if self._lock_edges is not None:
+            return self._lock_edges
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        def add(a: str, b: str, rel: str, line: int, via: str):
+            if a.startswith("?") or b.startswith("?") or a == b:
+                return
+            edges.setdefault((a, b), (rel, line, via))
+
+        for fi in self.functions.values():
+            for ev in fi.acquisitions:
+                for h in ev.held:
+                    add(h, ev.lock, fi.rel, ev.line,
+                        f"{fi.qual} acquires directly")
+            for call in fi.calls:
+                if not call.held or call.target is None or \
+                        call.target in TRUSTED_NOOPS:
+                    continue
+                for lock, (chain, _line) in \
+                        self.unguarded_acquires(call.target).items():
+                    for h in call.held:
+                        add(h, lock, fi.rel, call.line,
+                            f"{fi.qual} via " + " -> ".join(chain))
+        self._lock_edges = edges
+        return edges
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Cycles in the lock-order graph (each as a node list with the
+        first node repeated last), discovered via Tarjan SCCs."""
+        edges = self.lock_edges()
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str):
+            work = [(v, iter(adj[v]))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(adj[w])))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        cycles: List[List[str]] = []
+        for scc in sccs:
+            members = set(scc)
+            # one representative cycle path per SCC via DFS
+            start = sorted(scc)[0]
+            path = [start]
+            seen = {start}
+            cur = start
+            while True:
+                nxt = next((w for w in sorted(adj[cur])
+                            if w in members and w not in seen), None)
+                if nxt is None:
+                    back = next((w for w in sorted(adj[cur])
+                                 if w in members and w in seen), start)
+                    path.append(back)
+                    break
+                path.append(nxt)
+                seen.add(nxt)
+                cur = nxt
+            cycles.append(path)
+        return cycles
+
+    def lock_order_dot(self) -> str:
+        """The global lock-order graph as Graphviz DOT (the
+        ``--lock-graph`` export; cycles render red)."""
+        edges = self.lock_edges()
+        cyclic: Set[Tuple[str, str]] = set()
+        for cyc in self.lock_cycles():
+            for a, b in zip(cyc, cyc[1:]):
+                cyclic.add((a, b))
+        lines = ["digraph lock_order {",
+                 "  rankdir=LR;",
+                 '  node [shape=box, fontsize=10];']
+        for (a, b), (rel, line, _via) in sorted(edges.items()):
+            attrs = f'label="{os.path.basename(rel)}:{line}"'
+            if (a, b) in cyclic:
+                attrs += ', color=red, penwidth=2'
+            lines.append(f'  "{a}" -> "{b}" [{attrs}];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# shared construction + caching (the three rules reuse one Program)
+# --------------------------------------------------------------------------
+
+_CACHE: Dict[tuple, Program] = {}
+_CACHE_MAX = 4
+
+
+def extra_program_files(root: str,
+                        seen: Sequence[str]) -> Dict[str, str]:
+    """raft_tpu sources under ``root`` not in ``seen`` (rel → abs
+    path) — the interprocedural rules always analyze the WHOLE program
+    even when the engine scanned a subset (e.g. ``--changed-only`` or
+    an explicit subtree), so summaries never miss a callee."""
+    seen_set = set(seen)
+    out: Dict[str, str] = {}
+    top = os.path.join(root, "raft_tpu")
+    if not os.path.isdir(top):
+        return out
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel not in seen_set:
+                out[rel] = path
+    return out
+
+
+def get_program(contexts: Dict[str, object],
+                root: Optional[str]) -> Program:
+    """Build (or fetch from cache) the Program over ``contexts``
+    (rel → FileContext with a parsed ``.tree``) plus every other
+    ``raft_tpu`` file under ``root``."""
+    trees: Dict[str, ast.AST] = {
+        rel: ctx.tree for rel, ctx in contexts.items()
+        if getattr(ctx, "tree", None) is not None}
+    fingerprint: List[tuple] = [
+        (rel, hash(ctx.text)) for rel, ctx in sorted(contexts.items())
+        if getattr(ctx, "tree", None) is not None]
+    extra = extra_program_files(root, list(trees)) if root else {}
+    texts: Dict[str, str] = {}
+    for rel, path in sorted(extra.items()):
+        try:
+            with open(path, encoding="utf-8") as f:
+                texts[rel] = f.read()
+        except OSError:
+            continue
+        fingerprint.append((rel, hash(texts[rel])))
+    key = tuple(fingerprint)
+    prog = _CACHE.get(key)
+    if prog is not None:
+        return prog
+    for rel, text in texts.items():
+        try:
+            trees[rel] = ast.parse(text, filename=rel)
+        except SyntaxError:
+            continue        # GL000 reports it when in scope
+    prog = Program.build(trees)
+    if len(_CACHE) >= _CACHE_MAX:
+        _CACHE.pop(next(iter(_CACHE)))
+    _CACHE[key] = prog
+    return prog

@@ -149,6 +149,25 @@ def get_rule(code: str) -> Type[Rule]:
 # shared AST helpers (used by several rules)
 # --------------------------------------------------------------------------
 
+# recognized lock objects (GL003/GL007/GL008/GL009 and the call-graph
+# summaries agree on this): ``self.X``/bare ``X`` where X is one of
+# these names (any case) or ends in ``_lock``/``_cond``
+LOCK_NAMES = {"_lock", "lock", "_cond", "cond", "_mu", "_mutex"}
+
+
+def is_lock_expr(node: ast.AST) -> bool:
+    """True when ``node`` names a lock by the tree's conventions."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return False
+    low = name.lower()
+    return (low in LOCK_NAMES or low.endswith("_lock")
+            or low.endswith("_cond"))
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """``jax.experimental.shard_map.shard_map`` for nested Attributes,
     ``jit`` for a bare Name; None for anything else."""

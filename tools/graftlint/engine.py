@@ -14,7 +14,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import time
 from collections import Counter
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -51,13 +53,50 @@ def iter_source_files(root: str,
     return sorted(set(out))
 
 
+def changed_files(root: str) -> List[str]:
+    """Repo-relative ``.py`` files changed vs HEAD — staged, unstaged
+    AND untracked — filtered to the scan roots (the ``--changed-only``
+    selection).  Returns [] when git is unavailable or ``root`` is not
+    a work tree (the caller falls back to a clean no-op run)."""
+    lines: List[str] = []
+    for args in (("git", "diff", "--name-only", "HEAD", "--"),
+                 ("git", "ls-files", "--others", "--exclude-standard")):
+        try:
+            r = subprocess.run(args, cwd=root, capture_output=True,
+                               text=True, timeout=15)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if r.returncode != 0:
+            return []
+        lines += r.stdout.splitlines()
+    out = set()
+    for rel in lines:
+        rel = rel.strip().replace("\\", "/")
+        if not rel.endswith(".py"):
+            continue
+        in_scope = any(rel == sr or rel.startswith(sr.rstrip("/") + "/")
+                       for sr in SCAN_ROOTS)
+        if in_scope and os.path.exists(os.path.join(root, rel)):
+            out.add(rel)
+    return sorted(out)
+
+
 def run(root: str = REPO, files: Optional[Sequence[str]] = None,
         select: Optional[Iterable[str]] = None,
+        respect_scope: bool = False,
+        timings: Optional[Dict[str, float]] = None,
         ) -> Tuple[List[Finding], List[Finding]]:
     """Run the (selected) rules over ``files`` (default: the scan
     roots) → ``(findings, suppressed)``, both sorted.  Suppressed
     findings carried a ``# graftlint: disable=`` pragma on their line;
-    they are returned separately so the CLI can report the count."""
+    they are returned separately so the CLI can report the count.
+
+    ``files`` normally bypasses rule path *scoping* (you pointed at
+    it, it gets linted); ``respect_scope=True`` keeps scoping active —
+    the ``--changed-only`` selection, where a changed file outside a
+    rule's contract must not suddenly enter it.  Pass a dict as
+    ``timings`` to collect per-rule wall time (seconds, check +
+    finalize) keyed by rule code."""
     codes = set(select) if select else None
     rules = [cls() for code, cls in all_rules().items()
              if codes is None or code in codes]
@@ -66,10 +105,19 @@ def run(root: str = REPO, files: Optional[Sequence[str]] = None,
         if unknown:
             raise KeyError(f"unknown rule(s): {', '.join(sorted(unknown))}")
     paths = [os.path.abspath(f) for f in files] if files else None
-    explicit = paths is not None
+    explicit = paths is not None and not respect_scope
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     contexts: Dict[str, FileContext] = {}
+
+    def timed_iter(rule, gen):
+        t0 = time.perf_counter()
+        out = list(gen)
+        if timings is not None:
+            timings[rule.code] = timings.get(rule.code, 0.0) \
+                + (time.perf_counter() - t0)
+        return out
+
     for path in iter_source_files(root, paths):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         try:
@@ -87,10 +135,10 @@ def run(root: str = REPO, files: Optional[Sequence[str]] = None,
         for rule in rules:
             if not rule.applies_to(rel, explicit=explicit):
                 continue
-            for f in rule.check(ctx):
+            for f in timed_iter(rule, rule.check(ctx)):
                 (suppressed if ctx.suppressed(f) else findings).append(f)
     for rule in rules:
-        for f in rule.finalize():
+        for f in timed_iter(rule, rule.finalize()):
             ctx = contexts.get(f.file)
             if ctx is not None and ctx.suppressed(f):
                 suppressed.append(f)
@@ -98,6 +146,30 @@ def run(root: str = REPO, files: Optional[Sequence[str]] = None,
                 findings.append(f)
     order = (lambda f: (f.file, f.line, f.col, f.rule))
     return sorted(findings, key=order), sorted(suppressed, key=order)
+
+
+def lock_graph_dot(root: str = REPO,
+                   files: Optional[Sequence[str]] = None
+                   ) -> Tuple[str, List[List[str]]]:
+    """The GL007 whole-program lock-order graph as Graphviz DOT plus
+    any cycles (the ``--lock-graph`` export).  Scans ``raft_tpu``
+    under ``root`` by default."""
+    from tools.graftlint import callgraph
+    paths = ([os.path.abspath(f) for f in files] if files
+             else [os.path.join(root, "raft_tpu")])
+    contexts: Dict[str, FileContext] = {}
+    for path in iter_source_files(root, paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        ctx = FileContext(path, rel, text)
+        if ctx.tree is not None:
+            contexts[rel] = ctx
+    program = callgraph.get_program(contexts, root)
+    return program.lock_order_dot(), program.lock_cycles()
 
 
 # --------------------------------------------------------------------------
@@ -157,8 +229,12 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> dict:
 # --------------------------------------------------------------------------
 
 def to_json(new: Sequence[Finding], grandfathered: Sequence[Finding],
-            suppressed: Sequence[Finding]) -> dict:
-    """The ``--json`` schema (checked by tests/test_graftlint.py)."""
+            suppressed: Sequence[Finding],
+            timings: Optional[Dict[str, float]] = None) -> dict:
+    """The ``--json`` schema (checked by tests/test_graftlint.py).
+    ``timings`` (per-rule wall seconds from :func:`run`) lands as
+    per-rule milliseconds so precommit latency regressions are
+    attributable to a rule, not just to "the lint"."""
     return {
         "version": JSON_VERSION,
         "findings": [
@@ -169,6 +245,8 @@ def to_json(new: Sequence[Finding], grandfathered: Sequence[Finding],
         "counts": dict(Counter(f.rule for f in new)),
         "grandfathered": len(grandfathered),
         "suppressed": len(suppressed),
+        "timings_ms": {code: round(s * 1e3, 3)
+                       for code, s in sorted((timings or {}).items())},
     }
 
 
@@ -182,8 +260,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--select", default=None,
                     help="comma-separated rule codes to run (e.g. "
                          "GL001,GL003); default: all")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only .py files changed vs HEAD (git "
+                         "diff + untracked), rule path scopes still "
+                         "applied — the fast dev loop; CI/precommit "
+                         "stays full-tree")
+    ap.add_argument("--lock-graph", nargs="?", const="-",
+                    metavar="FILE", default=None,
+                    help="emit the GL007 whole-program lock-order "
+                         "graph as Graphviz DOT (to FILE, default "
+                         "stdout) and exit; exit 1 if the graph has "
+                         "cycles")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+                    help="machine-readable output (includes per-rule "
+                         "timings_ms)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help=f"baseline file (default: {DEFAULT_BASELINE} "
                          f"when it exists)")
@@ -204,11 +294,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(f"       {cls.description}")
         return 0
 
+    if args.lock_graph is not None:
+        dot, cycles = lock_graph_dot(REPO, files=args.paths or None)
+        if args.lock_graph == "-":
+            print(dot)
+        else:
+            with open(args.lock_graph, "w", encoding="utf-8") as f:
+                f.write(dot + "\n")
+            print(f"graftlint: wrote lock-order graph to "
+                  f"{args.lock_graph}")
+        if cycles:
+            print(f"graftlint: lock-order graph has {len(cycles)} "
+                  f"cycle(s)", file=sys.stderr)
+            return 1
+        return 0
+
     select = ([c.strip() for c in args.select.split(",") if c.strip()]
               if args.select else None)
+    files: Optional[Sequence[str]] = args.paths or None
+    respect_scope = False
+    if args.changed_only:
+        if files or args.write_baseline:
+            print("graftlint: --changed-only excludes explicit paths "
+                  "and --write-baseline (a partial-tree baseline "
+                  "would un-grandfather everything else)",
+                  file=sys.stderr)
+            return 2
+        changed = changed_files(REPO)
+        if not changed:
+            print("graftlint: clean (no changed files)",
+                  file=sys.stderr)
+            return 0
+        files = [os.path.join(REPO, rel) for rel in changed]
+        respect_scope = True
+    timings: Dict[str, float] = {}
     try:
-        findings, suppressed = run(REPO, files=args.paths or None,
-                                   select=select)
+        findings, suppressed = run(REPO, files=files, select=select,
+                                   respect_scope=respect_scope,
+                                   timings=timings)
     except KeyError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
@@ -229,8 +352,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     new, grandfathered = split_new(findings, allow)
 
     if args.as_json:
-        print(json.dumps(to_json(new, grandfathered, suppressed),
-                         indent=2))
+        print(json.dumps(to_json(new, grandfathered, suppressed,
+                                 timings), indent=2))
     else:
         for f in new:
             print(f.render())

@@ -1,7 +1,10 @@
 """Rule modules — importing this package registers every rule."""
 
+from tools.graftlint.rules import blocking  # noqa: F401
+from tools.graftlint.rules import callback  # noqa: F401
 from tools.graftlint.rules import clock  # noqa: F401
 from tools.graftlint.rules import host_sync  # noqa: F401
+from tools.graftlint.rules import lockorder  # noqa: F401
 from tools.graftlint.rules import locks  # noqa: F401
 from tools.graftlint.rules import metrics  # noqa: F401
 from tools.graftlint.rules import precision  # noqa: F401

@@ -1,0 +1,90 @@
+"""GL008 — unbounded blocking reachable while a lock is held.
+
+The stall class ISSUE 10 paid for dynamically: anything that can block
+without bound — ``os.fsync``, ``comms.sync_stream``, ``Future.result``,
+``Thread.join``, ``time.sleep``, ``block_until_ready``, plan compiles,
+host<->device transfers — executed while a lock is held turns ONE slow
+operation into a stall of every thread contending that lock (the
+serving dispatcher included).  The per-function GL003 cannot see a
+``_locked`` method calling ``wal.append_upsert`` three frames away
+from the fsync; this rule propagates blocking summaries through the
+:mod:`tools.graftlint.callgraph` call graph and reports the call site
+where the lock is actually held.
+
+Reporting discipline: an operation under a function's OWN lock (or a
+``_locked`` method's entry lock) is reported inside that function,
+once per (function, operation) — callers are not re-flagged for it.
+``Condition.wait`` is exempt (it releases the lock it waits on), and
+``raft_tpu.testing.faults.inject`` is a trusted production no-op
+(callgraph docstring).
+
+A justified hold stays allowed via ``# graftlint: disable=GL008`` with
+a comment — e.g. a WAL append whose durability-before-apply ordering
+REQUIRES the mutation lock (``mutate/mutable.py`` documents each one).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Set
+
+from tools.graftlint.core import Finding, register
+from tools.graftlint.rules.interproc import (InterproceduralRule,
+                                             chain_desc, held_desc)
+
+
+@register
+class BlockingUnderLock(InterproceduralRule):
+    code = "GL008"
+    name = "blocking-under-lock"
+    description = ("unbounded-blocking calls (fsync, sync_stream, "
+                   "Future.result, Thread.join, sleep, "
+                   "block_until_ready, plan compiles, host<->device "
+                   "transfers) reachable — transitively, through the "
+                   "call graph — while a lock is held")
+    paths = ("raft_tpu",)
+    report_paths = ("raft_tpu/serve", "raft_tpu/mutate",
+                    "raft_tpu/obs", "raft_tpu/comms",
+                    "raft_tpu/testing")
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._contexts:
+            return
+        program = self.program()
+        seen: Set[tuple] = set()
+        for fi in program.functions.values():
+            if not self._eligible(fi.rel):
+                continue
+            for ev in fi.blocking:
+                if not ev.held:
+                    continue
+                key = (fi.qual, ev.desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding_at(
+                    fi.rel, ev.line,
+                    f"{ev.desc} while holding {held_desc(ev.held)} "
+                    f"(in `{fi.name}`) — unbounded blocking under a "
+                    f"lock stalls every thread contending it; move "
+                    f"the operation outside the hold or justify with "
+                    f"a disable pragma")
+            for call in fi.calls:
+                if not call.held or call.target is None:
+                    continue
+                blocked = program.unguarded_blocking(call.target)
+                if not blocked:
+                    continue
+                key = (fi.qual, call.target)
+                if key in seen:
+                    continue
+                seen.add(key)
+                desc, (chain, _line) = sorted(blocked.items())[0]
+                more = (f" (+{len(blocked) - 1} more)"
+                        if len(blocked) > 1 else "")
+                yield self.finding_at(
+                    fi.rel, call.line,
+                    f"`{call.text}(...)` may block on {desc} "
+                    f"(via {chain_desc(chain)}){more} while holding "
+                    f"{held_desc(call.held)} (in `{fi.name}`) — move "
+                    f"the call outside the hold or justify with a "
+                    f"disable pragma")

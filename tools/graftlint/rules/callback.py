@@ -1,0 +1,92 @@
+"""GL009 — user-supplied callables invoked while a lock is held.
+
+A callback run under your lock executes ARBITRARY user code inside
+your critical section: it can take its own locks (instant lock-order
+inversion — the GL007 class, created at runtime by whoever registered
+the listener), call back into the locked object (self-deadlock on a
+non-reentrant lock), or simply be slow (the GL008 class).  PR 11 fires
+``MutableIndex`` epoch listeners outside the lock *by convention and a
+comment*; the quality ``estimator`` fn, fault-injection ``on_hit``
+hooks and future logger callbacks rely on the same discipline.  This
+rule makes the invariant machine-checked.
+
+Callback identification (heuristic, documented): a parameter whose
+annotation mentions ``Callable`` or whose name is callback-shaped
+(``fn``, ``callback``, ``cb``, ``hook``, ``listener(s)``,
+``estimator``, ``on_*``); an attribute assigned from such a parameter
+(including the ``self._listeners = self._listeners + (fn,)``
+accumulation shape); locals bound or iterated from such attributes.
+Invoking any of these with a lock held — directly or transitively
+through the call graph — is flagged at the site holding the lock.
+
+The fix is the snapshot idiom ``mutate/mutable.py`` uses::
+
+    with self._cond:
+        listeners = self._epoch_listeners     # snapshot under lock
+    for fn in listeners:
+        fn(number)                            # invoke OUTSIDE it
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Set
+
+from tools.graftlint.core import Finding, register
+from tools.graftlint.rules.interproc import (InterproceduralRule,
+                                             chain_desc, held_desc)
+
+
+@register
+class CallbackUnderLock(InterproceduralRule):
+    code = "GL009"
+    name = "callback-under-lock"
+    description = ("user-supplied callables (listeners, estimator "
+                   "fns, hooks) invoked — transitively — with a lock "
+                   "held: arbitrary code in the critical section can "
+                   "deadlock or stall it; snapshot under the lock, "
+                   "invoke outside")
+    paths = ("raft_tpu",)
+    report_paths = ("raft_tpu/serve", "raft_tpu/mutate",
+                    "raft_tpu/obs", "raft_tpu/comms",
+                    "raft_tpu/testing")
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._contexts:
+            return
+        program = self.program()
+        seen: Set[tuple] = set()
+        for fi in program.functions.values():
+            if not self._eligible(fi.rel):
+                continue
+            for ev in fi.callbacks:
+                if not ev.held:
+                    continue
+                key = (fi.qual, ev.desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding_at(
+                    fi.rel, ev.line,
+                    f"user-supplied callable {ev.desc} invoked while "
+                    f"holding {held_desc(ev.held)} (in `{fi.name}`) — "
+                    f"arbitrary code inside the critical section; "
+                    f"snapshot the callable under the lock and invoke "
+                    f"it outside (mutate/mutable.py "
+                    f"`_notify_epoch_listeners` is the model)")
+            for call in fi.calls:
+                if not call.held or call.target is None:
+                    continue
+                cbs = program.unguarded_callbacks(call.target)
+                if not cbs:
+                    continue
+                key = (fi.qual, call.target)
+                if key in seen:
+                    continue
+                seen.add(key)
+                desc, (chain, _line) = sorted(cbs.items())[0]
+                yield self.finding_at(
+                    fi.rel, call.line,
+                    f"`{call.text}(...)` invokes user-supplied "
+                    f"callable {desc} (via {chain_desc(chain)}) while "
+                    f"holding {held_desc(call.held)} (in `{fi.name}`) "
+                    f"— snapshot under the lock, invoke outside")

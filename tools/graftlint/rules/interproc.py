@@ -1,0 +1,84 @@
+"""Shared machinery for the interprocedural rules (GL007–GL009).
+
+These rules are whole-program: ``check`` only records the files the
+engine selected (path scope or explicit CLI paths decide where
+findings may be REPORTED), and ``finalize`` analyzes the full
+``raft_tpu`` program — :func:`callgraph.get_program` always loads the
+rest of the tree from the scan root, so a ``--changed-only`` or
+subtree run still sees every callee and every lock (findings are just
+filtered to the selected files).  One :class:`callgraph.Program` is
+shared by all three rules per run via the module-level cache.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional, Sequence, Set
+
+from tools.graftlint import callgraph
+from tools.graftlint.core import FileContext, Finding, Rule
+
+
+def short_lock(lock_id: str) -> str:
+    """``raft_tpu.serve.batcher.SearchServer._cond`` →
+    ``SearchServer._cond`` (messages stay readable)."""
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else lock_id
+
+
+def held_desc(held: Sequence[str]) -> str:
+    real = [short_lock(h) for h in held if not h.startswith("?")]
+    if not real:
+        return "a lock"
+    return " and ".join(sorted(set(real)))
+
+
+def chain_desc(chain: Sequence[str]) -> str:
+    return " -> ".join(q.split(".")[-1] if i else short_lock(q)
+                       for i, q in enumerate(chain))
+
+
+class InterproceduralRule(Rule):
+    """Base: collect contexts in ``check``, analyze in ``finalize``."""
+
+    # program collection scope: the whole library tree
+    paths = ("raft_tpu",)
+    # where findings may be reported (subclasses narrow this);
+    # explicitly-named CLI files are always eligible
+    report_paths: tuple = ("raft_tpu",)
+    excludes = ("tools/graftlint",)
+
+    def __init__(self):
+        self._contexts: Dict[str, FileContext] = {}
+        self._explicit: Set[str] = set()
+        self._root: Optional[str] = None
+
+    def applies_to(self, rel: str, explicit: bool = False) -> bool:
+        ok = super().applies_to(rel, explicit)
+        if ok and explicit:
+            self._explicit.add(rel.replace("\\", "/"))
+        return ok
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        self._contexts[ctx.rel] = ctx
+        if self._root is None and not ctx.rel.startswith(".."):
+            path = os.path.abspath(ctx.path).replace(os.sep, "/")
+            if path.endswith("/" + ctx.rel):
+                self._root = path[:-len(ctx.rel) - 1]
+        return ()
+
+    def _eligible(self, rel: str) -> bool:
+        if rel not in self._contexts:
+            return False
+        if rel in self._explicit:
+            return True
+        for p in self.report_paths:
+            if rel == p or rel.startswith(p.rstrip("/") + "/"):
+                return True
+        return False
+
+    def program(self) -> callgraph.Program:
+        return callgraph.get_program(self._contexts, self._root)
+
+    def finding_at(self, rel: str, line: int, message: str) -> Finding:
+        return self._contexts[rel].finding(self.code, line, message)

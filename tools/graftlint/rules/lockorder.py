@@ -1,0 +1,83 @@
+"""GL007 — global lock-order graph, cycles flagged as deadlocks.
+
+The deadlock class the per-function GL003 cannot see: thread 1 holds
+``MutableIndex._cond`` and (transitively, through any call chain)
+tries to take the batcher's ``SearchServer._cond`` while thread 2
+holds the batcher's lock and calls into the mutable index — a
+lock-order inversion that hangs both threads forever, and only under
+load.  PRs 8–11 created exactly this topology (dispatcher + watchdog,
+compactor daemon, quality shadow thread, SLO poller, health monitor
+all sharing ``serve/``/``mutate/``/``obs/``/``comms/`` state), and
+the ROADMAP's replica/tiered/actuator items add more threads on the
+same locks.
+
+The rule consumes :mod:`tools.graftlint.callgraph`: per-function lock
+acquisition summaries (``with self._lock/_cond`` with class-qualified
+lock identities, ``_locked``-suffix methods entering with their
+class's locks held) are propagated through the call graph; every
+(held, acquired) pair is an edge in the global lock-order graph; any
+cycle is a potential deadlock, reported once per cycle with every
+edge's site.  The full graph is exportable as Graphviz DOT via
+``python -m tools.graftlint --lock-graph`` and asserted acyclic in
+``tests/test_graftlint_concurrency.py``.
+
+Same-identity self-edges (A→A) are ignored: two instances of one
+class share a static lock identity, and same-instance re-entry is
+GL003's territory.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from tools.graftlint.core import Finding, register
+from tools.graftlint.rules.interproc import (InterproceduralRule,
+                                             short_lock)
+
+
+@register
+class LockOrder(InterproceduralRule):
+    code = "GL007"
+    name = "lock-order-cycle"
+    description = ("cycles in the whole-program lock-order graph "
+                   "(held-lock -> acquired-lock edges propagated "
+                   "through the call graph) — a lock-order inversion "
+                   "between two threads is a deadlock waiting for "
+                   "load; export the graph with --lock-graph")
+    paths = ("raft_tpu",)
+    report_paths = ("raft_tpu",)
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._contexts:
+            return
+        program = self.program()
+        edges = program.lock_edges()
+        for cyc in program.lock_cycles():
+            pairs = list(zip(cyc, cyc[1:]))
+            sites = []
+            anchor = None
+            for a, b in pairs:
+                site = edges.get((a, b))
+                if site is None:
+                    continue
+                rel, line, via = site
+                sites.append(f"{short_lock(a)} -> {short_lock(b)} "
+                             f"at {rel}:{line} ({via})")
+                if anchor is None and self._eligible(rel):
+                    anchor = (rel, line)
+            if anchor is None:
+                continue        # cycle entirely outside the selection
+            path = " -> ".join(short_lock(n) for n in cyc)
+            yield self.finding_at(
+                anchor[0], anchor[1],
+                f"lock-order cycle (potential deadlock): {path}; "
+                f"edges: {'; '.join(sites)} — acquire these locks in "
+                f"one global order, or restructure so no call path "
+                f"holds one while taking the other")
+
+    # introspection surface for tests / the --lock-graph CLI
+    def lock_graph_dot(self) -> str:
+        return self.program().lock_order_dot()
+
+    def lock_cycles(self) -> List[List[str]]:
+        return self.program().lock_cycles()

@@ -26,23 +26,13 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Optional, Set
 
-from tools.graftlint.core import (FileContext, Finding, Rule, register,
-                                  str_tuple)
+from tools.graftlint.core import (FileContext, Finding, Rule,
+                                  is_lock_expr, register, str_tuple)
 
-LOCK_NAMES = {"_lock", "lock", "_cond", "cond", "_mu", "_mutex"}
 EXEMPT_METHODS = {"__init__", "__del__", "__enter__"}
 
-
-def _is_lock_expr(node: ast.AST) -> bool:
-    if isinstance(node, ast.Attribute):
-        name = node.attr
-    elif isinstance(node, ast.Name):
-        name = node.id
-    else:
-        return False
-    low = name.lower()
-    return (low in LOCK_NAMES or low.endswith("_lock")
-            or low.endswith("_cond"))
+# shared with callgraph.py / GL007–GL009 via core.is_lock_expr
+_is_lock_expr = is_lock_expr
 
 
 def _with_locks(node: ast.With) -> bool:
@@ -132,9 +122,14 @@ class LockDiscipline(Rule):
     description = ("_locked-suffix methods called without the lock and "
                    "GUARDED_BY fields touched outside `with "
                    "self._lock/_cond` (static race detector for the "
-                   "PR 5 dispatcher/caller thread boundary and the "
-                   "ISSUE 9 mutate dispatcher/compactor boundary)")
-    paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate")
+                   "PR 5 dispatcher/caller thread boundary, the "
+                   "ISSUE 9 mutate dispatcher/compactor boundary, and "
+                   "the ISSUE 11 shadow/SLO threads)")
+    # the threaded modules that postdate PR 6 are scoped explicitly:
+    # quality's shadow thread, the SLO poller, the chaos harness
+    paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate",
+             "raft_tpu/obs/quality.py", "raft_tpu/obs/slo.py",
+             "raft_tpu/testing/faults.py")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         tree = ctx.tree

@@ -20,12 +20,17 @@ else
     python -m compileall -q raft_tpu || fail=1
 fi
 
-# graftlint (ISSUE 6): the JAX/TPU-aware static-analysis gate — host
-# syncs in jit, retrace hazards, serve/comms lock discipline, missing
-# matmul precision, wall-clock misuse, metric-name taxonomy. Strict on
-# new code: only findings grandfathered in the checked-in baseline
-# pass (docs/static_analysis.md has the suppression/baseline workflow).
-echo "precommit: graftlint static analysis"
+# graftlint (ISSUE 6, interprocedural since ISSUE 12): the JAX/TPU-
+# aware static-analysis gate — host syncs in jit, retrace hazards,
+# serve/comms lock discipline, missing matmul precision, wall-clock
+# misuse, metric-name taxonomy, PLUS the whole-program concurrency
+# rules: GL007 lock-order cycles (the global graph must stay acyclic),
+# GL008 blocking-under-lock and GL009 callback-under-lock across
+# serve/mutate/obs/comms. Strict on new code with an EMPTY baseline:
+# any live finding — a seeded lock-order inversion included — fails
+# this line (docs/static_analysis.md has the suppression workflow;
+# `--changed-only` is the fast dev loop, CI stays full-tree).
+echo "precommit: graftlint static analysis (full tree, all rules)"
 python -m tools.graftlint --baseline tools/graftlint_baseline.json \
     || fail=1
 
