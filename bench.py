@@ -419,6 +419,36 @@ def child_main():
         except Exception as e:
             out["quality_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # fleet row (ISSUE 13): aggregate QPS vs replica count behind
+        # the power-of-two-choices front door, availability through a
+        # full replica kill, and a rolling restart under load — the
+        # millions-of-users serving axis
+        try:
+            rows = []
+            bench_suite.bench_fleet(rows, n=min(n_ivf, 100_000))
+            for r in rows:
+                if "fleet_qps_x1" in r:
+                    out["fleet_qps_x1"] = r["fleet_qps_x1"]
+                    out["fleet_qps_x2"] = r["fleet_qps_x2"]
+                    out["fleet_qps_x4"] = r["fleet_qps_x4"]
+                    out["fleet_scaling_x4"] = r["fleet_scaling_x4"]
+                    out["fleet_scaling_ok"] = r["fleet_scaling_ok"]
+                    out["fleet_availability"] = \
+                        r["fleet_availability"]
+                    out["fleet_availability_ok"] = \
+                        r["fleet_availability_ok"]
+                    out["fleet_hung_requests"] = \
+                        r["fleet_hung_requests"]
+                    out["fleet_steady_state_compiles"] = \
+                        r["fleet_steady_state_compiles"]
+                    out["fleet_rolling_ok"] = r["fleet_rolling_ok"]
+                    out["fleet_rolling_failed_requests"] = \
+                        r["fleet_rolling_failed_requests"]
+                elif "error" in r:
+                    out.setdefault("fleet_error", r["error"])
+        except Exception as e:
+            out["fleet_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

@@ -1408,6 +1408,200 @@ def bench_quality(results, n=None, nlists=256, n_probes=None):
         server.close()
 
 
+def bench_fleet(results, n=None, nlists=64):
+    """Fleet-serving bench (ISSUE 13): N single-host replicas behind
+    the power-of-two-choices :class:`raft_tpu.fleet.FleetRouter` at
+    the flat bench point. Three rows:
+
+    * **scaling** — aggregate closed-loop QPS at 1/2/4 replicas (the
+      ~linear-scaling acceptance axis). The ratio gate only ARMS when
+      the process sees multiple accelerator devices
+      (``fleet_scaling_gated``): on the CPU smoke every replica shares
+      one device's cores, so adding replicas adds contention, not
+      capacity — the ratios are reported for the record and the
+      capacity-scaling property is proven by
+      ``tests/test_fleet.py`` with service-time-dominated fake
+      replicas instead. One-replica-per-chip/host is the deployment
+      shape the hardware round (r6 stage ``fl0``) measures.
+    * **availability through a replica kill** — open-loop traffic over
+      3 replicas while one is killed (no drain) mid-run and revived:
+      availability must stay ≥ 0.999 with zero steady-state compiles
+      fleet-wide (``raft.plan.cache.*`` — the revived replica warms
+      from the shared plan cache).
+    * **rolling restart** — one full rollout under the same open-loop
+      load: zero failed requests is the acceptance figure.
+
+    Knobs: ``BENCH_FLEET_N`` (rows, default 60k),
+    ``BENCH_FLEET_SECONDS`` (per-phase window, default 2.0),
+    ``BENCH_FLEET_CLIENTS`` (closed-loop callers per replica, 4)."""
+    import importlib.util
+    import threading
+    import jax
+    from raft_tpu import fleet, obs, serve
+    from raft_tpu.neighbors import ivf_flat
+    n = n or int(os.environ.get("BENCH_FLEET_N", 60_000))
+    seconds = float(os.environ.get("BENCH_FLEET_SECONDS", 2.0))
+    per_rep_clients = int(os.environ.get("BENCH_FLEET_CLIENTS", 4))
+    d, nq_pool, k = 128, 256, 32
+    metric = f"fleet_serve_{n//1000}kx{d}"
+    db, q = _ann_dataset(n, d, nq_pool)
+    q_np = np.asarray(q)
+    index = ivf_flat.build(db, ivf_flat.IndexParams(
+        n_lists=nlists, kmeans_n_iters=10))
+    n_probes = min(FLAT_PROBES, nlists)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    cfg = serve.ServeConfig(batch_sizes=(1, 8, 32), max_queue=512,
+                            max_wait_ms=2.0,
+                            default_deadline_ms=3000.0)
+
+    def build_server():
+        return serve.SearchServer.from_index(index, q_np[:32], k,
+                                             params=sp, config=cfg)
+
+    def closed_loop_qps(router, clients):
+        stop_t = time.perf_counter() + seconds
+        counts = []
+        lock = threading.Lock()
+
+        def client(tid):
+            i, done = tid, 0
+            while time.perf_counter() < stop_t:
+                router.search(q_np[i % nq_pool:i % nq_pool + 1],
+                              timeout=60.0)
+                done += 1
+                i += clients
+            with lock:
+                counts.append(done)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    try:
+        # -- scaling: aggregate QPS at 1 / 2 / 4 replicas ---------------
+        qps = {}
+        compiles_by_count = {}
+        for n_reps in (1, 2, 4):
+            reps = [fleet.Replica(f"r{i}", build_server())
+                    for i in range(n_reps)]
+            router = fleet.FleetRouter(reps)
+            before = obs.snapshot()
+            qps[n_reps] = closed_loop_qps(router,
+                                          per_rep_clients * n_reps)
+            diff = obs.snapshot_diff(before, obs.snapshot())
+            cnt = diff.get("counters", {})
+            compiles_by_count[n_reps] = int(
+                cnt.get("raft.plan.cache.misses", 0.0)
+                + cnt.get("raft.plan.build.total", 0.0))
+            router.close(drain_timeout_s=10.0)
+        x2 = qps[2] / max(qps[1], 1e-9)
+        x4 = qps[4] / max(qps[1], 1e-9)
+        # the ratio gate arms only with real per-replica capacity
+        # (multiple accelerator devices); shared-device smokes report
+        # the ratios for the record without failing on contention
+        scaling_gated = (jax.device_count() > 1
+                         and jax.default_backend() != "cpu")
+        scaling_ok = (x2 >= 1.4 and x4 >= 2.0) if scaling_gated \
+            else True
+
+        # -- availability through a replica kill ------------------------
+        spec = importlib.util.spec_from_file_location(
+            "raft_loadgen",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "loadgen.py"))
+        loadgen = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen)
+        reps = [fleet.Replica(f"k{i}", build_server())
+                for i in range(3)]
+        router = fleet.FleetRouter(
+            reps, fleet.FleetConfig(max_retries=1, suspect_ms=500.0,
+                                    default_deadline_ms=3000.0))
+        rate = max(30.0, 0.4 * qps[1])
+        window = max(3.0, 2 * seconds)
+        before = obs.snapshot()
+        release = threading.Event()
+
+        def chaos():
+            release.wait(window / 3.0)
+            reps[1].kill()      # no drain — a crash, not a deploy
+            release.wait(window / 3.0)
+            reps[1].begin_bootstrap()
+            reps[1].set_server(build_server())
+            reps[1].mark_serving()
+
+        ct = threading.Thread(target=chaos, daemon=True)
+        ct.start()
+        rep = loadgen.run_open_loop(router, q_np, rate_qps=rate,
+                                    duration_s=window, nq=1,
+                                    deadline_ms=3000.0, seed=0)
+        release.set()
+        ct.join(timeout=60.0)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+        kill_compiles = int(cnt.get("raft.plan.cache.misses", 0.0)
+                            + cnt.get("raft.plan.build.total", 0.0))
+        hung = rep["offered"] - (rep["completed"] + rep["shed"]
+                                 + rep["deadline_expired"]
+                                 + rep["errors"])
+
+        # -- rolling restart under load ---------------------------------
+        def restart(replica):
+            replica.set_server(build_server())
+
+        roll_fail = {}
+
+        def rolling():
+            roll_fail["report"] = fleet.rolling_restart(
+                router, restart, drain_timeout_s=30.0)
+
+        rt = threading.Thread(target=rolling, daemon=True)
+        rt.start()
+        rep_roll = loadgen.run_open_loop(router, q_np, rate_qps=rate,
+                                         duration_s=window, nq=1,
+                                         deadline_ms=3000.0, seed=1)
+        rt.join(timeout=120.0)
+        roll_report = roll_fail.get("report", {"ok": False})
+        roll_failed = (rep_roll["shed"] + rep_roll["errors"]
+                       + rep_roll["deadline_expired"])
+
+        results.append({
+            "metric": metric,
+            "value": round(qps[4], 1), "unit": "qps_x4",
+            "fleet_qps_x1": round(qps[1], 1),
+            "fleet_qps_x2": round(qps[2], 1),
+            "fleet_qps_x4": round(qps[4], 1),
+            "fleet_scaling_x2": round(x2, 3),
+            "fleet_scaling_x4": round(x4, 3),
+            "fleet_scaling_gated": scaling_gated,
+            "fleet_scaling_ok": scaling_ok,
+            "fleet_shared_device": not scaling_gated,
+            "fleet_availability": rep["availability"],
+            "fleet_availability_ok": rep["availability"] >= 0.999,
+            "fleet_hung_requests": int(hung),
+            "fleet_kill_retries": int(sum(
+                v for k_, v in cnt.items()
+                if k_.startswith("raft.fleet.retry.total"))),
+            "fleet_steady_state_compiles": int(kill_compiles),
+            "fleet_scaling_compiles": compiles_by_count,
+            "fleet_rolling_ok": bool(roll_report.get("ok")),
+            "fleet_rolling_failed_requests": int(roll_failed),
+            "fleet_rolling_availability": rep_roll["availability"],
+            "offered_qps": rep["offered_qps"],
+            "n_probes": n_probes})
+    except Exception as e:
+        results.append({"metric": metric, "error": repr(e)[:200]})
+    finally:
+        try:
+            router.close(drain_timeout_s=5.0)
+        except Exception:
+            pass
+
+
 def bench_brute_500k(results):
     # the IVF bench point's brute baseline, default-on so the
     # bfknn_fused_500k gate (wall-QPS floor 35k — see PERF_GATES) has
@@ -1534,7 +1728,7 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_flat, bench_ivf_flat_100k, bench_ivf_pq,
           bench_ivf_pq4,
           bench_ivf_bq, bench_serve, bench_serve_sharded,
-          bench_mutate, bench_chaos, bench_quality,
+          bench_mutate, bench_chaos, bench_quality, bench_fleet,
           bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,

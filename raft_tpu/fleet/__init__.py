@@ -1,0 +1,58 @@
+"""raft_tpu.fleet — replica fleet serving: the millions-of-users layer.
+
+One mesh (or one host) is one blast radius and one QPS ceiling. This
+subsystem puts **N replicas of the index behind one front door**
+(ROADMAP item 1; the reference's raft-dask cluster-bootstrap layer
+rebuilt TPU-native):
+
+* :class:`~raft_tpu.fleet.replica.Replica` — one serving process with
+  an explicit lifecycle (``BOOTSTRAPPING → SERVING → DRAINING →
+  DOWN``), a cheap batcher-derived load signal, and drain-before-stop.
+* :mod:`~raft_tpu.fleet.replication` — new replicas bootstrap from the
+  compactor's checkpointed epoch snapshot and converge by tailing the
+  mutation WAL (ISSUE 10's checkpoint + ordered at-least-once replay
+  IS the replication protocol); a :class:`~raft_tpu.fleet.replication.
+  Replicator` thread keeps them fresh with exported lag.
+* :class:`~raft_tpu.fleet.router.FleetRouter` — power-of-two-choices
+  over per-replica queue depth with health/suspect exclusion,
+  deadline-aware retry-on-another-replica, and per-replica admission
+  (one drowning replica sheds alone).
+* :func:`~raft_tpu.fleet.rolling.rolling_restart` — the zero-downtime
+  upgrade path: drain one, restart it from snapshot + WAL tail,
+  rejoin, next.
+
+Quick use::
+
+    from raft_tpu import fleet, serve
+
+    reps = [fleet.Replica(f"r{i}", serve.SearchServer.from_index(
+                index, rep_q, k=10)) for i in range(3)]
+    router = fleet.FleetRouter(reps, fleet.FleetConfig(max_retries=1))
+    dists, ids = router.search(queries)       # one front door
+    fleet.rolling_restart(router, my_restart_fn)
+    router.close()
+
+Everything lands in the ``raft.fleet.*`` metric/span taxonomy, folded
+into ``/healthz`` and ``/debug/fleet`` (docs/fleet.md has the
+architecture, the bootstrap/replication walkthrough and the
+rolling-restart runbook; load-test with ``tools/loadgen.py --fleet``).
+"""
+
+from raft_tpu.fleet.replica import Replica, ReplicaState
+from raft_tpu.fleet.replication import (Replicator, WalApplier,
+                                        bootstrap_replica)
+from raft_tpu.fleet.rolling import rolling_restart
+from raft_tpu.fleet.router import (FleetConfig, FleetRouter,
+                                   FleetUnavailableError)
+
+__all__ = [
+    "FleetConfig",
+    "FleetRouter",
+    "FleetUnavailableError",
+    "Replica",
+    "ReplicaState",
+    "Replicator",
+    "WalApplier",
+    "bootstrap_replica",
+    "rolling_restart",
+]

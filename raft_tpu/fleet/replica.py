@@ -1,0 +1,259 @@
+"""Replica: one serving process in a fleet, with an explicit lifecycle.
+
+A :class:`Replica` wraps one :class:`~raft_tpu.serve.SearchServer` (or
+``DistributedSearchServer`` — a replica may itself be a whole sharded
+mesh) and gives the fleet tier the three things routing needs that a
+bare server does not expose:
+
+* **lifecycle states** — ``BOOTSTRAPPING → SERVING → DRAINING → DOWN``
+  (and ``DOWN → BOOTSTRAPPING`` for the rolling-restart rebirth).
+  Transitions are validated — a replica cannot silently jump from
+  ``DOWN`` to ``SERVING`` without passing through bootstrap — and
+  every transition lands in ``raft.fleet.replica.*`` metrics so the
+  fleet's shape is reconstructible from the registry alone.
+* **load** — a cheap scalar derived from the batcher's
+  :meth:`~raft_tpu.serve.SearchServer.load` snapshot (queued rows +
+  in-flight rows, shed-rate-penalized), the power-of-two-choices input
+  of :class:`~raft_tpu.fleet.router.FleetRouter`. The same snapshot
+  feeds the ``/healthz`` fleet section — routing and health read ONE
+  signal, so they can never disagree about which replica is sick.
+* **drain-before-stop** — :meth:`drain` flips the replica out of the
+  routing set and flushes its queue (every outstanding future
+  resolves) before :meth:`stop` closes anything; a replica never
+  drops accepted work on the floor.
+
+Threading model: the state machine sits on the router/operator/
+replicator thread boundary — all state under ``self._lock`` (GL003
+contract below); the wrapped server's own lock is never taken while
+holding it (lock-order discipline, GL007).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Optional
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import get_logger
+
+__all__ = ["Replica", "ReplicaState"]
+
+
+class ReplicaState(enum.Enum):
+    """Lifecycle of one replica. Gauge codes (the value exported under
+    ``raft.fleet.replica.state{replica=...}``) ride in ``.code``."""
+
+    BOOTSTRAPPING = "bootstrapping"
+    SERVING = "serving"
+    DRAINING = "draining"
+    DOWN = "down"
+
+    @property
+    def code(self) -> int:
+        return _STATE_CODE[self]
+
+
+_STATE_CODE = {ReplicaState.BOOTSTRAPPING: 0, ReplicaState.SERVING: 1,
+               ReplicaState.DRAINING: 2, ReplicaState.DOWN: 3}
+
+# the legal lifecycle edges: bootstrap either succeeds into SERVING or
+# fails to DOWN; a serving replica drains before it stops (stop() goes
+# through DRAINING) but may be declared DOWN directly when it is
+# observed dead (a kill is not a drain); a draining replica either
+# finishes into DOWN or aborts back to SERVING; only DOWN replicas
+# re-enter bootstrap
+_ALLOWED = {
+    ReplicaState.BOOTSTRAPPING: {ReplicaState.SERVING, ReplicaState.DOWN},
+    ReplicaState.SERVING: {ReplicaState.DRAINING, ReplicaState.DOWN},
+    ReplicaState.DRAINING: {ReplicaState.SERVING, ReplicaState.DOWN},
+    ReplicaState.DOWN: {ReplicaState.BOOTSTRAPPING},
+}
+
+# load() for a replica that must not receive traffic — larger than any
+# real queue so a mis-filtered candidate still loses every p2c duel
+_UNROUTABLE_LOAD = float("inf")
+
+
+class Replica:
+    """One fleet member: a named server + lifecycle + load signal.
+
+    Construct around a running server (state starts ``SERVING``) or
+    empty (state ``BOOTSTRAPPING``; :meth:`set_server` installs the
+    server once replication has caught up)."""
+
+    # static race contract (tools/graftlint GL003): router threads,
+    # the rolling-restart operator and the replication thread meet on
+    # these fields — touch them only under `with self._lock`
+    GUARDED_BY = ("_state", "_server", "_replicator")
+
+    def __init__(self, name: str, server=None,
+                 state: Optional[ReplicaState] = None, replicator=None):
+        expects(bool(name), "Replica: name must be non-empty")
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._server = server
+        self._replicator = replicator
+        self._state = (state if state is not None else
+                       (ReplicaState.SERVING if server is not None
+                        else ReplicaState.BOOTSTRAPPING))
+        obs.gauge("raft.fleet.replica.state",
+                  replica=self.name).set(self._state.code)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def state(self) -> ReplicaState:
+        with self._lock:
+            return self._state
+
+    @property
+    def server(self):
+        with self._lock:
+            return self._server
+
+    @property
+    def replicator(self):
+        with self._lock:
+            return self._replicator
+
+    def set_server(self, server, replicator=None) -> "Replica":
+        """Install a (new) server — the bootstrap/rolling-restart
+        hand-off. The old server is NOT closed here (the caller owns
+        its shutdown ordering: drain first, then close, then swap).
+        ``set_server(None)`` detaches both server and replicator."""
+        with self._lock:
+            self._server = server
+            if replicator is not None or server is None:
+                self._replicator = replicator
+        return self
+
+    # -- lifecycle ---------------------------------------------------------
+    def to(self, new_state: ReplicaState) -> "Replica":
+        """Transition the lifecycle — validated against the legal
+        edges, exported as gauge + transition counter."""
+        with self._lock:
+            expects(new_state in _ALLOWED[self._state],
+                    "replica %s: illegal transition %s -> %s",
+                    self.name, self._state.value, new_state.value)
+            self._state = new_state
+        obs.gauge("raft.fleet.replica.state",
+                  replica=self.name).set(new_state.code)
+        obs.counter("raft.fleet.replica.transitions.total",
+                    replica=self.name, to=new_state.value).inc()
+        return self
+
+    def mark_serving(self) -> "Replica":
+        return self.to(ReplicaState.SERVING)
+
+    def begin_drain(self) -> "Replica":
+        return self.to(ReplicaState.DRAINING)
+
+    def mark_down(self) -> "Replica":
+        return self.to(ReplicaState.DOWN)
+
+    def begin_bootstrap(self) -> "Replica":
+        return self.to(ReplicaState.BOOTSTRAPPING)
+
+    # -- routing signals ---------------------------------------------------
+    def routable(self) -> bool:
+        """May the router send traffic here? (SERVING with a live
+        server — DRAINING/DOWN/BOOTSTRAPPING replicas are out of the
+        set by definition, before any load comparison.)"""
+        with self._lock:
+            return (self._state is ReplicaState.SERVING
+                    and self._server is not None)
+
+    def load(self) -> float:
+        """The power-of-two-choices scalar: queued + in-flight rows
+        from the batcher's cheap :meth:`~raft_tpu.serve.SearchServer.
+        load` snapshot, plus a shed-rate penalty (a replica actively
+        bouncing work is worse than its queue depth says — admission
+        pressure must show up BEFORE the queue maxes out). Unroutable
+        states return +inf so a stale candidate loses every duel."""
+        with self._lock:
+            srv = self._server
+            state = self._state
+        if state is not ReplicaState.SERVING or srv is None:
+            return _UNROUTABLE_LOAD
+        try:
+            snap = srv.load()
+        except Exception:
+            get_logger("fleet").warning(
+                "replica %s: load() probe failed — treating as "
+                "unroutable", self.name)
+            obs.counter("raft.fleet.replica.load_errors.total",
+                        replica=self.name).inc()
+            return _UNROUTABLE_LOAD
+        if snap.get("closed") or snap.get("draining"):
+            return _UNROUTABLE_LOAD
+        return (float(snap["queued_rows"]) + float(snap["inflight_rows"])
+                + 100.0 * float(snap.get("shed_rate", 0.0)))
+
+    # -- drain-before-stop -------------------------------------------------
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Leave the routing set (state ``DRAINING``) and flush the
+        wrapped server's queue — every accepted request completes, new
+        submissions shed with reason ``draining``. Returns the
+        server's drain verdict (False = timed out with work left)."""
+        self.to(ReplicaState.DRAINING)
+        with self._lock:
+            srv = self._server
+        return srv.drain(timeout_s) if srv is not None else True
+
+    def stop(self, drain_timeout_s: float = 30.0) -> bool:
+        """Drain, then close the server (and the replication tailer
+        when one is attached), then ``DOWN``. The zero-failed-requests
+        guarantee of the rolling restart lives here: nothing is closed
+        until the queue is flushed."""
+        drained = True
+        with self._lock:
+            state = self._state
+        if state is ReplicaState.SERVING:
+            drained = self.drain(drain_timeout_s)
+        with self._lock:
+            srv, repl = self._server, self._replicator
+            self._server = None
+            self._replicator = None
+        if repl is not None:
+            repl.close()
+        if srv is not None:
+            srv.close()
+        with self._lock:
+            state = self._state
+        if state is not ReplicaState.DOWN:
+            self.to(ReplicaState.DOWN)
+        return drained
+
+    def kill(self) -> None:
+        """Immediate death (the chaos-harness path): no drain, the
+        server closes under the fleet's feet and queued work fails with
+        its typed errors — exactly what a crashed process looks like
+        to the router."""
+        with self._lock:
+            srv, repl = self._server, self._replicator
+            self._server = None
+            self._replicator = None
+            state = self._state
+        if state is not ReplicaState.DOWN:
+            self.to(ReplicaState.DOWN)
+        if repl is not None:
+            repl.close()
+        if srv is not None:
+            srv.close()
+
+    def describe(self) -> dict:
+        """Structured snapshot for ``/debug/fleet``."""
+        with self._lock:
+            srv = self._server
+            state = self._state
+        body = {"name": self.name, "state": state.value}
+        if srv is not None and state is not ReplicaState.DOWN:
+            try:
+                body["load"] = srv.load()
+            except Exception:   # graftlint: disable=GL006
+                # a debug snapshot must not fail because one replica's
+                # server is mid-teardown (justified swallow: the state
+                # field already says what the reader needs)
+                body["load"] = None
+        return body

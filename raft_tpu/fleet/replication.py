@@ -1,0 +1,332 @@
+"""WAL-tailing replication: new replicas from checkpoint + log tail.
+
+The fleet tier does NOT invent a replication protocol — ISSUE 10
+already built one and called it recovery: the compactor's durably
+checkpointed epoch snapshot plus ordered at-least-once replay of the
+fsync'd mutation WAL reproduces the primary's logical state exactly
+(parity-tested there). Replication is the same machinery pointed at a
+*different process's* state:
+
+* **bootstrap** (:func:`bootstrap_replica`) — load the primary's
+  checkpoint (``serialize.load``; falls back to the base index the WAL
+  was started against), wrap it in a fresh
+  :class:`~raft_tpu.mutate.MutableIndex`, and replay the log through a
+  read-only :class:`~raft_tpu.mutate.wal.WalReader` — the replica
+  converges to the primary's live state without the primary doing
+  anything (no snapshot RPC, no pause; the WAL *is* the transfer
+  format).
+* **catch-up + freshness** (:class:`Replicator`) — a daemon thread
+  keeps tailing ``WalReader.tail()`` and applying records through a
+  :class:`WalApplier`; the replica stays behind the primary by exactly
+  the un-tailed suffix, exported as ``raft.fleet.replication.
+  lag_records`` / ``lag_seconds``.
+* **the primary compacts** — its WAL :meth:`~raft_tpu.mutate.wal.
+  MutationWAL.rewrite` replaces the log with a meta record + the
+  still-pending tail. A caught-up follower resumes contiguously (the
+  sequence space is monotone across the rewrite), folds its own state
+  on the meta record (same frozen content → same logical result) and
+  skips the snapshot records it already holds
+  (``snapshot_upto_seq``). A follower that was still BEHIND the
+  rewrite lost records to the checkpoint: the reader raises
+  :class:`~raft_tpu.mutate.wal.WalGapError`, the replicator parks
+  with ``raft.fleet.replication.gap`` set, and the replica must
+  re-bootstrap — stale-but-wrong is never served.
+
+Followers never write the primary's WAL (one writer per log) and do
+not attach WALs of their own in this tier — a promoted replica starts
+its own log from its converged state.
+
+Retrieval caveat: a follower folds its delta (including the primary's
+pending tail) into its main lists at the meta record, so under partial
+``n_probes`` a tail row sits behind list routing on the follower while
+the primary still scans it exactly in the delta — the same recall
+semantics any fold has (docs/mutability.md). Logical state is
+identical; the fleet parity test pins ids at exhaustive probes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import get_logger
+from raft_tpu.mutate.types import DeltaFullError
+from raft_tpu.mutate.wal import (OP_DELETE, OP_META, OP_UPSERT,
+                                 WalGapError, WalReader, WalRecord)
+from raft_tpu.obs import spans
+
+__all__ = ["WalApplier", "Replicator", "bootstrap_replica"]
+
+
+class WalApplier:
+    """Applies a WAL record stream (in seq order) onto a follower
+    :class:`~raft_tpu.mutate.MutableIndex`. Single-consumer: owned by
+    one bootstrap call or one :class:`Replicator` thread; it holds no
+    lock of its own (the index's lock already serializes the apply).
+
+    At-least-once semantics ride the same contract recovery proved:
+    records at or below the applied position are skipped, re-applied
+    upserts/deletes are keyed by explicit ids, and an upsert stream
+    that overflows the follower's delta budget compacts inline and
+    continues — replication never fails on volume."""
+
+    def __init__(self, mindex):
+        self.m = mindex
+        self.applied_seq = 0     # highest record seq processed
+        self.applied_records = 0
+        self._skip_upto = 0      # rewrite snapshot records already held
+
+    def apply(self, rec: WalRecord) -> str:
+        """Process one record → what happened (``applied`` /
+        ``skipped`` / ``meta`` / ``compacted``)."""
+        if rec.seq and rec.seq <= max(self.applied_seq,
+                                      self._skip_upto):
+            self.applied_seq = max(self.applied_seq, rec.seq)
+            return "skipped"
+        out = "applied"
+        if rec.op == OP_META:
+            out = self._apply_meta(rec)
+        elif rec.op == OP_DELETE:
+            self.m.delete(rec.ids)
+        elif rec.op == OP_UPSERT:
+            self._apply_upsert(rec)
+        self.applied_seq = max(self.applied_seq, rec.seq)
+        self.applied_records += 1
+        return out
+
+    def _apply_meta(self, rec: WalRecord) -> str:
+        meta = rec.meta or {}
+        if self.applied_seq == 0:
+            # head of a post-compaction log at bootstrap: restore the
+            # id-space/epoch counters the checkpoint was folded under
+            # and APPLY the snapshot records that follow (they carry
+            # pending state the checkpoint does not)
+            self.m.apply_meta(meta)
+            return "meta"
+        # mid-stream meta: the primary compacted. We hold every record
+        # up to rec.seq - 1 (the reader guarantees contiguity), i.e.
+        # exactly the primary's pre-swap logical state — folding our
+        # own delta reproduces its post-swap state, and the rewrite's
+        # snapshot records (seq <= snapshot_upto_seq) are already in
+        # our state: skip them.
+        if int(meta.get("epoch", 0)) > self.m.epoch:
+            self.m.compact()
+        self._skip_upto = int(meta.get("snapshot_upto_seq", rec.seq))
+        return "compacted"
+
+    def _apply_upsert(self, rec: WalRecord) -> None:
+        ids32 = np.asarray(rec.ids, np.int32)
+        top = self.m.cfg.delta_capacities[-1]
+        # chunk to the top rung: the log may have been written under a
+        # larger delta budget than this follower configures
+        for s in range(0, ids32.shape[0], top):
+            try:
+                self.m.upsert(rec.rows[s:s + top], ids=ids32[s:s + top])
+            except DeltaFullError:
+                self.m.compact()
+                self.m.upsert(rec.rows[s:s + top], ids=ids32[s:s + top])
+
+
+def bootstrap_replica(wal_path: str, k: int,
+                      checkpoint_path: Optional[str] = None,
+                      base_index=None, params=None, config=None,
+                      name: str = "replica"
+                      ) -> Tuple[object, WalReader, WalApplier]:
+    """Build a follower :class:`~raft_tpu.mutate.MutableIndex` from
+    the primary's durable state: the compaction checkpoint when one
+    exists (else ``base_index`` — the index the WAL was started
+    against) + a full read-only replay of the mutation log. Returns
+    ``(mindex, reader, applier)`` positioned at the log tip — hand
+    them to a :class:`Replicator` to stay fresh. Counted under
+    ``raft.fleet.bootstrap.total`` and timed as
+    ``raft.fleet.bootstrap.seconds`` (a fleet that cannot bootstrap a
+    replica inside its traffic-growth window cannot scale out)."""
+    from raft_tpu.mutate import MutableIndex
+    from raft_tpu.neighbors import serialize
+    with obs.timed("raft.fleet.bootstrap"), \
+            spans.span("raft.fleet.bootstrap", replica=name) as sp:
+        inner = None
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            inner = serialize.load(checkpoint_path)
+            sp.set_attr("source", "checkpoint")
+        else:
+            inner = base_index
+            sp.set_attr("source", "base_index")
+        expects(inner is not None,
+                "fleet.bootstrap: no checkpoint at %r and no "
+                "base_index — a replica needs the index the WAL was "
+                "started against", checkpoint_path)
+        m = MutableIndex(inner, k=int(k), params=params, config=config)
+        reader = WalReader(wal_path)
+        applier = WalApplier(m)
+        for rec in reader.tail():
+            applier.apply(rec)
+        sp.set_attr("replayed", applier.applied_records)
+        sp.set_attr("seq", applier.applied_seq)
+    obs.counter("raft.fleet.bootstrap.total").inc()
+    obs.gauge("raft.fleet.replication.lag_records", replica=name).set(0)
+    return m, reader, applier
+
+
+class Replicator:
+    """Daemon thread keeping one follower fresh: poll
+    ``WalReader.tail()``, apply through the :class:`WalApplier`,
+    export lag. On a :class:`~raft_tpu.mutate.wal.WalGapError` (the
+    follower fell behind a checkpoint rewrite) the thread PARKS —
+    ``gap`` goes True, ``raft.fleet.replication.gap{replica}`` raises,
+    and the owner must re-bootstrap; tailing a log with a hole would
+    serve wrong answers, not stale ones."""
+
+    # static race contract (tools/graftlint GL003): owner thread and
+    # the tailer thread meet on these flags
+    GUARDED_BY = ("_closed", "_gap")
+
+    def __init__(self, mindex, wal_path: str, name: str = "replica",
+                 poll_ms: float = 25.0, reader: Optional[WalReader] = None,
+                 applier: Optional[WalApplier] = None,
+                 start: bool = True):
+        self.name = str(name)
+        self.wal_path = wal_path
+        self._reader = reader if reader is not None \
+            else WalReader(wal_path)
+        self._applier = applier if applier is not None \
+            else WalApplier(mindex)
+        self._poll_s = max(1e-3, poll_ms / 1e3)
+        self._cond = threading.Condition()
+        self._closed = False
+        self._gap = False
+        self._thread: Optional[threading.Thread] = None
+        obs.gauge("raft.fleet.replication.gap", replica=self.name).set(0)
+        if start:
+            self.start()
+
+    @property
+    def applier(self) -> WalApplier:
+        return self._applier
+
+    @property
+    def gap(self) -> bool:
+        with self._cond:
+            return self._gap
+
+    def start(self) -> "Replicator":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"raft-fleet-replicator-{self.name}")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    def __enter__(self) -> "Replicator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- catch-up ----------------------------------------------------------
+    def caught_up(self) -> bool:
+        """Is the follower at the log tip RIGHT NOW? (A read-only
+        probe from the applier's position — the answer can be stale by
+        one append the moment it returns.)"""
+        floor = max(self._applier.applied_seq,
+                    self._applier._skip_upto)
+        try:
+            probe = WalReader(self.wal_path, from_seq=floor)
+            return not probe.tail(max_records=1)
+        except (WalGapError, OSError):
+            return False
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until the follower has applied everything the log
+        held (quiesce-then-compare — the fleet parity test's barrier).
+        False on timeout or a parked gap."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            if self.gap:
+                return False
+            if self.caught_up():
+                return True
+            time.sleep(min(self._poll_s, 0.02))
+        return False
+
+    # -- the tail loop -----------------------------------------------------
+    def _loop(self) -> None:
+        log = get_logger("fleet")
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                self._cond.wait(timeout=self._poll_s)
+                if self._closed:
+                    return
+            try:
+                recs = self._reader.tail()
+            except WalGapError as e:
+                with self._cond:
+                    self._gap = True
+                obs.counter("raft.fleet.replication.gaps.total",
+                            replica=self.name).inc()
+                obs.gauge("raft.fleet.replication.gap",
+                          replica=self.name).set(1)
+                log.warning(
+                    "replicator %s: fell behind a checkpoint rewrite "
+                    "(%r) — parked; re-bootstrap this replica",
+                    self.name, e)
+                return
+            except OSError as e:
+                # the log file can transiently not exist (primary
+                # rotating) — count and keep polling
+                obs.counter("raft.fleet.replication.errors.total",
+                            replica=self.name).inc()
+                log.warning("replicator %s: tail failed: %r",
+                            self.name, e)
+                continue
+            if not recs:
+                obs.gauge("raft.fleet.replication.lag_records",
+                          replica=self.name).set(0)
+                continue
+            obs.gauge("raft.fleet.replication.lag_records",
+                      replica=self.name).set(len(recs))
+            applied = 0
+            for rec in recs:
+                try:
+                    if self._applier.apply(rec) != "skipped":
+                        applied += 1
+                except Exception as e:
+                    obs.counter("raft.fleet.replication.errors.total",
+                                replica=self.name).inc()
+                    log.error(
+                        "replicator %s: apply of seq %d failed: %r "
+                        "— parking (state may be behind, never wrong)",
+                        self.name, rec.seq, e)
+                    with self._cond:
+                        self._gap = True
+                    obs.gauge("raft.fleet.replication.gap",
+                              replica=self.name).set(1)
+                    return
+            obs.counter("raft.fleet.replication.applied.total",
+                        replica=self.name).inc(applied)
+            obs.gauge("raft.fleet.replication.lag_records",
+                      replica=self.name).set(0)
+            # wall clock by design (GL005): replication lag compares
+            # the primary's record-write wall time against OUR wall
+            # clock — monotonic clocks do not compare across processes
+            lag_s = max(0.0, time.time() - recs[-1].ts)  # graftlint: disable=GL005
+            obs.gauge("raft.fleet.replication.lag_seconds",
+                      replica=self.name).set(round(lag_s, 6))

@@ -1,0 +1,375 @@
+"""FleetRouter: the one front door over N replicas.
+
+Routing policy is **power-of-two-choices** (Mitzenmacher's result: two
+random probes + pick-the-less-loaded gets within a constant factor of
+ideal load balance at a fraction of the coordination cost of
+join-shortest-queue): per request the router samples two routable
+replicas, compares their :meth:`~raft_tpu.fleet.replica.Replica.load`
+(queued + in-flight rows from the batcher's cheap snapshot), and
+dispatches to the lighter one. Replicas outside the routing set —
+``DRAINING``/``DOWN``/``BOOTSTRAPPING`` states, or *suspect* after a
+dispatch-class failure — are excluded before the duel, so a sick
+replica stops receiving traffic the moment it first fails rather than
+after its queue fills.
+
+Failure handling composes with the per-replica stack underneath
+(ISSUE 10's watchdog/retry/failover run *inside* each replica): a
+dispatch that still fails at the replica level is **retried on a
+different replica**, deadline-aware — a request whose budget is
+exhausted fails with :class:`~raft_tpu.serve.DeadlineExceeded` instead
+of burning another replica's slot. Backpressure is **per-replica
+admission**: each wrapped server keeps its own bounded queue, a shed
+(:class:`~raft_tpu.serve.RejectedError`) reroutes to another replica
+without marking the shedding replica suspect (load is not sickness),
+and only when every routable replica refuses does the caller see
+:class:`FleetUnavailableError` — one drowning replica sheds alone, it
+cannot drag the fleet down with it.
+
+Every decision lands in ``raft.fleet.*`` metrics and the
+``raft.fleet.route`` span (docs/fleet.md has the taxonomy).
+
+Threading model: callers submit from any thread; completion callbacks
+run on each replica's dispatcher thread and may re-submit (a retry) —
+they only touch the router lock briefly for candidate selection and
+never hold it across a server call (GL007 lock-order discipline).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.fleet.replica import Replica, ReplicaState
+from raft_tpu.obs import spans
+from raft_tpu.serve.types import (DeadlineExceeded, DispatchError,
+                                  RejectedError)
+
+__all__ = ["FleetConfig", "FleetRouter", "FleetUnavailableError"]
+
+
+class FleetUnavailableError(RejectedError):
+    """No routable replica could take the request — every fleet member
+    is down/draining/suspect or refused admission. The fleet-level
+    backpressure signal (a :class:`RejectedError` subclass, so callers
+    and the HTTP route treat it as a 429-class shed)."""
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Operating contract of a :class:`FleetRouter`.
+
+    * ``max_retries`` — how many times a failed dispatch is retried on
+      a *different* replica (the per-replica retry/failover budget of
+      ISSUE 10 has already run underneath by the time the router sees
+      the failure). Tried replicas are excluded from the re-pick.
+    * ``suspect_ms`` — how long a replica that failed a dispatch stays
+      out of the routing set. Time-based recovery: the next pick after
+      expiry routes to it again (its own /healthz + watchdog decide if
+      it fails again). Sheds do NOT mark suspect — load is not
+      sickness.
+    * ``default_deadline_ms`` — per-request deadline when ``submit``
+      does not pass one (0 = none). The retry path subtracts time
+      already spent, so a retry can never resolve after the caller
+      stopped waiting.
+    * ``seed`` — the two-choice sampler's RNG seed (deterministic
+      tests).
+    """
+
+    max_retries: int = 1
+    suspect_ms: float = 2000.0
+    default_deadline_ms: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_retries < 0 or self.suspect_ms < 0:
+            raise ValueError("FleetConfig: max_retries and suspect_ms "
+                             "must be >= 0")
+        if self.default_deadline_ms < 0:
+            raise ValueError("FleetConfig: default_deadline_ms must "
+                             "be >= 0")
+
+
+class FleetRouter:
+    """The fleet front door: ``submit() -> Future`` / blocking
+    ``search()``, same call shape as a single
+    :class:`~raft_tpu.serve.SearchServer` — a caller (or the HTTP
+    route, or ``tools/loadgen.py``) cannot tell one replica from a
+    fleet except by its throughput."""
+
+    # static race contract (tools/graftlint GL003): caller threads and
+    # every replica's dispatcher thread (completion callbacks) meet on
+    # these fields — touch them only under `with self._lock`
+    GUARDED_BY = ("_replicas", "_suspect_until", "_rng", "_gauge_t")
+
+    # fleet-shape gauges re-export at most this often on the routing
+    # path — replica STATE can change outside the router (a kill, an
+    # operator drain), and /healthz reads the gauges, so routing
+    # traffic keeps them honest without a per-request registry storm
+    _GAUGE_REFRESH_S = 0.1
+
+    def __init__(self, replicas=(), config: Optional[FleetConfig] = None):
+        self._cfg = config if config is not None else FleetConfig()
+        self._lock = threading.Lock()
+        self._replicas: List[Replica] = list(replicas)
+        self._suspect_until: Dict[str, float] = {}
+        self._rng = random.Random(self._cfg.seed)
+        self._gauge_t = 0.0
+        names = [r.name for r in self._replicas]
+        expects(len(set(names)) == len(names),
+                "FleetRouter: replica names must be unique, got %s",
+                names)
+        self._refresh_gauges()
+
+    # -- membership --------------------------------------------------------
+    @property
+    def config(self) -> FleetConfig:
+        return self._cfg
+
+    @property
+    def replicas(self) -> Tuple[Replica, ...]:
+        with self._lock:
+            return tuple(self._replicas)
+
+    def replica(self, name: str) -> Replica:
+        with self._lock:
+            for r in self._replicas:
+                if r.name == name:
+                    return r
+        raise KeyError(f"fleet: no replica named {name!r}")
+
+    def add_replica(self, replica: Replica) -> "FleetRouter":
+        with self._lock:
+            expects(all(r.name != replica.name for r in self._replicas),
+                    "fleet: replica name %r already registered",
+                    replica.name)
+            self._replicas.append(replica)
+        self._refresh_gauges()
+        return self
+
+    def remove_replica(self, name: str) -> Replica:
+        with self._lock:
+            for i, r in enumerate(self._replicas):
+                if r.name == name:
+                    del self._replicas[i]
+                    self._suspect_until.pop(name, None)
+                    break
+            else:
+                raise KeyError(f"fleet: no replica named {name!r}")
+        self._refresh_gauges()
+        return r
+
+    def _refresh_gauges(self) -> None:
+        reps = self.replicas
+        now = time.monotonic()
+        with self._lock:
+            self._gauge_t = now
+            suspects = sum(1 for n, t in self._suspect_until.items()
+                           if t > now)
+        serving = sum(1 for r in reps
+                      if r.state is ReplicaState.SERVING)
+        obs.gauge("raft.fleet.replicas.total").set(len(reps))
+        obs.gauge("raft.fleet.replicas.serving").set(serving)
+        obs.gauge("raft.fleet.suspects").set(suspects)
+
+    # -- suspect set -------------------------------------------------------
+    def _mark_suspect(self, replica: Replica) -> None:
+        until = time.monotonic() + self._cfg.suspect_ms / 1e3
+        with self._lock:
+            self._suspect_until[replica.name] = until
+        obs.counter("raft.fleet.suspect.total",
+                    replica=replica.name).inc()
+        self._refresh_gauges()
+
+    def suspects(self) -> Tuple[str, ...]:
+        now = time.monotonic()
+        with self._lock:
+            return tuple(sorted(n for n, t in self._suspect_until.items()
+                                if t > now))
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, exclude: frozenset) -> Optional[Replica]:
+        """Power-of-two-choices over the routable, non-suspect,
+        non-excluded set. Candidate selection holds the lock; the load
+        duel runs OUTSIDE it (load() takes each server's own lock —
+        never nested under ours)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = now - self._gauge_t > self._GAUGE_REFRESH_S
+            cands = [r for r in self._replicas
+                     if r.name not in exclude
+                     and self._suspect_until.get(r.name, 0.0) <= now]
+            if len(cands) >= 2:
+                duel = self._rng.sample(cands, 2)
+            else:
+                duel = list(cands)
+        if stale:
+            self._refresh_gauges()
+        duel = [r for r in duel if r.routable()]
+        if not duel:
+            # the sampled pair was stale (state raced) or the set is
+            # empty — fall back to a full routable scan before giving up
+            full = [r for r in cands if r.routable()]
+            if not full:
+                return None
+            duel = full[:2]
+        if len(duel) == 1:
+            return duel[0]
+        la, lb = duel[0].load(), duel[1].load()
+        return duel[0] if la <= lb else duel[1]
+
+    def submit(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Route one request → ``Future`` (same result contract as
+        :meth:`SearchServer.submit`). The future resolves with the
+        chosen replica's answer, after up to ``max_retries`` re-routes
+        on dispatch-class failures — or with the typed error when the
+        fleet cannot serve it."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if deadline_ms is None:
+            deadline_ms = self._cfg.default_deadline_ms
+        t_deadline = (time.perf_counter() + deadline_ms / 1e3
+                      if deadline_ms and deadline_ms > 0 else None)
+        outer: Future = Future()
+        self._dispatch(outer, q, k, t_deadline, attempt=0,
+                       tried=frozenset())
+        return outer
+
+    def search(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None):
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(queries, k, deadline_ms).result(timeout)
+
+    def _remaining_ms(self, t_deadline: Optional[float]
+                      ) -> Optional[float]:
+        if t_deadline is None:
+            return None
+        return (t_deadline - time.perf_counter()) * 1e3
+
+    def _dispatch(self, outer: Future, q, k,
+                  t_deadline: Optional[float], attempt: int,
+                  tried: frozenset) -> None:
+        remaining = self._remaining_ms(t_deadline)
+        if remaining is not None and remaining <= 0:
+            obs.counter("raft.fleet.deadline.total").inc()
+            outer.set_exception(DeadlineExceeded(
+                f"fleet: deadline expired after {attempt} attempt(s)"))
+            return
+        rep = self._pick(tried)
+        if rep is None and tried:
+            # every untried replica is out — as a last resort re-admit
+            # the tried set minus the one that just failed (a shed on a
+            # busy replica beats a guaranteed FleetUnavailableError)
+            rep = self._pick(frozenset())
+        if rep is None:
+            obs.counter("raft.fleet.unroutable.total").inc()
+            self._refresh_gauges()
+            outer.set_exception(FleetUnavailableError(
+                "fleet: no routable replica "
+                f"(total={len(self.replicas)}, "
+                f"suspects={list(self.suspects())})"))
+            return
+        obs.counter("raft.fleet.route.total", replica=rep.name).inc()
+        with spans.span("raft.fleet.route", replica=rep.name,
+                        nq=int(q.shape[0]), attempt=attempt):
+            srv = rep.server
+            try:
+                if srv is None:
+                    # killed under our feet — a retryable dispatch
+                    # failure, exactly like a crashed process
+                    raise DispatchError(
+                        f"fleet: replica {rep.name} lost its server "
+                        f"mid-route")
+                inner = srv.submit(q, k=k, deadline_ms=remaining)
+            except Exception as e:
+                self._on_failure(outer, q, k, t_deadline, attempt,
+                                 tried, rep, e)
+                return
+        inner.add_done_callback(
+            lambda f: self._complete(f, outer, q, k, t_deadline,
+                                     attempt, tried, rep))
+
+    def _complete(self, inner: Future, outer: Future, q, k,
+                  t_deadline: Optional[float], attempt: int,
+                  tried: frozenset, rep: Replica) -> None:
+        exc = inner.exception()
+        if exc is None:
+            if attempt:
+                obs.counter("raft.fleet.retry.success.total").inc()
+            obs.counter("raft.fleet.completed.total").inc()
+            outer.set_result(inner.result())
+            return
+        self._on_failure(outer, q, k, t_deadline, attempt, tried, rep,
+                         exc)
+
+    def _on_failure(self, outer: Future, q, k,
+                    t_deadline: Optional[float], attempt: int,
+                    tried: frozenset, rep: Replica, exc) -> None:
+        # dispatch-class failures implicate the replica: out of the
+        # routing set for suspect_ms. A shed (RejectedError) is load,
+        # not sickness — reroute without suspecting. A deadline is the
+        # caller's budget — final, never retried.
+        retryable = isinstance(exc, (DispatchError, RejectedError)) \
+            and not isinstance(exc, FleetUnavailableError)
+        if isinstance(exc, DispatchError):
+            self._mark_suspect(rep)
+        if isinstance(exc, DeadlineExceeded) or not retryable \
+                or attempt >= self._cfg.max_retries:
+            if retryable and attempt >= self._cfg.max_retries:
+                obs.counter("raft.fleet.retry.exhausted.total").inc()
+            obs.counter("raft.fleet.errors.total",
+                        error=type(exc).__name__).inc()
+            outer.set_exception(exc)
+            return
+        obs.counter("raft.fleet.retry.total").inc()
+        self._dispatch(outer, q, k, t_deadline, attempt + 1,
+                       tried | {rep.name})
+
+    # -- surfaces ----------------------------------------------------------
+    def report(self) -> dict:
+        """Structured fleet snapshot for ``/debug/fleet``: per-replica
+        state + load + route share, the suspect set, the config."""
+        reps = self.replicas
+        snap = obs.snapshot()["counters"]
+        routes = {}
+        for key, v in snap.items():
+            if key.startswith("raft.fleet.route.total{"):
+                name = key.split("replica=")[1].rstrip("}").split(",")[0]
+                routes[name] = routes.get(name, 0) + int(v)
+        total = max(1, sum(routes.values()))
+        return {
+            "replicas": [dict(r.describe(),
+                              routed=routes.get(r.name, 0),
+                              route_share=round(
+                                  routes.get(r.name, 0) / total, 4))
+                         for r in reps],
+            "serving": sum(1 for r in reps
+                           if r.state is ReplicaState.SERVING),
+            "suspects": list(self.suspects()),
+            "config": {"max_retries": self._cfg.max_retries,
+                       "suspect_ms": self._cfg.suspect_ms},
+        }
+
+    def close(self, drain_timeout_s: float = 10.0) -> None:
+        """Stop the whole fleet: drain-then-close every replica (the
+        per-replica stop already guarantees queued work resolves)."""
+        for r in self.replicas:
+            if r.state is not ReplicaState.DOWN:
+                r.stop(drain_timeout_s)
+        self._refresh_gauges()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -788,6 +788,31 @@ class MutableIndex:
             self._push_dev_locked()
             self._cond.notify_all()
 
+    def apply_meta(self, meta: dict) -> "MutableIndex":
+        """Restore the epoch/id-space counters a checkpointed inner
+        index was folded under — the WAL meta record at the head of a
+        post-compaction log, applied before replaying the tail
+        (:meth:`recover` and the fleet tier's
+        :func:`raft_tpu.fleet.replication.bootstrap_replica` both run
+        through here). Only meaningful on a freshly-wrapped index:
+        pending delta rows / tombstones would be stranded in the old
+        id space (id_base may exceed the inner index's row count —
+        ids are a space, rows are a count)."""
+        with self._cond:
+            expects(self._delta_used == 0 and not self._tomb_ids,
+                    "mutate.apply_meta: only valid before any mutation "
+                    "is applied (%d delta rows, %d tombstones pending)",
+                    self._delta_used, len(self._tomb_ids))
+            id_base = int(meta["id_base"])
+            self._epoch = _Epoch(index=self._epoch.index,
+                                 id_base=id_base,
+                                 number=int(meta["epoch"]),
+                                 tomb_words=_tomb_words(id_base))
+            self._tomb = np.zeros((self._epoch.tomb_words,), np.uint32)
+            self._next_id = int(meta["next_id"])
+            self._push_dev_locked()
+        return self
+
     # -- durability: mutation WAL (ISSUE 10) -------------------------------
     def attach_wal(self, wal: MutationWAL,
                    checkpoint_path: Optional[str] = None
@@ -834,18 +859,7 @@ class MutableIndex:
         records = wal.replay()
         m = cls(inner, k=int(k), params=params, config=config)
         if records and records[0].op == OP_META:
-            # post-compaction log: restore the id-space/epoch counters
-            # the checkpointed index was folded under (id_base may
-            # exceed inner.size — ids are a space, rows are a count)
-            meta = records[0].meta
-            with m._cond:
-                id_base = int(meta["id_base"])
-                m._epoch = _Epoch(index=inner, id_base=id_base,
-                                  number=int(meta["epoch"]),
-                                  tomb_words=_tomb_words(id_base))
-                m._tomb = np.zeros((m._epoch.tomb_words,), np.uint32)
-                m._next_id = int(meta["next_id"])
-                m._push_dev_locked()
+            m.apply_meta(records[0].meta)
             records = records[1:]
         top = m.cfg.delta_capacities[-1]
         for rec in records:

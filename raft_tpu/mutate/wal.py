@@ -1,4 +1,4 @@
-"""Crash-safe mutation write-ahead log (ISSUE 10).
+"""Crash-safe mutation write-ahead log (ISSUE 10; sequenced ISSUE 13).
 
 The gap this closes: every acked ``MutableIndex`` mutation since the
 last :func:`~raft_tpu.neighbors.serialize.save_mutable` snapshot lived
@@ -11,17 +11,37 @@ replays harmlessly — at-least-once replay reproduces the same logical
 state because upsert/delete are keyed by explicit ids and the log
 preserves total mutation order (appends happen under the index lock).
 
+Since ISSUE 13 the log is also the **replication stream** of the fleet
+tier (:mod:`raft_tpu.fleet.replication`): every record carries a
+monotonically increasing **sequence number** plus the wall-clock write
+time (both inside the CRC'd payload), and :class:`WalReader` gives a
+read-only follower a positioned ``tail(from_seq)`` view that survives
+the checkpoint-time :meth:`MutationWAL.rewrite`.
+
 Format (binary, versioned, no pickling — a torn tail must be
 recognizable, never executable)::
 
-    header   8 bytes   b"RTPUWAL1"
+    header   8 bytes   b"RTPUWAL2"
     record   u32 payload_length | u32 crc32(payload) | payload
-    payload  u8 op, then
+    payload  u64 seq, f64 wall_ts, u8 op, then
              op=1 upsert: u32 n, u32 dim, n×i64 ids, n×dim×f32 rows
              op=2 delete: u32 n, n×i64 ids
              op=3 meta:   u32 json_len, json bytes
                           (epoch/id_base/next_id — written as the first
                           record of a post-compaction rewrite)
+
+Sequence contract: ``seq`` starts at 1 and increases by exactly 1 per
+appended record — the log is *contiguous*. :meth:`rewrite` CONSUMES
+sequence numbers for the snapshot records it writes (it never reuses
+or resets them), so the space stays monotone across truncation: a
+reader caught up to the pre-rewrite tip resumes at the meta record
+with no gap, while a reader that was still behind sees a hole (its
+missing records were folded into the checkpoint) and gets a typed
+:class:`WalGapError` — re-bootstrap from the checkpoint is the only
+correct continuation, and the error says so instead of silently
+skipping state. The rewrite's meta record carries
+``snapshot_upto_seq`` (the seq of the last snapshot record) so a
+caught-up follower can skip the snapshot records it already holds.
 
 Durability contract: ``append_*`` returns only after ``flush`` +
 ``os.fsync`` (one fsync per mutation *batch* — the unit callers ack).
@@ -46,6 +66,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
@@ -54,10 +75,11 @@ import numpy as np
 from raft_tpu import obs
 from raft_tpu.core.error import expects
 
-__all__ = ["MutationWAL", "WalRecord"]
+__all__ = ["MutationWAL", "WalReader", "WalRecord", "WalGapError"]
 
-_MAGIC = b"RTPUWAL1"
+_MAGIC = b"RTPUWAL2"
 _HDR = struct.Struct("<II")     # payload length, crc32
+_SEQ = struct.Struct("<Qd")     # sequence number, wall-clock write time
 OP_UPSERT = 1
 OP_DELETE = 2
 OP_META = 3
@@ -66,16 +88,35 @@ OP_META = 3
 _MAX_RECORD = 1 << 30
 
 
+class WalGapError(RuntimeError):
+    """The reader's position predates the oldest record the log still
+    holds — the records in between were folded into a checkpoint by
+    :meth:`MutationWAL.rewrite`. Tailing cannot continue; re-bootstrap
+    from the checkpoint (``fleet.replication.bootstrap_replica``)."""
+
+    def __init__(self, last_seq: int, first_seq: int):
+        super().__init__(
+            f"wal: reader at seq {last_seq} but the log now starts at "
+            f"seq {first_seq} — the gap was folded into a checkpoint; "
+            f"re-bootstrap from the snapshot")
+        self.last_seq = int(last_seq)
+        self.first_seq = int(first_seq)
+
+
 class WalRecord:
-    """One decoded log record: ``op`` plus the op-specific fields."""
+    """One decoded log record: ``op`` plus the op-specific fields,
+    the replication ``seq`` and the wall-clock write time ``ts``."""
 
-    __slots__ = ("op", "ids", "rows", "meta")
+    __slots__ = ("op", "ids", "rows", "meta", "seq", "ts")
 
-    def __init__(self, op: int, ids=None, rows=None, meta=None):
+    def __init__(self, op: int, ids=None, rows=None, meta=None,
+                 seq: int = 0, ts: float = 0.0):
         self.op = op
         self.ids = ids
         self.rows = rows
         self.meta = meta
+        self.seq = seq
+        self.ts = ts
 
 
 def _encode_upsert(ids: np.ndarray, rows: np.ndarray) -> bytes:
@@ -97,25 +138,59 @@ def _encode_meta(meta: dict) -> bytes:
 
 
 def _decode(payload: bytes) -> WalRecord:
-    op = payload[0]
+    seq, ts = _SEQ.unpack_from(payload, 0)
+    base = _SEQ.size
+    op = payload[base]
     if op == OP_UPSERT:
-        _, n, dim = struct.unpack_from("<BII", payload, 0)
-        off = struct.calcsize("<BII")
+        _, n, dim = struct.unpack_from("<BII", payload, base)
+        off = base + struct.calcsize("<BII")
         ids = np.frombuffer(payload, np.int64, n, off)
         rows = np.frombuffer(payload, np.float32, n * dim,
                              off + n * 8).reshape(n, dim)
-        return WalRecord(OP_UPSERT, ids=ids, rows=rows)
+        return WalRecord(OP_UPSERT, ids=ids, rows=rows, seq=seq, ts=ts)
     if op == OP_DELETE:
-        _, n = struct.unpack_from("<BI", payload, 0)
+        _, n = struct.unpack_from("<BI", payload, base)
         ids = np.frombuffer(payload, np.int64, n,
-                            struct.calcsize("<BI"))
-        return WalRecord(OP_DELETE, ids=ids)
+                            base + struct.calcsize("<BI"))
+        return WalRecord(OP_DELETE, ids=ids, seq=seq, ts=ts)
     if op == OP_META:
-        _, ln = struct.unpack_from("<BI", payload, 0)
-        off = struct.calcsize("<BI")
-        return WalRecord(OP_META,
-                         meta=json.loads(payload[off:off + ln]))
+        _, ln = struct.unpack_from("<BI", payload, base)
+        off = base + struct.calcsize("<BI")
+        return WalRecord(OP_META, meta=json.loads(payload[off:off + ln]),
+                         seq=seq, ts=ts)
     raise ValueError(f"wal: unknown record op {op}")
+
+
+def _iter_file_records(path: str) -> Iterator[Tuple[WalRecord, int]]:
+    """Yield (record, end_offset) for every intact record; stop at the
+    first torn/corrupt one. Shared by the appending WAL and the
+    read-only :class:`WalReader`. Raises StopIteration value via
+    generator return of the torn byte count (0 = clean EOF)."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        expects(magic == _MAGIC,
+                "wal: %s is not a mutation WAL (bad magic)", path)
+        off = len(_MAGIC)
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                return len(hdr)
+            length, crc = _HDR.unpack(hdr)
+            if length > _MAX_RECORD or length < _SEQ.size + 1:
+                return _HDR.size
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                return _HDR.size + len(payload)
+            try:
+                rec = _decode(payload)
+            except Exception:   # graftlint: disable=GL006
+                # an undecodable-but-checksummed record is a version
+                # skew / corruption boundary, handled exactly like a
+                # torn tail: stop replay here (justified swallow —
+                # replay MUST return the intact prefix, not raise)
+                return _HDR.size + length
+            off += _HDR.size + length
+            yield rec, off
 
 
 class MutationWAL:
@@ -129,6 +204,9 @@ class MutationWAL:
         self.path = path
         self.sync = bool(sync)
         self.torn_bytes = 0
+        # next sequence number to assign (contiguous from 1; restored
+        # by scanning at reopen so the space never restarts)
+        self.next_seq = 1
         fresh = not os.path.exists(path) or os.path.getsize(path) == 0
         if fresh:
             self._f = open(path, "wb")
@@ -149,7 +227,19 @@ class MutationWAL:
             os.fsync(self._f.fileno())
             obs.counter("raft.mutate.wal.fsyncs.total").inc()
 
-    def _append(self, payload: bytes) -> None:
+    def _stamp(self, body: bytes) -> bytes:
+        """Prefix the op body with the next (seq, wall-ts) pair —
+        inside the CRC'd region, so a corrupted seq can never be
+        mistaken for a real position."""
+        # wall clock by design (GL005): the ts feeds the cross-process
+        # replication-lag gauge — a follower compares it against ITS
+        # wall clock, which monotonic time cannot do
+        payload = _SEQ.pack(self.next_seq, time.time()) + body  # graftlint: disable=GL005
+        self.next_seq += 1
+        return payload
+
+    def _append(self, body: bytes) -> None:
+        payload = self._stamp(body)
         rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         self._f.write(rec)
         self._flush()
@@ -158,48 +248,23 @@ class MutationWAL:
 
     def _scan_good_length(self) -> int:
         """Byte offset of the last intact record's end (validates the
-        whole file; called once at reopen)."""
+        whole file; called once at reopen). Also restores
+        ``next_seq`` past the highest surviving record."""
         good = len(_MAGIC)
-        for _rec, end in self._iter_records(count_torn=True):
+        it = _iter_file_records(self.path)
+        torn = 0
+        while True:
+            try:
+                rec, end = next(it)
+            except StopIteration as stop:
+                torn = stop.value or 0
+                break
             good = end
+            self.next_seq = max(self.next_seq, rec.seq + 1)
+        if torn:
+            self.torn_bytes = torn
+            obs.counter("raft.mutate.wal.torn.total").inc()
         return good
-
-    def _iter_records(self, count_torn: bool = False
-                      ) -> Iterator[Tuple[WalRecord, int]]:
-        with open(self.path, "rb") as f:
-            magic = f.read(len(_MAGIC))
-            expects(magic == _MAGIC,
-                    "wal: %s is not a mutation WAL (bad magic)",
-                    self.path)
-            off = len(_MAGIC)
-            while True:
-                hdr = f.read(_HDR.size)
-                if len(hdr) < _HDR.size:
-                    torn = len(hdr)
-                    break
-                length, crc = _HDR.unpack(hdr)
-                if length > _MAX_RECORD:
-                    torn = _HDR.size
-                    break
-                payload = f.read(length)
-                if len(payload) < length or zlib.crc32(payload) != crc:
-                    torn = _HDR.size + len(payload)
-                    break
-                try:
-                    rec = _decode(payload)
-                except Exception:   # graftlint: disable=GL006
-                    # an undecodable-but-checksummed record is a
-                    # version skew / corruption boundary, handled
-                    # exactly like a torn tail: stop replay here and
-                    # count it (justified swallow — replay MUST return
-                    # the intact prefix rather than raise)
-                    torn = _HDR.size + length
-                    break
-                off += _HDR.size + length
-                yield rec, off
-            if torn and count_torn:
-                self.torn_bytes = torn
-                obs.counter("raft.mutate.wal.torn.total").inc()
 
     # -- public API --------------------------------------------------------
     def append_upsert(self, ids, rows) -> None:
@@ -217,7 +282,17 @@ class MutationWAL:
     def replay(self) -> List[WalRecord]:
         """Every intact record in append order (stops at the first
         torn/corrupt one — the crash boundary)."""
-        out = [rec for rec, _ in self._iter_records(count_torn=True)]
+        out = []
+        it = _iter_file_records(self.path)
+        while True:
+            try:
+                rec, _end = next(it)
+            except StopIteration as stop:
+                if stop.value:
+                    self.torn_bytes = stop.value
+                    obs.counter("raft.mutate.wal.torn.total").inc()
+                break
+            out.append(rec)
         obs.counter("raft.mutate.wal.replayed.total").inc(len(out))
         return out
 
@@ -228,21 +303,30 @@ class MutationWAL:
         a meta record (epoch/id-space counters) + the still-pending
         deletes and delta-tail upserts. tmp + fsync + ``os.replace`` —
         a crash at any point leaves either the old complete log or the
-        new complete log, never a hybrid."""
+        new complete log, never a hybrid.
+
+        The snapshot records CONSUME fresh sequence numbers (the space
+        is monotone, never reset): a reader caught up to the
+        pre-rewrite tip resumes here contiguously, and the meta record
+        carries ``snapshot_upto_seq`` so it can recognize — and skip —
+        snapshot records whose state it already holds."""
+        chunks = []
+        if tomb_ids is not None and len(tomb_ids):
+            chunks.append(_encode_delete(
+                np.asarray(tomb_ids, np.int64).reshape(-1)))
+        if upsert_ids is not None and len(upsert_ids):
+            chunks.append(_encode_upsert(
+                np.asarray(upsert_ids, np.int64).reshape(-1),
+                np.asarray(upsert_rows, np.float32)))
+        if meta is not None:
+            meta = dict(meta,
+                        snapshot_upto_seq=self.next_seq + len(chunks))
+            chunks.insert(0, _encode_meta(meta))
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(_MAGIC)
-            chunks = []
-            if meta is not None:
-                chunks.append(_encode_meta(meta))
-            if tomb_ids is not None and len(tomb_ids):
-                chunks.append(_encode_delete(
-                    np.asarray(tomb_ids, np.int64).reshape(-1)))
-            if upsert_ids is not None and len(upsert_ids):
-                chunks.append(_encode_upsert(
-                    np.asarray(upsert_ids, np.int64).reshape(-1),
-                    np.asarray(upsert_rows, np.float32)))
-            for payload in chunks:
+            for body in chunks:
+                payload = self._stamp(body)
                 f.write(_HDR.pack(len(payload), zlib.crc32(payload))
                         + payload)
             f.flush()
@@ -262,3 +346,90 @@ class MutationWAL:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class WalReader:
+    """Read-only positioned view over a (possibly live) mutation WAL —
+    the replication follower's end of the log.
+
+    ``tail()`` returns every record newer than the reader's position
+    and advances it. The reader NEVER writes (no truncation, no
+    repair): a torn tail simply ends the batch — the appending side
+    repairs it at its next reopen, and the torn record re-delivers
+    once rewritten intact (at-least-once, the same contract replay
+    has).
+
+    Surviving ``rewrite``: the writer atomically replaces the file, so
+    the reader watches the inode. When the file was replaced (or
+    shrank under its offset) it rescans from the header, skipping
+    records at or below its position. Because the sequence space is
+    monotone and contiguous, a caught-up reader resumes exactly at the
+    rewrite's snapshot records; a reader that was still behind finds
+    the log's first record more than one seq ahead — those records
+    were folded into the checkpoint — and gets :class:`WalGapError`
+    (re-bootstrap is the only correct continuation)."""
+
+    def __init__(self, path: str, from_seq: int = 0):
+        self.path = path
+        self.last_seq = int(from_seq)
+        self._off = len(_MAGIC)
+        self._ino = self._stat_ino()
+
+    def _stat_ino(self):
+        try:
+            st = os.stat(self.path)
+            return (st.st_dev, st.st_ino, st.st_size)
+        except OSError:
+            return None
+
+    def tail(self, from_seq: Optional[int] = None,
+             max_records: int = 0) -> List[WalRecord]:
+        """Records with ``seq > from_seq`` (default: the reader's
+        position) in order, advancing the position past everything
+        returned. ``max_records`` > 0 bounds one call (the rest stays
+        for the next). Empty list = caught up (or the file does not
+        exist yet)."""
+        if from_seq is not None:
+            self.last_seq = int(from_seq)
+            self._off = len(_MAGIC)
+        st = self._stat_ino()
+        if st is None:
+            return []
+        if self._ino is None or st[:2] != self._ino[:2] \
+                or st[2] < self._off:
+            # the writer replaced (rewrite) or restarted the file:
+            # rescan from the header, filtering on seq
+            self._off = len(_MAGIC)
+        self._ino = st
+        out: List[WalRecord] = []
+        first_seen: Optional[int] = None
+        it = _iter_file_records(self.path)
+        off = len(_MAGIC)
+        while True:
+            try:
+                rec, end = next(it)
+            except StopIteration:
+                break       # clean EOF or torn tail — stop either way
+            off = end
+            if off <= self._off:
+                continue    # already consumed (byte-position resume)
+            if rec.seq <= self.last_seq:
+                self._off = off     # pre-position records after rescan
+                continue
+            if first_seen is None:
+                first_seen = rec.seq
+                if rec.seq > self.last_seq + 1 and self.last_seq > 0:
+                    obs.counter("raft.mutate.wal.reader.gaps.total").inc()
+                    raise WalGapError(self.last_seq, rec.seq)
+            out.append(rec)
+            self._off = off
+            self.last_seq = rec.seq
+            if max_records and len(out) >= max_records:
+                break
+        obs.counter("raft.mutate.wal.reader.records.total").inc(len(out))
+        return out
+
+    @property
+    def position(self) -> int:
+        """Seq of the last record returned (0 = nothing yet)."""
+        return self.last_seq

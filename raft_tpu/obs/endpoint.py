@@ -24,6 +24,12 @@ needs inspectable:
   ``format=chrome`` renders it (or, without ``trace``, the most
   recent) as Chrome-trace JSON — save the body and load it in
   Perfetto.
+* ``GET /debug/fleet`` — the replica-fleet report
+  (:mod:`raft_tpu.fleet`): per-replica state/load/route share and the
+  suspect set from the attached :class:`~raft_tpu.fleet.FleetRouter`
+  (``obs.serve(fleet=router)``), else the exported ``raft.fleet.*``
+  gauges. ``/healthz`` degrades while any replica is out of the
+  serving set.
 * ``GET /debug/slo`` — the declarative SLO verdict
   (:mod:`raft_tpu.obs.slo`): every objective's per-window burn rates
   and breach flags, from the in-process :class:`~raft_tpu.obs.slo.
@@ -155,6 +161,26 @@ def _health_body(snapshot: dict) -> dict:
                 "engaged": failover_engaged,
                 "coverage": _gsum("raft.serve.failover.coverage"),
             }
+    # fleet tier (ISSUE 13): a registered replica fleet degrades the
+    # verdict while any replica is out of the serving set (draining /
+    # bootstrapping / down — a fleet at partial capacity must say so,
+    # exactly like the failover plane above) and hard-degrades when
+    # NOTHING serves
+    fleet_total = _gsum("raft.fleet.replicas.total")
+    if fleet_total:
+        fleet_serving = _gsum("raft.fleet.replicas.serving")
+        fleet_suspects = _gsum("raft.fleet.suspects")
+        fleet_degraded = (fleet_serving < fleet_total
+                          or fleet_serving == 0 or fleet_suspects > 0)
+        body["fleet"] = {
+            "replicas": fleet_total,
+            "serving": fleet_serving,
+            "suspects": fleet_suspects,
+            "replication_lag_records": _gsum(
+                "raft.fleet.replication.lag_records"),
+        }
+        if fleet_degraded:
+            body["status"] = "degraded"
     # distributed serving tier (ISSUE 8): when a mesh-wide server is
     # active (shards gauge set), surface the mesh shape, the merge
     # compression it runs at, and — folding the per-shard comms-health
@@ -212,11 +238,14 @@ class _Handler(BaseHTTPRequestHandler):
                 body = _slo.endpoint_body(self.server.registry
                                           .snapshot())
                 self._send_json(200, body)
+            elif path == "/debug/fleet":
+                self._debug_fleet()
             else:
                 self._send_json(404, {"error": f"no route {path!r}",
                                       "routes": ["/metrics", "/healthz",
                                                  "/debug/requests",
-                                                 "/debug/slo"]})
+                                                 "/debug/slo",
+                                                 "/debug/fleet"]})
         except BrokenPipeError:
             pass
 
@@ -272,6 +301,25 @@ class _Handler(BaseHTTPRequestHandler):
                               "nq": len(i), "k": len(i[0]) if len(i)
                               else 0})
 
+    def _debug_fleet(self) -> None:
+        """``GET /debug/fleet`` — the fleet router's full report when
+        one is attached (``obs.serve(fleet=router)``: per-replica
+        state/load/route share, suspects), else reconstructed from the
+        exported ``raft.fleet.*`` gauges."""
+        router = getattr(self.server, "fleet", None)
+        if router is not None:
+            self._send_json(200, router.report())
+            return
+        gauges = self.server.registry.snapshot().get("gauges", {})
+        fleet_g = {k: v for k, v in gauges.items()
+                   if k.split("{")[0].startswith("raft.fleet.")}
+        if not fleet_g:
+            self._send_json(404, {"error": "no fleet attached and no "
+                                           "raft.fleet.* gauges "
+                                           "exported"})
+            return
+        self._send_json(200, {"source": "gauges", "gauges": fleet_g})
+
     def _debug_requests(self, q: dict) -> None:
         rec = self.server.recorder
         trace_id = q.get("trace", [None])[0]
@@ -321,14 +369,17 @@ class DebugServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, recorder=None, registry=None,
-                 searcher=None):
+                 searcher=None, fleet=None):
         super().__init__(addr, _Handler)
         self.recorder = recorder if recorder is not None \
             else _recorder.RECORDER
         self.registry = registry if registry is not None \
             else _registry.REGISTRY
-        # optional raft_tpu.serve.SearchServer backing POST /search
+        # optional raft_tpu.serve.SearchServer (or fleet.FleetRouter —
+        # same submit/search shape) backing POST /search
         self.searcher = searcher
+        # optional raft_tpu.fleet.FleetRouter backing GET /debug/fleet
+        self.fleet = fleet
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -363,11 +414,14 @@ class DebugServer(ThreadingHTTPServer):
 
 
 def serve(host: str = "127.0.0.1", port: int = 0, recorder=None,
-          registry=None, searcher=None) -> DebugServer:
+          registry=None, searcher=None, fleet=None) -> DebugServer:
     """Start the debug endpoint in a daemon thread → running
     :class:`DebugServer` (``.url``, ``.port``, ``.close()``).
     ``port=0`` binds an ephemeral port (tests, side-by-side procs).
-    ``searcher`` (a :class:`raft_tpu.serve.SearchServer`) enables the
-    ``POST /search`` JSON route."""
+    ``searcher`` (a :class:`raft_tpu.serve.SearchServer` or a
+    :class:`raft_tpu.fleet.FleetRouter` — same call shape) enables the
+    ``POST /search`` JSON route; ``fleet`` (a ``FleetRouter``) enables
+    the full ``GET /debug/fleet`` report."""
     return DebugServer((host, port), recorder=recorder,
-                       registry=registry, searcher=searcher).start()
+                       registry=registry, searcher=searcher,
+                       fleet=fleet).start()

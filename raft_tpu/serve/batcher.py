@@ -133,7 +133,8 @@ class SearchServer:
     # fields sit on the caller-thread/dispatcher-thread boundary and
     # must only be touched under `with self._cond` or inside a
     # `_locked`-suffix method
-    GUARDED_BY = ("_q", "_rows_queued", "_closed", "_shed_times")
+    GUARDED_BY = ("_q", "_rows_queued", "_closed", "_shed_times",
+                  "_draining", "_inflight_rows")
 
     def __init__(self, ladder: PlanLadder,
                  config: Optional[ServeConfig] = None,
@@ -145,6 +146,8 @@ class SearchServer:
         self._rows_queued = 0
         self._cond = threading.Condition()
         self._closed = False
+        self._draining = False
+        self._inflight_rows = 0
         self._thread: Optional[threading.Thread] = None
         self._shed_times: deque = deque()
         # watchdog helper (dispatcher-thread-only state, like the
@@ -351,6 +354,11 @@ class SearchServer:
             if self._closed:
                 self._shed_locked(req, "closed")
                 return req.future
+            if self._draining:
+                # drain() stopped admission (rolling restart, ISSUE 13):
+                # the queue flushes, new work goes to another replica
+                self._shed_locked(req, "draining")
+                return req.future
             if len(self._q) >= self._cfg.max_queue:
                 self._shed_locked(req, "queue_full")
                 return req.future
@@ -366,6 +374,52 @@ class SearchServer:
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Blocking convenience: ``submit(...).result(timeout)``."""
         return self.submit(queries, k, deadline_ms).result(timeout)
+
+    # -- load / drain (ISSUE 13: the fleet tier's per-replica view) --------
+    def load(self) -> dict:
+        """Cheap load snapshot for routing decisions (the fleet
+        router's power-of-two-choices input) and for /debug surfaces:
+        queued requests/rows, rows in the batch currently executing,
+        the recent shed rate, and the admission state. One lock
+        acquisition, no device work, no allocation beyond the dict."""
+        with self._cond:
+            self._update_shed_rate_locked()
+            return {
+                "queue_depth": len(self._q),
+                "queued_rows": self._rows_queued,
+                "inflight_rows": self._inflight_rows,
+                "shed_rate": (len(self._shed_times)
+                              / _SHED_RATE_WINDOW_S),
+                "draining": self._draining,
+                "closed": self._closed,
+            }
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admission and flush: new submissions fail NOW with
+        :class:`RejectedError` (reason ``draining``) while every
+        already-queued request still executes and every outstanding
+        future resolves. Returns True once the queue is empty and no
+        batch is in flight (False = timed out with work remaining).
+        The dispatcher stays alive — :meth:`resume` re-opens admission
+        (the rolling-restart rejoin path); :meth:`close` afterwards is
+        a clean stop with nothing left to fail."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._q or self._inflight_rows:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.25))
+            return True
+
+    def resume(self) -> None:
+        """Re-open admission after :meth:`drain` (rolling-restart
+        rejoin)."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
 
     # -- internals ---------------------------------------------------------
     def _shed_locked(self, req: _Request, reason: str) -> None:
@@ -464,6 +518,7 @@ class SearchServer:
                     break
                 batch, rows, expired, depth, now = \
                     self._take_batch_locked()
+                self._inflight_rows = rows
             for r in expired:
                 self._fail_deadline(r, now)
             if batch:
@@ -484,6 +539,11 @@ class SearchServer:
                     for r in batch:
                         if not r.future.done():
                             r.future.set_exception(err)
+            with self._cond:
+                # batch finished (or there was none): a drain() waiter
+                # watches this reach zero together with an empty queue
+                self._inflight_rows = 0
+                self._cond.notify_all()
         self._drain_closed()
 
     # -- dispatch hooks (overridden by the distributed tier) ---------------

@@ -1,0 +1,891 @@
+"""Replica fleet serving tests (ISSUE 13).
+
+The fleet acceptance, layer by layer:
+
+* the sequenced WAL — monotone contiguous seqs, the positioned
+  read-only ``WalReader.tail(from_seq)``, resume across the
+  checkpoint-time ``rewrite`` (caught-up readers continue, behind
+  readers get a typed :class:`WalGapError` instead of silent state
+  loss), and apply-parity vs :meth:`MutableIndex.recover`;
+* the batcher's ``load()``/``drain()``/``resume()`` satellite — the
+  router's routing signal and the rolling restart's flush step;
+* the replica lifecycle — validated transitions, drain-before-stop;
+* the router — power-of-two-choices skewing toward the less-loaded
+  replica, health/suspect exclusion, deadline-aware
+  retry-on-another-replica, per-replica admission (one drowning
+  replica sheds alone), typed fleet-level unavailability;
+* replication — bootstrap from snapshot + WAL tail to parity with the
+  live primary (the PR 10 parity test fleet-wide), live tailing
+  through a checkpointed compaction, gap → park;
+* rolling restart — zero failed requests under concurrent traffic,
+  with capacity scaling ~linear across service-time-dominated
+  replicas (the property the shared-device CPU bench cannot show);
+* the surfaces — /healthz fleet fold, /debug/fleet, loadgen's
+  ``kill_replica`` chaos grammar, zero steady-state compiles
+  fleet-wide on the real-index smoke.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+from raft_tpu import mutate, obs
+from raft_tpu.fleet import (FleetConfig, FleetRouter,
+                            FleetUnavailableError, Replica,
+                            ReplicaState, Replicator, WalApplier,
+                            bootstrap_replica, rolling_restart)
+from raft_tpu.mutate.wal import (MutationWAL, WalGapError, WalReader)
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.random import make_blobs
+from raft_tpu.serve import (DeadlineExceeded, DispatchError, PlanLadder,
+                            RejectedError, SearchServer, ServeConfig)
+
+
+def _csum(snap, name):
+    return sum(v for k, v in snap["counters"].items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _cdiff(before, after, name):
+    return _csum(after, name) - _csum(before, name)
+
+
+@pytest.fixture(scope="module")
+def small_flat():
+    x, _ = make_blobs(n_samples=1500, n_features=16, centers=8,
+                      cluster_std=2.0, seed=0)
+    x = np.asarray(x)
+    return x, ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                     kmeans_n_iters=3))
+
+
+class _FakePlan:
+    """Deterministic plan: optional service time, optional scripted
+    failures, returns each row's marker (first feature) as every id."""
+
+    def __init__(self, nq, n_probes, delay_s=0.0, k=4, fail_box=None):
+        self.nq = nq
+        self.n_probes = n_probes
+        self.delay_s = delay_s
+        self.k = k
+        self.fail_box = fail_box     # {"n": remaining failures}
+
+    def search(self, q, block=True):
+        if self.delay_s:
+            time.sleep(self.delay_s)    # service time, then verdict
+        if self.fail_box and self.fail_box.get("n", 0) > 0:
+            self.fail_box["n"] -= 1
+            raise DispatchError("scripted dispatch failure")
+        m = np.asarray(q)[:, :1]
+        return (np.repeat(m.astype(np.float32), self.k, axis=1),
+                np.repeat(m.astype(np.int64), self.k, axis=1))
+
+
+def _fake_server(delay_s=0.0, fail_box=None, max_queue=64,
+                 shapes=(1, 4, 16), max_wait_ms=0.5):
+    plans = {(s, 0): _FakePlan(s, 8, delay_s, fail_box=fail_box)
+             for s in shapes}
+    ladder = PlanLadder(shapes=shapes, rungs=(8,), plans=plans, dim=4,
+                        k=4)
+    return SearchServer(ladder, ServeConfig(batch_sizes=shapes,
+                                            max_queue=max_queue,
+                                            max_wait_ms=max_wait_ms))
+
+
+def _rows(n, base=0):
+    out = np.zeros((n, 4), np.float32)
+    out[:, 0] = np.arange(base, base + n, dtype=np.float32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sequenced WAL + positioned reader
+# ---------------------------------------------------------------------------
+
+
+class TestWalSequencing:
+    def test_seqs_monotone_contiguous_and_restored(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=False)
+        w.append_upsert([1, 2], np.zeros((2, 4), np.float32))
+        w.append_delete([1])
+        w.append_delete([2])
+        recs = w.replay()
+        assert [r.seq for r in recs] == [1, 2, 3]
+        assert all(r.ts > 0 for r in recs)
+        w.close()
+        # reopen continues the space — never restarts
+        w2 = MutationWAL(p, sync=False)
+        assert w2.next_seq == 4
+        w2.append_delete([3])
+        assert [r.seq for r in w2.replay()] == [1, 2, 3, 4]
+
+    def test_reader_tail_positions_and_increments(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=False)
+        for i in range(5):
+            w.append_delete([i])
+        r = WalReader(p)
+        assert [x.seq for x in r.tail()] == [1, 2, 3, 4, 5]
+        assert r.tail() == []           # caught up
+        w.append_delete([9])
+        assert [x.seq for x in r.tail()] == [6]
+        # positioned start + bounded batches
+        r2 = WalReader(p, from_seq=3)
+        assert [x.seq for x in r2.tail(max_records=2)] == [4, 5]
+        assert [x.seq for x in r2.tail()] == [6]
+
+    def test_reader_resumes_across_rewrite(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=False)
+        rows = np.arange(8, dtype=np.float32).reshape(2, 4)
+        w.append_upsert([5, 6], rows)
+        w.append_delete([5])
+        r = WalReader(p)
+        assert len(r.tail()) == 2       # caught up at seq 2
+        w.rewrite(meta={"epoch": 1, "id_base": 10, "next_id": 20},
+                  tomb_ids=[5], upsert_ids=[6], upsert_rows=rows[:1])
+        recs = r.tail()
+        # seq space is monotone across truncation: meta=3, delete=4,
+        # upsert=5; snapshot_upto_seq names the snapshot records
+        assert [(x.seq, x.op) for x in recs] == [(3, 3), (4, 2), (5, 1)]
+        assert recs[0].meta["snapshot_upto_seq"] == 5
+        # appends after the rewrite keep flowing to the same reader
+        w.append_delete([7])
+        assert [x.seq for x in r.tail()] == [6]
+
+    def test_behind_reader_gaps_fresh_reader_does_not(self, tmp_path):
+        p = str(tmp_path / "m.wal")
+        w = MutationWAL(p, sync=False)
+        for i in range(4):
+            w.append_delete([i])
+        behind = WalReader(p)
+        behind.tail(from_seq=1)         # consumed only seq 1... rest
+        w.rewrite(meta={"epoch": 1, "id_base": 4, "next_id": 4})
+        behind2 = WalReader(p, from_seq=2)
+        with pytest.raises(WalGapError):
+            behind2.tail()
+        # a FRESH reader (bootstrap: state comes from the checkpoint)
+        # replays the rewritten log without a gap verdict
+        fresh = WalReader(p)
+        assert [x.op for x in fresh.tail()] == [3]
+
+    def test_reader_apply_matches_recover(self, small_flat, tmp_path):
+        """Ordered at-least-once apply through the reader reproduces
+        exactly what crash recovery reproduces — the reader IS the
+        replication protocol."""
+        x, idx = small_flat
+        p = str(tmp_path / "m.wal")
+        m = mutate.MutableIndex(idx, k=4)
+        m.attach_wal(MutationWAL(p, sync=False))
+        ids = m.upsert(x[:10] + 0.01)
+        m.delete(ids[:3])
+        m.upsert(x[10:12] + 0.02, ids=ids[3:5])
+        follower = mutate.MutableIndex(idx, k=4)
+        applier = WalApplier(follower)
+        for rec in WalReader(p).tail():
+            applier.apply(rec)
+        recovered = mutate.MutableIndex.recover(p, k=4, base_index=idx,
+                                                sync=False)
+        s1, s2 = follower.stats(), recovered.stats()
+        for key in ("delta_used", "delta_live", "tombstones",
+                    "next_id", "id_base"):
+            assert s1[key] == s2[key], key
+        q = x[:16]
+        _, i1 = follower.search(q, block=True)
+        _, i2 = recovered.search(q, block=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+# ---------------------------------------------------------------------------
+# batcher load()/drain()/resume()
+# ---------------------------------------------------------------------------
+
+
+class TestBatcherLoadDrain:
+    def test_load_snapshot_reflects_queue_and_inflight(self):
+        srv = _fake_server(delay_s=0.15, max_wait_ms=0.0)
+        try:
+            snap = srv.load()
+            assert snap == {"queue_depth": 0, "queued_rows": 0,
+                            "inflight_rows": 0, "shed_rate": 0.0,
+                            "draining": False, "closed": False}
+            futs = [srv.submit(_rows(1, base=i)) for i in range(6)]
+            # one batch in flight, the rest queued (service time 150ms)
+            time.sleep(0.05)
+            snap = srv.load()
+            assert snap["inflight_rows"] >= 1
+            assert snap["queue_depth"] + snap["inflight_rows"] >= 2
+            for f in futs:
+                f.result(timeout=30)
+            assert srv.load()["queued_rows"] == 0
+        finally:
+            srv.close()
+
+    def test_drain_flushes_blocks_admission_and_resumes(self):
+        srv = _fake_server(delay_s=0.05, max_wait_ms=0.0)
+        try:
+            futs = [srv.submit(_rows(1, base=i)) for i in range(4)]
+            before = obs.snapshot()
+            assert srv.drain(timeout_s=30.0)
+            # everything queued at drain time resolved
+            for f in futs:
+                d, i = f.result(timeout=1.0)
+                assert i.shape == (1, 4)
+            assert srv.load()["draining"] is True
+            # admission is closed: immediate typed shed
+            with pytest.raises(RejectedError):
+                srv.search(_rows(1))
+            assert _cdiff(before, obs.snapshot(),
+                          "raft.serve.shed.total{reason=draining}") == 1
+            # rejoin: admission re-opens, the dispatcher never died
+            srv.resume()
+            d, i = srv.search(_rows(1, base=42), timeout=30)
+            assert i[0, 0] == 42
+        finally:
+            srv.close()
+
+    def test_drain_timeout_reports_false(self):
+        srv = _fake_server(delay_s=0.3, max_wait_ms=0.0)
+        try:
+            futs = [srv.submit(_rows(1, base=i)) for i in range(5)]
+            assert srv.drain(timeout_s=0.05) is False
+            for f in futs:       # work still completes afterwards
+                f.result(timeout=30)
+            assert srv.drain(timeout_s=10.0) is True
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# replica lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaLifecycle:
+    def test_transitions_validated_and_exported(self):
+        srv = _fake_server()
+        try:
+            rep = Replica("a", srv)
+            assert rep.state is ReplicaState.SERVING
+            assert rep.routable()
+            before = obs.snapshot()
+            rep.begin_drain()
+            assert not rep.routable()
+            rep.mark_serving()          # drain aborted: rejoin
+            rep.begin_drain()
+            rep.mark_down()
+            # DOWN cannot jump straight to SERVING
+            with pytest.raises(Exception):
+                rep.mark_serving()
+            rep.begin_bootstrap()
+            rep.mark_serving()
+            after = obs.snapshot()
+            assert obs.snapshot()["gauges"][
+                "raft.fleet.replica.state{replica=a}"] == \
+                ReplicaState.SERVING.code
+            assert _cdiff(
+                before, after,
+                "raft.fleet.replica.transitions.total") == 6
+        finally:
+            srv.close()
+
+    def test_load_signal_and_unroutable_states(self):
+        srv = _fake_server(delay_s=0.2, max_wait_ms=0.0)
+        try:
+            rep = Replica("b", srv)
+            assert rep.load() == 0.0
+            futs = [srv.submit(_rows(1, base=i)) for i in range(4)]
+            time.sleep(0.05)
+            assert rep.load() >= 1.0
+            rep.begin_drain()
+            assert rep.load() == float("inf")
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            srv.close()
+
+    def test_drain_before_stop(self):
+        srv = _fake_server(delay_s=0.05, max_wait_ms=0.0)
+        rep = Replica("c", srv)
+        futs = [srv.submit(_rows(1, base=i)) for i in range(4)]
+        assert rep.stop(drain_timeout_s=30.0)
+        # nothing accepted was dropped: every future resolved OK
+        for f in futs:
+            d, i = f.result(timeout=1.0)
+            assert i.shape == (1, 4)
+        assert rep.state is ReplicaState.DOWN
+        assert rep.server is None
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+class TestRouter:
+    def test_two_choices_prefers_less_loaded(self):
+        """One slow replica, one fast, PACED arrivals (the queues must
+        get a chance to reflect service rates — an un-paced burst
+        makes both queues equal and p2c rightly splits it): the fast
+        replica must take the clear majority."""
+        slow = _fake_server(delay_s=0.05, max_wait_ms=0.0)
+        fast = _fake_server(delay_s=0.0, max_wait_ms=0.0)
+        router = FleetRouter([Replica("slow", slow),
+                              Replica("fast", fast)],
+                             FleetConfig(seed=7))
+        try:
+            before = obs.snapshot()
+            futs = []
+            for i in range(60):
+                futs.append(router.submit(_rows(1, base=i)))
+                time.sleep(0.004)
+            for f in futs:
+                f.result(timeout=60)
+            after = obs.snapshot()
+            n_fast = _cdiff(before, after,
+                            "raft.fleet.route.total{replica=fast}")
+            n_slow = _cdiff(before, after,
+                            "raft.fleet.route.total{replica=slow}")
+            assert n_fast + n_slow == 60
+            # anything 'slow' accepted occupies its queue for ~50 ms,
+            # so the duels during that window all pick 'fast' — the
+            # majority must be clear (an even split = blind routing)
+            assert n_fast >= 2 * n_slow, (n_fast, n_slow)
+        finally:
+            router.close()
+
+    def test_excludes_non_serving_replicas(self):
+        a, b = _fake_server(), _fake_server()
+        router = FleetRouter([Replica("a", a), Replica("b", b)])
+        try:
+            router.replica("a").begin_drain()
+            before = obs.snapshot()
+            for i in range(10):
+                router.search(_rows(1, base=i), timeout=30)
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.fleet.route.total{replica=a}") == 0
+            assert _cdiff(before, after,
+                          "raft.fleet.route.total{replica=b}") == 10
+        finally:
+            router.close()
+
+    def test_retry_on_other_replica_and_suspect_exclusion(self):
+        fail_box = {"n": 1000}          # 'bad' fails every dispatch
+        bad = _fake_server(fail_box=fail_box)
+        good = _fake_server()
+        router = FleetRouter(
+            [Replica("bad", bad), Replica("good", good)],
+            FleetConfig(max_retries=1, suspect_ms=60_000.0, seed=3))
+        try:
+            before = obs.snapshot()
+            for i in range(20):
+                d, ids = router.search(_rows(1, base=i), timeout=30)
+                assert ids[0, 0] == i   # the answer came from 'good'
+            after = obs.snapshot()
+            # the first failure marked 'bad' suspect; every subsequent
+            # request routed around it without a retry
+            assert _cdiff(before, after,
+                          "raft.fleet.suspect.total{replica=bad}") >= 1
+            assert _cdiff(before, after, "raft.fleet.retry.total") >= 1
+            assert _cdiff(before, after,
+                          "raft.fleet.retry.success.total") >= 1
+            assert "bad" in router.suspects()
+        finally:
+            router.close()
+
+    def test_suspect_expires_and_replica_recovers(self):
+        fail_box = {"n": 1}             # fails once, then healthy
+        flaky = _fake_server(fail_box=fail_box)
+        other = _fake_server()
+        router = FleetRouter(
+            [Replica("flaky", flaky), Replica("other", other)],
+            FleetConfig(max_retries=1, suspect_ms=50.0, seed=1))
+        try:
+            for i in range(5):
+                router.search(_rows(1, base=i), timeout=30)
+            time.sleep(0.1)             # suspect window expires
+            before = obs.snapshot()
+            for i in range(40):
+                router.search(_rows(1, base=i), timeout=30)
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.fleet.route.total{replica=flaky}") > 0
+        finally:
+            router.close()
+
+    def test_deadline_aware_no_retry_past_budget(self):
+        """Every replica fails and the budget is ~gone after the first
+        failure: the router must fail the caller NOW with
+        DeadlineExceeded instead of burning the retry budget past the
+        deadline (with a 3-retry budget and no deadline pressure the
+        same fleet would spin through 4 dispatch attempts)."""
+        bad1 = _fake_server(delay_s=0.02, fail_box={"n": 1000})
+        bad2 = _fake_server(delay_s=0.02, fail_box={"n": 1000})
+        router = FleetRouter(
+            [Replica("bad1", bad1), Replica("bad2", bad2)],
+            FleetConfig(max_retries=3, suspect_ms=0.0, seed=5))
+        try:
+            before = obs.snapshot()
+            t0 = time.perf_counter()
+            with pytest.raises(DeadlineExceeded):
+                router.search(_rows(1), deadline_ms=1.0, timeout=30)
+            assert time.perf_counter() - t0 < 5.0
+            after = obs.snapshot()
+            assert _cdiff(before, after,
+                          "raft.fleet.deadline.total") == 1
+            # without deadline pressure the retry budget is spent in
+            # full before the typed error surfaces
+            with pytest.raises(DispatchError):
+                router.search(_rows(1), timeout=30)
+            assert _cdiff(after, obs.snapshot(),
+                          "raft.fleet.retry.exhausted.total") == 1
+        finally:
+            router.close()
+
+    def test_per_replica_admission_one_sheds_fleet_absorbs(self):
+        """One replica with a tiny queue drowns; the fleet absorbs its
+        spillover — per-replica admission never becomes fleet-wide
+        collapse."""
+        tiny = _fake_server(delay_s=0.1, max_queue=1, max_wait_ms=0.0)
+        big = _fake_server(delay_s=0.0, max_queue=256, max_wait_ms=0.0)
+        router = FleetRouter(
+            [Replica("tiny", tiny), Replica("big", big)],
+            FleetConfig(max_retries=1, suspect_ms=0.0, seed=2))
+        try:
+            futs = [router.submit(_rows(1, base=i)) for i in range(50)]
+            ok = 0
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                    ok += 1
+                except Exception:
+                    pass
+            # a shed on 'tiny' reroutes to 'big' — fleet availability
+            # stays total even while one member is saturated
+            assert ok == 50
+            assert "tiny" not in router.suspects()  # load != sickness
+        finally:
+            router.close()
+
+    def test_all_down_is_typed_unavailability(self):
+        a = _fake_server()
+        router = FleetRouter([Replica("a", a)])
+        try:
+            router.replica("a").kill()
+            before = obs.snapshot()
+            with pytest.raises(FleetUnavailableError):
+                router.search(_rows(1), timeout=10)
+            assert _cdiff(before, obs.snapshot(),
+                          "raft.fleet.unroutable.total") == 1
+        finally:
+            router.close()
+
+    def test_route_span_emitted(self):
+        a = _fake_server()
+        router = FleetRouter([Replica("a", a)])
+        try:
+            router.search(_rows(1), timeout=10)
+            traces = obs.RECORDER.requests(5)
+            names = {t["name"] for t in traces}
+            assert "raft.fleet.route" in names
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# replication: bootstrap + tail + compaction follow
+# ---------------------------------------------------------------------------
+
+
+class TestReplication:
+    def _primary(self, x, idx, tmp_path, ckpt=True):
+        wal_p = str(tmp_path / "m.wal")
+        ckpt_p = str(tmp_path / "m.ckpt") if ckpt else None
+        m = mutate.MutableIndex(idx, k=4)
+        m.attach_wal(MutationWAL(wal_p, sync=False),
+                     checkpoint_path=ckpt_p)
+        return m, wal_p, ckpt_p
+
+    def test_bootstrap_parity_with_live_primary(self, small_flat,
+                                                tmp_path):
+        x, idx = small_flat
+        prim, wal_p, _ = self._primary(x, idx, tmp_path)
+        ids = prim.upsert(x[:10] + 0.01)
+        prim.delete(ids[:3])
+        prim.delete([2, 5])
+        prim.upsert(x[10:12] + 0.02, ids=ids[3:5])
+        before = obs.snapshot()
+        follower, reader, applier = bootstrap_replica(
+            wal_p, k=4, base_index=idx, name="f0")
+        assert _cdiff(before, obs.snapshot(),
+                      "raft.fleet.bootstrap.total") == 1
+        s1, s2 = prim.stats(), follower.stats()
+        for key in ("delta_used", "delta_live", "tombstones",
+                    "next_id", "id_base"):
+            assert s1[key] == s2[key], key
+        q = x[:32]
+        d1, i1 = prim.search(q, block=True)
+        d2, i2 = follower.search(q, block=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5)
+
+    def test_live_tail_keeps_follower_fresh(self, small_flat,
+                                            tmp_path):
+        x, idx = small_flat
+        prim, wal_p, _ = self._primary(x, idx, tmp_path)
+        follower, reader, applier = bootstrap_replica(
+            wal_p, k=4, base_index=idx, name="f1")
+        repl = Replicator(follower, wal_p, name="f1", poll_ms=5.0,
+                          reader=reader, applier=applier)
+        try:
+            ids = prim.upsert(x[:20] + 0.04)
+            prim.delete(ids[:5])
+            assert repl.drain(20.0)
+            q = x[:32]
+            _, i1 = prim.search(q, block=True)
+            _, i2 = follower.search(q, block=True)
+            np.testing.assert_array_equal(np.asarray(i1),
+                                          np.asarray(i2))
+            gauges = obs.snapshot()["gauges"]
+            assert gauges[
+                "raft.fleet.replication.lag_records{replica=f1}"] == 0
+        finally:
+            repl.close()
+
+    def test_follower_tracks_checkpointed_compaction(self, small_flat,
+                                                     tmp_path):
+        """The primary folds (checkpoint + WAL rewrite); a caught-up
+        follower follows via the meta record — same epoch, identical
+        search answers, and the rewritten snapshot records are not
+        double-applied."""
+        x, idx = small_flat
+        prim, wal_p, ckpt_p = self._primary(x, idx, tmp_path)
+        follower, reader, applier = bootstrap_replica(
+            wal_p, k=4, base_index=idx, name="f2")
+        repl = Replicator(follower, wal_p, name="f2", poll_ms=5.0,
+                          reader=reader, applier=applier)
+        try:
+            ids = prim.upsert(x[:15] + 0.03)
+            prim.delete(ids[:4])
+            assert repl.drain(20.0)
+            assert prim.compact()
+            prim.upsert(x[30:35] + 0.06)    # traffic after the fold
+            assert repl.drain(20.0)
+            assert follower.epoch == prim.epoch == 1
+            q = x[:32]
+            _, i1 = prim.search(q, block=True)
+            _, i2 = follower.search(q, block=True)
+            np.testing.assert_array_equal(np.asarray(i1),
+                                          np.asarray(i2))
+            assert prim.stats()["next_id"] == \
+                follower.stats()["next_id"]
+            assert not repl.gap
+        finally:
+            repl.close()
+
+    def test_fresh_bootstrap_from_checkpoint_after_compaction(
+            self, small_flat, tmp_path):
+        x, idx = small_flat
+        prim, wal_p, ckpt_p = self._primary(x, idx, tmp_path)
+        ids = prim.upsert(x[:12] + 0.02)
+        prim.delete(ids[:2])
+        assert prim.compact()
+        prim.upsert(x[40:44] + 0.05)
+        # a replica born AFTER the fold: checkpoint + rewritten log
+        follower, reader, applier = bootstrap_replica(
+            wal_p, k=4, checkpoint_path=ckpt_p, name="f3")
+        q = x[:32]
+        _, i1 = prim.search(q, block=True)
+        _, i2 = follower.search(q, block=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        assert follower.epoch == prim.epoch
+
+    def test_behind_follower_parks_on_gap(self, small_flat, tmp_path):
+        x, idx = small_flat
+        prim, wal_p, ckpt_p = self._primary(x, idx, tmp_path)
+        prim.upsert(x[:8] + 0.01)
+        follower = mutate.MutableIndex(idx, k=4)
+        # a reader stranded mid-log (positioned before records the
+        # rewrite will fold away)
+        stale_reader = WalReader(wal_p, from_seq=0)
+        stale_reader.last_seq = 0
+        prim.upsert(x[8:16] + 0.02)
+        assert prim.compact()           # rewrite happens here
+        stale_reader.last_seq = 1       # pretend we stopped at seq 1
+        repl = Replicator(follower, wal_p, name="f4", poll_ms=5.0,
+                          reader=stale_reader,
+                          applier=WalApplier(follower))
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and not repl.gap:
+                time.sleep(0.02)
+            assert repl.gap
+            assert obs.snapshot()["gauges"][
+                "raft.fleet.replication.gap{replica=f4}"] == 1
+        finally:
+            repl.close()
+
+
+# ---------------------------------------------------------------------------
+# rolling restart
+# ---------------------------------------------------------------------------
+
+
+class TestRollingRestart:
+    def test_zero_failed_requests_under_load(self):
+        reps = [Replica(f"r{i}", _fake_server(delay_s=0.004))
+                for i in range(3)]
+        router = FleetRouter(reps, FleetConfig(max_retries=1, seed=4))
+        stop = threading.Event()
+        failures, completed = [], [0]
+        lock = threading.Lock()
+
+        def traffic(tid):
+            i = tid
+            while not stop.is_set():
+                try:
+                    d, ids = router.search(_rows(1, base=i), timeout=60)
+                    assert ids[0, 0] == i
+                    with lock:
+                        completed[0] += 1
+                except Exception as e:
+                    with lock:
+                        failures.append(repr(e))
+                i += 4
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=traffic, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.1)
+
+            def restart(rep):
+                rep.set_server(_fake_server(delay_s=0.004))
+
+            report = rolling_restart(router, restart,
+                                     drain_timeout_s=30.0)
+            time.sleep(0.1)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            router.close()
+        assert report["ok"]
+        assert [e["ok"] for e in report["replicas"]] == [True] * 3
+        assert failures == []           # ZERO failed requests
+        assert completed[0] > 50
+        assert all(r.state is ReplicaState.DOWN for r in reps)
+
+    def test_failed_restart_halts_rollout(self):
+        reps = [Replica(f"h{i}", _fake_server()) for i in range(3)]
+        router = FleetRouter(reps)
+        try:
+            calls = []
+
+            def restart(rep):
+                calls.append(rep.name)
+                if len(calls) == 2:
+                    raise RuntimeError("bad build")
+                rep.set_server(_fake_server())
+
+            report = rolling_restart(router, restart)
+            assert not report["ok"]
+            assert len(calls) == 2      # third replica never touched
+            assert reps[1].state is ReplicaState.DOWN
+            assert reps[2].state is ReplicaState.SERVING
+            # traffic still flows through the untouched replicas
+            router.search(_rows(1), timeout=10)
+        finally:
+            router.close()
+
+    def test_requires_capacity(self):
+        rep = Replica("solo", _fake_server())
+        router = FleetRouter([rep])
+        try:
+            with pytest.raises(Exception):
+                rolling_restart(router, lambda r: None)
+        finally:
+            router.close()
+
+    def test_capacity_scales_with_service_time_dominated_replicas(self):
+        """The linear-scaling property the shared-device bench cannot
+        show: with service-time-dominated replicas (sleepy fake plans
+        — each replica a fixed-rate server), fleet capacity is
+        ~N times one replica's."""
+        delay = 0.02
+
+        def capacity(n_reps):
+            router = FleetRouter(
+                [Replica(f"s{n_reps}_{i}", _fake_server(
+                    delay_s=delay, max_wait_ms=0.0))
+                 for i in range(n_reps)],
+                FleetConfig(seed=6))
+            try:
+                t_end = time.perf_counter() + 1.0
+                done = [0]
+                lock = threading.Lock()
+
+                def client(tid):
+                    i = tid
+                    while time.perf_counter() < t_end:
+                        router.search(_rows(1, base=i), timeout=60)
+                        with lock:
+                            done[0] += 1
+                        i += 1
+                threads = [threading.Thread(target=client, args=(t,))
+                           for t in range(3 * n_reps)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                return done[0] / (time.perf_counter() - t0)
+            finally:
+                router.close()
+
+        q1, q3 = capacity(1), capacity(3)
+        # ~linear with generous slack for scheduler jitter: 3 replicas
+        # must clear 2x one replica's ceiling (blind routing or a
+        # broken p2c would pin near 1x)
+        assert q3 >= 2.0 * q1, (q1, q3)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: healthz / debug / loadgen grammar / fleet smoke
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    @staticmethod
+    def _get(url):
+        """(status, json body) — a 503 /healthz is a verdict to
+        assert on, not an exception (urlopen raises on it)."""
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_healthz_fleet_fold_and_debug_fleet(self):
+        a, b = _fake_server(), _fake_server()
+        router = FleetRouter([Replica("ha", a), Replica("hb", b)])
+        router.search(_rows(1), timeout=10)
+        ep = obs.serve(port=0, fleet=router)
+        try:
+            code, body = self._get(ep.url + "/debug/fleet")
+            assert code == 200
+            assert body["serving"] == 2
+            assert {r["name"] for r in body["replicas"]} >= {"ha", "hb"}
+            # /healthz carries the fleet section (other planes in the
+            # SHARED registry may already be degraded from earlier
+            # tests — assert on the fleet section, not the verdict)
+            _, hb = self._get(ep.url + "/healthz")
+            assert hb["fleet"]["replicas"] >= 2
+            assert hb["fleet"]["serving"] == 2
+            # one replica out of the serving set → degraded verdict
+            # (serving < total forces 503 regardless of other planes).
+            # No manual gauge poke: routing traffic is what keeps the
+            # fleet gauges honest (the rate-limited refresh on _pick)
+            router.replica("hb").begin_drain()
+            time.sleep(FleetRouter._GAUGE_REFRESH_S + 0.05)
+            router.search(_rows(1), timeout=10)
+            code, hb = self._get(ep.url + "/healthz")
+            assert code == 503
+            assert hb["status"] == "degraded"
+            assert hb["fleet"]["serving"] == 1
+        finally:
+            ep.close()
+            router.close()
+
+    def test_loadgen_kill_replica_grammar(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "raft_loadgen_fleet_test",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tools", "loadgen.py"))
+        loadgen = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(loadgen)
+        events = loadgen.parse_chaos_spec(
+            "kill_replica:1@t+2s+3s,stall_shard:0@t+1s")
+        assert events == [(1.0, "stall_shard", "0", 5.0),
+                          (2.0, "kill_replica", "1", 3.0)]
+        with pytest.raises(ValueError):
+            loadgen.parse_chaos_spec("eat_replica:1@t+2s")
+        share = loadgen.fleet_route_share(
+            {"raft.fleet.route.total{replica=r0}": 30.0,
+             "raft.fleet.route.total{replica=r1}": 10.0})
+        assert share == {"r0": 0.75, "r1": 0.25}
+
+    def test_real_index_fleet_kill_availability_zero_compiles(
+            self, small_flat):
+        """The CPU fleet smoke of the acceptance row: 3 replicas over
+        a real index, a full replica kill mid-traffic, availability
+        1.0, the kill routed around with zero steady-state compiles
+        fleet-wide (the revived replica warms from the shared plan
+        cache)."""
+        x, idx = small_flat
+        q_np = x[:64]
+        sp = ivf_flat.SearchParams(n_probes=8)   # exhaustive: 8 lists
+        cfg = ServeConfig(batch_sizes=(1, 8), max_queue=256,
+                          max_wait_ms=1.0, default_deadline_ms=5000.0)
+
+        def build_server():
+            return SearchServer.from_index(idx, q_np[:8], 4, params=sp,
+                                           config=cfg)
+
+        reps = [Replica(f"s{i}", build_server()) for i in range(3)]
+        router = FleetRouter(
+            reps, FleetConfig(max_retries=1, suspect_ms=300.0, seed=0))
+        try:
+            router.search(q_np[:1], timeout=60)     # warm the route
+            before = obs.snapshot()
+            stop = threading.Event()
+            failures, done = [], [0]
+            lock = threading.Lock()
+
+            def traffic(tid):
+                i = tid
+                while not stop.is_set():
+                    try:
+                        router.search(q_np[i % 64:i % 64 + 1],
+                                      timeout=60)
+                        with lock:
+                            done[0] += 1
+                    except Exception as e:
+                        with lock:
+                            failures.append(repr(e))
+                    i += 3
+            threads = [threading.Thread(target=traffic, args=(t,))
+                       for t in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            reps[1].kill()                          # full replica kill
+            time.sleep(0.3)
+            reps[1].begin_bootstrap()
+            reps[1].set_server(build_server())      # revive from cache
+            reps[1].mark_serving()
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            after = obs.snapshot()
+            assert failures == []                   # availability 1.0
+            assert done[0] > 20
+            compiles = (_cdiff(before, after, "raft.plan.cache.misses")
+                        + _cdiff(before, after, "raft.plan.build.total"))
+            assert compiles == 0
+        finally:
+            router.close()

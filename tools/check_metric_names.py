@@ -159,6 +159,24 @@ REQUIRED_NAMES = (
     "raft.obs.quality.drift.total",
     "raft.slo.burn_rate",
     "raft.slo.breach",
+    # replica fleet serving (ISSUE 13): the routing decision volume
+    # per replica, the fleet-level retry/backpressure counters, the
+    # replica lifecycle gauges /healthz's fleet section reads, the
+    # bootstrap counter (timed as raft.fleet.bootstrap.seconds), and
+    # the replication-lag gauges the freshness story keys on
+    "raft.fleet.route.total",
+    "raft.fleet.retry.total",
+    "raft.fleet.unroutable.total",
+    "raft.fleet.replicas.total",
+    "raft.fleet.replicas.serving",
+    "raft.fleet.suspects",
+    "raft.fleet.replica.state",
+    "raft.fleet.replica.transitions.total",
+    "raft.fleet.bootstrap.total",
+    "raft.fleet.replication.applied.total",
+    "raft.fleet.replication.lag_records",
+    "raft.fleet.replication.lag_seconds",
+    "raft.fleet.rolling.total",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -201,6 +219,11 @@ REQUIRED_SPAN_NAMES = (
     # opens one span (family, query count) — off the serving path, so
     # it roots its own trace
     "raft.obs.quality.shadow",
+    # replica fleet serving (ISSUE 13): every routing decision opens
+    # one span (replica, attempt) under the caller's trace — a traced
+    # request names which replica answered it and how many re-routes
+    # it took
+    "raft.fleet.route",
 )
 
 

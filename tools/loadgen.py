@@ -61,10 +61,12 @@ def parse_chaos_spec(spec: str, default_duration_s: float = 5.0):
     duration_s)`` events. Grammar per event:
     ``<kind>[:<arg>]@t+<seconds>s[+<duration>s]`` with kinds
     ``stall_shard`` (arg = rank), ``kill_compactor``,
-    ``fail_transfer`` (arg = times, default 1) and ``delay_execute``
-    (arg = ms)."""
+    ``fail_transfer`` (arg = times, default 1), ``delay_execute``
+    (arg = ms) and ``kill_replica`` (arg = replica index; requires
+    ``--fleet`` — the replica dies without draining at the offset and
+    is revived after the duration, ISSUE 13)."""
     known = ("stall_shard", "kill_compactor", "fail_transfer",
-             "delay_execute")
+             "delay_execute", "kill_replica")
     events = []
     for part in spec.split(","):
         part = part.strip()
@@ -87,12 +89,29 @@ def parse_chaos_spec(spec: str, default_duration_s: float = 5.0):
     return sorted(events)
 
 
-def run_chaos_schedule(events, stop: threading.Event) -> threading.Thread:
+def run_chaos_schedule(events, stop: threading.Event,
+                       router=None, revive_fn=None) -> threading.Thread:
     """Drive the fault harness on a schedule: a daemon thread enters
     each event's scope at its offset and exits it after its duration
-    (or when ``stop`` is set — faults never outlive the run)."""
-    from contextlib import ExitStack
+    (or when ``stop`` is set — faults never outlive the run).
+    ``kill_replica`` events need ``router`` (a
+    :class:`raft_tpu.fleet.FleetRouter`); ``revive_fn()`` builds the
+    replacement server the killed replica rejoins with after the
+    event's duration (None = the replica stays dead)."""
+    from contextlib import ExitStack, contextmanager
     from raft_tpu.testing import faults
+
+    @contextmanager
+    def _replica_kill(idx):
+        rep = router.replicas[int(idx)]
+        rep.kill()      # no drain — a crash, not a deploy
+        try:
+            yield
+        finally:
+            if revive_fn is not None:
+                rep.begin_bootstrap()
+                rep.set_server(revive_fn())
+                rep.mark_serving()
 
     def _enter(stack, kind, arg, dur):
         if kind == "stall_shard":
@@ -103,6 +122,10 @@ def run_chaos_schedule(events, stop: threading.Event) -> threading.Thread:
         if kind == "fail_transfer":
             return stack.enter_context(
                 faults.fail_transfer(times=int(arg or 1)))
+        if kind == "kill_replica":
+            if router is None:
+                raise ValueError("chaos kill_replica needs --fleet")
+            return stack.enter_context(_replica_kill(int(arg or 0)))
         return stack.enter_context(
             faults.delay_execute(float(arg or 10.0)))
 
@@ -335,6 +358,59 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
     return srv, q, None
 
 
+def _build_fleet(n: int, dim: int, n_lists: int, k: int,
+                 probes_ladder, deadline_ms: float, n_replicas: int,
+                 chaos: bool = False):
+    """N single-host replicas over ONE built index behind a
+    :class:`raft_tpu.fleet.FleetRouter` (the CPU fleet smoke: real
+    deployments put each replica on its own host/mesh — here they
+    share the device, so the plan cache is shared too and replicas
+    N > 1 warm from cache with zero fresh compiles). Returns
+    ``(router, query_pool, build_server_fn)`` — the builder is what a
+    ``kill_replica`` chaos event revives with."""
+    from raft_tpu import fleet, serve
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.random import make_blobs
+
+    x, _ = make_blobs(n_samples=n, n_features=dim,
+                      centers=max(8, n // 200), seed=0)
+    q, _ = make_blobs(n_samples=512, n_features=dim,
+                      centers=max(8, n // 200), seed=1)
+    x, q = np.asarray(x), np.asarray(q)
+    index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
+                                                   kmeans_n_iters=4))
+    params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
+    cfg = serve.ServeConfig(
+        batch_sizes=(1, 8, 32), max_queue=256, max_wait_ms=2.0,
+        probes_ladder=tuple(probes_ladder),
+        default_deadline_ms=deadline_ms)
+
+    def build_server():
+        return serve.SearchServer.from_index(index, q[:32], k=k,
+                                             params=params, config=cfg)
+
+    reps = [fleet.Replica(f"r{i}", build_server())
+            for i in range(n_replicas)]
+    router = fleet.FleetRouter(
+        reps, fleet.FleetConfig(max_retries=max(1, int(chaos)),
+                                suspect_ms=500.0 if chaos else 2000.0,
+                                default_deadline_ms=deadline_ms))
+    return router, q, build_server
+
+
+def fleet_route_share(counters_diff: dict) -> dict:
+    """Per-replica route share out of a counters diff (the
+    ``raft.fleet.route.total{replica=...}`` series)."""
+    routes = {}
+    for key, v in counters_diff.items():
+        if key.startswith("raft.fleet.route.total{"):
+            name = key.split("replica=")[1].rstrip("}").split(",")[0]
+            routes[name] = routes.get(name, 0) + int(v)
+    total = max(1, sum(routes.values()))
+    return {name: round(c / total, 4)
+            for name, c in sorted(routes.items())}
+
+
 def merge_bytes_by_rung(metrics_diff: dict) -> dict:
     """Per-rung compressed merge-bytes out of a ``raft.serve.*``
     counters diff (the ``raft.serve.dist.merge.bytes_post{level=r}``
@@ -370,6 +446,14 @@ def main(argv=None) -> int:
                          "SearchServer, 'dist' = DistributedSearchServer "
                          "over a mesh of every local device (list-"
                          "sharded index, quantized cross-shard merge)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="serve through N replica servers behind a "
+                         "power-of-two-choices FleetRouter (ISSUE 13) "
+                         "— the report gains per-replica route shares; "
+                         "combine with --chaos kill_replica:<i>@t+... "
+                         "for the availability-through-replica-kill "
+                         "row. CPU smoke shares one device; real "
+                         "fleets put each replica on its own host")
     ap.add_argument("--mutate-frac", type=float, default=0.0,
                     help="fraction of arrivals that are WRITES "
                          "(upsert/delete against a MutableIndex with a "
@@ -404,6 +488,15 @@ def main(argv=None) -> int:
         ap.error("--mutate-frac rides the single-device server "
                  "(DistributedSearchServer.from_mutable is the "
                  "library-level mesh path)")
+    if args.fleet and (args.server == "dist" or args.mutate_frac
+                       or args.demo):
+        ap.error("--fleet rides the plain single-server open loop "
+                 "(each replica is its own SearchServer; --server "
+                 "dist / --mutate-frac / --demo compose at the "
+                 "library level, not in this tool)")
+    if args.fleet and args.fleet < 2:
+        ap.error("--fleet needs >= 2 replicas (1 replica is just "
+                 "--server single)")
     chaos_events = (parse_chaos_spec(args.chaos, args.chaos_duration)
                     if args.chaos else None)
     if chaos_events and any(e[1] in ("kill_compactor", "fail_transfer")
@@ -411,6 +504,9 @@ def main(argv=None) -> int:
             and not args.mutate_frac:
         ap.error("--chaos kill_compactor/fail_transfer need a mutable "
                  "serving path — add --mutate-frac (> 0)")
+    if chaos_events and any(e[1] == "kill_replica"
+                            for e in chaos_events) and not args.fleet:
+        ap.error("--chaos kill_replica needs --fleet N")
     if chaos_events and args.demo:
         ap.error("--chaos rides the plain open-loop run (the demo's "
                  "calibration phase would skew the event offsets)")
@@ -418,6 +514,47 @@ def main(argv=None) -> int:
     ladder = tuple(int(s) for s in args.probes_ladder.split(","))
     quality_sample = (args.quality_sample if args.quality_sample
                       is not None else (0.25 if args.demo else 0.0))
+    if args.fleet:
+        # the fleet front door (ISSUE 13): N replicas, one router —
+        # run_open_loop drives it unchanged (same submit() shape)
+        from raft_tpu import obs
+        router, q, build_server = _build_fleet(
+            args.n, args.dim, args.n_lists, args.k, ladder,
+            args.deadline_ms, args.fleet, chaos=bool(chaos_events))
+        stop = threading.Event()
+        chaos_t = (run_chaos_schedule(chaos_events, stop,
+                                      router=router,
+                                      revive_fn=build_server)
+                   if chaos_events else None)
+        before = obs.snapshot()
+        try:
+            report = run_open_loop(
+                router, q, rate_qps=args.rate,
+                duration_s=args.duration, nq=args.nq,
+                deadline_ms=args.deadline_ms or None, seed=args.seed)
+        finally:
+            stop.set()
+            if chaos_t is not None:
+                chaos_t.join(timeout=10.0)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        cnt = diff.get("counters", {})
+        report["fleet"] = {
+            "replicas": args.fleet,
+            "route_share": fleet_route_share(cnt),
+            "retries": int(sum(
+                v for k_, v in cnt.items()
+                if k_.startswith("raft.fleet.retry.total"))),
+            "unroutable": int(sum(
+                v for k_, v in cnt.items()
+                if k_.startswith("raft.fleet.unroutable.total"))),
+            "serving_at_end": obs.snapshot()["gauges"].get(
+                "raft.fleet.replicas.serving", 0.0),
+        }
+        if chaos_events:
+            report["chaos"] = {"schedule": args.chaos}
+        print(json.dumps(report), flush=True)
+        router.close()
+        return 0
     srv, q, mindex = _build_demo_server(
         args.n, args.dim, args.n_lists, args.k, ladder,
         args.deadline_ms, server=args.server,

@@ -103,6 +103,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# replica fleet serving (ISSUE 13): the sequenced WAL + positioned
+# reader's rewrite-resume semantics, batcher drain, replica lifecycle,
+# p2c routing / suspect exclusion / deadline-aware re-route, the
+# bootstrap-from-snapshot+tail parity (incl. through a checkpointed
+# compaction), and the zero-failed-requests rolling restart.
+echo "precommit: replica fleet tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # distributed serving tier (ISSUE 8): the int8 merge codec round-trip
 # + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
 # mesh, pad-row non-leakage through the distributed scatter, and the

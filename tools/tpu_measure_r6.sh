@@ -20,6 +20,10 @@
 #   q0   quality row (ISSUE 11): live shadow-exact recall estimate vs
 #        the offline recall at the same operating point (gap ≤ 0.05),
 #        zero steady-state compiles with sampling active
+#   fl0  fleet row (ISSUE 13): aggregate QPS at 1/2/4 replicas behind
+#        the power-of-two-choices front door, availability through a
+#        full replica kill, one rolling restart under load — first
+#        hardware row of the millions-of-users layer
 #   h1   headline bench (driver format) so the round has fresh
 #        single-device context for the dist comparison
 #   g0   full gated suite (PERF/RECALL/GAP gates end-to-end on TPU)
@@ -90,6 +94,15 @@ q0() {  # quality-observability row (ISSUE 11): live vs offline recall
   cp -f "$OUT/quality_r6.log" docs/measurements/
 }
 
+fl0() {  # fleet row (ISSUE 13): replica scaling + kill availability +
+         # rolling restart. NB: single-process replicas share the
+         # chip(s) — the scaling figure is the shared-device lower
+         # bound; one-replica-per-host is the deployment shape
+  BENCH_FLEET_N=500000 BENCH_FLEET_SECONDS=4 \
+    python bench_suite.py fleet 2>&1 | tee "$OUT/fleet_r6.log"
+  cp -f "$OUT/fleet_r6.log" docs/measurements/
+}
+
 h1() {  # headline bench rows (driver format, embedded measured_at)
   python bench.py 2>&1 | tee "$OUT/headline_r6.log"
   cp -f "$OUT/headline_r6.log" docs/measurements/
@@ -105,6 +118,7 @@ run ds1 ds1
 run mu0 mu0
 run ch0 ch0
 run q0 q0
+run fl0 fl0
 run h1 h1
 run g0 g0
 echo "[$(stamp)] == r6 campaign complete"
