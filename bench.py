@@ -257,6 +257,11 @@ def child_main():
                 # round reports it per family directly
                 if "marginal_gap" in r:
                     out[f"{fam}_marginal_gap"] = r["marginal_gap"]
+                # resource-utilization keys (ISSUE 14): measured duty
+                # cycle + peak device memory at this operating point
+                if r.get("device_util") is not None:
+                    out[f"{fam}_device_util"] = r["device_util"]
+                    out[f"{fam}_hbm_peak_mb"] = r["hbm_peak_mb"]
                 out[f"{fam}_recall"] = r.get("recall")
                 if "recall_estimator" in r:  # pq: rescored headline +
                     out[f"{fam}_recall_estimator"] = \
@@ -302,6 +307,9 @@ def child_main():
                     out["serve_steady_state_compiles"] = \
                         r["steady_state_compiles"]
                     out["serve_recall"] = r.get("recall")
+                    if r.get("device_util") is not None:
+                        out["serve_device_util"] = r["device_util"]
+                        out["serve_hbm_peak_mb"] = r["hbm_peak_mb"]
                 elif "error" in r:
                     out.setdefault("serve_error", r["error"])
         except Exception as e:
@@ -330,6 +338,9 @@ def child_main():
                     out["dist_recall"] = r.get("recall")
                     out["dist_recall_f32_merge"] = \
                         r.get("recall_f32_merge")
+                    if r.get("device_util") is not None:
+                        out["dist_device_util"] = r["device_util"]
+                        out["dist_hbm_peak_mb"] = r["hbm_peak_mb"]
                 elif "p99_under_2x_watermark" in r:
                     out["dist_overload_p99_ms"] = r["dist_p99_ms"]
                     out["dist_overload_p99_bounded"] = \
@@ -444,6 +455,11 @@ def child_main():
                     out["fleet_rolling_ok"] = r["fleet_rolling_ok"]
                     out["fleet_rolling_failed_requests"] = \
                         r["fleet_rolling_failed_requests"]
+                    if r.get("device_util") is not None:
+                        out["fleet_device_util"] = r["device_util"]
+                        out["fleet_hbm_peak_mb"] = r["hbm_peak_mb"]
+                        out["fleet_duty_cycle_per_replica"] = \
+                            r.get("fleet_duty_cycle_per_replica")
                 elif "error" in r:
                     out.setdefault("fleet_error", r["error"])
         except Exception as e:

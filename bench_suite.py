@@ -187,6 +187,44 @@ def _cached_cap(index, nq: int, n_probes: int) -> int:
     from raft_tpu.ops.dispatch import pallas_enabled
     return index.cap_cache[(nq, n_probes, pallas_enabled())]
 
+def _resource_utilization(dispatch_fn, seconds=0.5, extra_fn=None):
+    """Resource-utilization keys for a bench row (ISSUE 14): run
+    blocked dispatches in a tight loop for ``seconds`` under the
+    resource profiler at sample rate 1.0 and read back the measured
+    duty cycle (``device_util`` — the fraction of wall the device was
+    actually executing at this operating point; the rest is host
+    dispatch/glue) and the peak device memory the pass saw
+    (``hbm_peak_mb``; the live-arrays approximation on CPU). The pass
+    runs AFTER the row's timed measurements so the profiled loop never
+    perturbs the headline figures."""
+    from raft_tpu.obs import profiler
+    profiler.enable_profiling(
+        1.0, profiler.ProfilerConfig(hbm_poll_ms=100.0,
+                                     window_s=max(4 * seconds, 5.0)))
+    try:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            dispatch_fn()
+        time.sleep(0.15)        # let >= 1 HBM sample land
+        rep = profiler.report()
+        hbm_peak = max((dev.get("peak_bytes", 0) or 0
+                        for dev in rep["hbm"].values()), default=0)
+        out = {
+            "device_util": rep["duty_cycle"],
+            "hbm_peak_mb": round(hbm_peak / 2 ** 20, 2),
+        }
+        if extra_fn is not None:
+            # caller-side keys that must be read WHILE the profiler is
+            # still attached (e.g. the fleet's per-replica fold)
+            out.update(extra_fn())
+        return out
+    except Exception as e:      # a profiling hiccup must not void a row
+        return {"device_util": None, "hbm_peak_mb": None,
+                "profile_error": repr(e)[:120]}
+    finally:
+        profiler.disable_profiling()
+
+
 def _ann_dataset(n, d, nq, seed=5):
     """Semi-hard clustered ANN bench distribution: a gaussian mixture
     with unit-scale centers AND unit cluster noise (~125 rows/cluster),
@@ -305,6 +343,8 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=None,
     from raft_tpu.neighbors import plan as _plan
     pl = _plan.warmup(index, q, k, sp)
     t_plan = _time(lambda: pl.search(q), reps=3)
+    # resource-utilization pass (ISSUE 14): AFTER the timed figures
+    util = _resource_utilization(lambda: pl.search(q, block=True))
     results.append({
         "metric": (label or
                    f"ivf_flat_search_{n//1000}kx{d}_q{nq}_k{k}"
@@ -321,7 +361,8 @@ def bench_ivf_flat(results, n=500_000, nlists=1024, n_probes=None,
         "marginal_gap": round(t_plan / t_marg, 3),
         "fixed_cost_ms": round((t - t_marg) * 1e3, 3),
         "build_s": round(t_build, 2),
-        "build_warm_s": round(t_build_warm, 2)})
+        "build_warm_s": round(t_build_warm, 2),
+        **util})
 
 
 def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
@@ -402,6 +443,8 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
     from raft_tpu.neighbors import plan as _plan
     pl = _plan.warmup(index, q, k, sp)
     t_plan = _time(lambda: pl.search(q), reps=3)
+    # resource-utilization pass (ISSUE 14): AFTER the timed figures
+    util = _resource_utilization(lambda: pl.search(q, block=True))
     results.append({
         "metric": (label or
                    f"ivf_pq_search_{n//1000}kx{d}_q{nq}_k{k}"
@@ -418,7 +461,8 @@ def bench_ivf_pq(results, n=500_000, nlists=1024, n_probes=None,
         "plan_qps": round(nq / t_plan, 1),
         "marginal_gap": round(t_plan / t_marg, 3),  # see bench_ivf_flat
         "fixed_cost_ms": round((t - t_marg) * 1e3, 3),
-        "build_s": round(t_build, 2)})
+        "build_s": round(t_build, 2),
+        **util})
 
 
 def bench_ivf_pq4(results, n=500_000, nlists=1024, n_probes=None):
@@ -707,6 +751,11 @@ def bench_serve(results, n=500_000, nlists=1024, n_probes=None):
             return lats[min(len(lats) - 1,
                             int(p / 100 * (len(lats) - 1)))] * 1e3
 
+        # resource-utilization pass (ISSUE 14): the batcher's sampled
+        # dispatches split host vs device — was this point host- or
+        # device-bound?
+        util = _resource_utilization(
+            lambda: server.search(q_np[:1]))
         results.append({
             "metric": f"serve_closed_loop_{n//1000}kx{d}_q1_k{k}"
                       f"_p{n_probes}_qps",
@@ -722,7 +771,8 @@ def bench_serve(results, n=500_000, nlists=1024, n_probes=None):
             "steady_state_compiles": int(compiles),
             "clients": clients,
             "recall": round(rec_serve, 4),
-            "recall_per_request": round(rec_plan, 4)})
+            "recall_per_request": round(rec_plan, 4),
+            **util})
 
         # open-loop row: Poisson arrivals at ~70% of the closed-loop
         # rate (sub-saturation — queue delay, not collapse)
@@ -872,6 +922,8 @@ def bench_serve_sharded(results, n=None, nlists=1024, n_probes=None):
                     + csum("raft.plan.build.total"))
         bpre = csum("raft.serve.dist.merge.bytes_pre")
         bpost = csum("raft.serve.dist.merge.bytes_post")
+        # resource-utilization pass (ISSUE 14): mesh-wide dispatches
+        util = _resource_utilization(lambda: dist.search(q_np[:1]))
         results.append({
             "metric": metric,
             "value": round(dist_qps, 1), "unit": "queries/s",
@@ -887,7 +939,8 @@ def bench_serve_sharded(results, n=None, nlists=1024, n_probes=None):
             "n_shards": n_shards,
             "clients": clients,
             "recall": round(rec_dist, 4),
-            "recall_f32_merge": round(rec_f32, 4)})
+            "recall_f32_merge": round(rec_f32, 4),
+            **util})
 
         # overload row: open-loop Poisson at 2x the measured closed-
         # loop rate — bounded p99 via the inherited degradation ladder
@@ -1569,6 +1622,15 @@ def bench_fleet(results, n=None, nlists=64):
         roll_failed = (rep_roll["shed"] + rep_roll["errors"]
                        + rep_roll["deadline_expired"])
 
+        # resource-utilization pass (ISSUE 14): dispatches through the
+        # router land in per-replica profiler tags — the report folds
+        # measured utilization next to the p2c routing signal
+        util = _resource_utilization(
+            lambda: router.search(q_np[:1], timeout=60.0),
+            extra_fn=lambda: {"fleet_duty_cycle_per_replica": {
+                row["name"]: row.get("duty_cycle")
+                for row in router.report()["replicas"]}})
+
         results.append({
             "metric": metric,
             "value": round(qps[4], 1), "unit": "qps_x4",
@@ -1592,7 +1654,8 @@ def bench_fleet(results, n=None, nlists=64):
             "fleet_rolling_failed_requests": int(roll_failed),
             "fleet_rolling_availability": rep_roll["availability"],
             "offered_qps": rep["offered_qps"],
-            "n_probes": n_probes})
+            "n_probes": n_probes,
+            **util})
     except Exception as e:
         results.append({"metric": metric, "error": repr(e)[:200]})
     finally:

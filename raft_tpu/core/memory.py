@@ -10,6 +10,7 @@ in-place RMM workflow.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
 
 import jax
@@ -26,6 +27,52 @@ def memory_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:
     except Exception:
         return {}
     return dict(stats or {})
+
+
+def hbm_stats(device: Optional[jax.Device] = None) -> Dict[str, int]:
+    """Normalized allocator stats for one device — the resource
+    profiler's sampling contract (``raft_tpu.obs.profiler``):
+    ``{"bytes_in_use", "peak_bytes_in_use", "bytes_limit", "source"}``.
+
+    On backends whose PJRT allocator reports stats (TPU/GPU) this is
+    :func:`memory_stats` with the keys normalized (``source:
+    "pjrt"``). On backends without them (CPU) it falls back to
+    summing the live jax arrays resident on the device against
+    physical RAM (``source: "live_arrays"`` — an approximation good
+    for trend lines and smoke tests, not capacity planning; peak
+    tracking is the caller's job there, the fallback has no history).
+    Empty dict when nothing can be measured."""
+    dev = device or jax.devices()[0]
+    stats = memory_stats(dev)
+    if stats.get("bytes_in_use") is not None and stats:
+        return {
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use",
+                                               0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0)),
+            "source": "pjrt",
+        }
+    live = getattr(jax, "live_arrays", None)
+    if live is None:
+        return {}
+    in_use = 0
+    for arr in live():
+        try:
+            if dev in arr.devices():
+                in_use += int(arr.nbytes)
+        except Exception:
+            continue
+    try:
+        limit = (os.sysconf("SC_PHYS_PAGES")
+                 * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):
+        limit = 0
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": in_use,
+        "bytes_limit": int(limit),
+        "source": "live_arrays",
+    }
 
 
 def donate(fn, *donate_argnums: int):

@@ -98,8 +98,18 @@ class Replica:
         self._state = (state if state is not None else
                        (ReplicaState.SERVING if server is not None
                         else ReplicaState.BOOTSTRAPPING))
+        self._tag_server(server)
         obs.gauge("raft.fleet.replica.state",
                   replica=self.name).set(self._state.code)
+
+    def _tag_server(self, server) -> None:
+        """Name the wrapped server's sampled dispatches after this
+        replica in the resource profiler (ISSUE 14) — the per-replica
+        utilization the router folds into ``report()``. Duck-typed:
+        test fakes without the batcher API are left alone."""
+        tag = getattr(server, "set_profile_tag", None)
+        if tag is not None:
+            tag(self.name)
 
     # -- introspection -----------------------------------------------------
     @property
@@ -126,6 +136,7 @@ class Replica:
             self._server = server
             if replicator is not None or server is None:
                 self._replicator = replicator
+        self._tag_server(server)
         return self
 
     # -- lifecycle ---------------------------------------------------------

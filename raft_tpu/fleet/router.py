@@ -338,7 +338,14 @@ class FleetRouter:
     # -- surfaces ----------------------------------------------------------
     def report(self) -> dict:
         """Structured fleet snapshot for ``/debug/fleet``: per-replica
-        state + load + route share, the suspect set, the config."""
+        state + load + route share — and, while the resource profiler
+        is attached (ISSUE 14), per-replica MEASURED utilization
+        (sampled device duty cycle over the profiler window) next to
+        the p2c load signal routing actually used, so "we routed there
+        because its queue was short" and "its chip was busy" are
+        finally comparable side by side — plus the suspect set and the
+        config."""
+        from raft_tpu.obs import profiler
         reps = self.replicas
         snap = obs.snapshot()["counters"]
         routes = {}
@@ -347,18 +354,31 @@ class FleetRouter:
                 name = key.split("replica=")[1].rstrip("}").split(",")[0]
                 routes[name] = routes.get(name, 0) + int(v)
         total = max(1, sum(routes.values()))
-        return {
-            "replicas": [dict(r.describe(),
-                              routed=routes.get(r.name, 0),
-                              route_share=round(
-                                  routes.get(r.name, 0) / total, 4))
-                         for r in reps],
+        profiling = profiler.state() is not None
+        replicas = []
+        for r in reps:
+            row = dict(r.describe(), routed=routes.get(r.name, 0),
+                       route_share=round(
+                           routes.get(r.name, 0) / total, 4))
+            if profiling:
+                dc = profiler.duty_cycle(tag=r.name)
+                row["duty_cycle"] = (round(dc, 6)
+                                     if dc is not None else None)
+            replicas.append(row)
+        body = {
+            "replicas": replicas,
             "serving": sum(1 for r in reps
                            if r.state is ReplicaState.SERVING),
             "suspects": list(self.suspects()),
             "config": {"max_retries": self._cfg.max_retries,
                        "suspect_ms": self._cfg.suspect_ms},
         }
+        if profiling:
+            body["utilization"] = {
+                "duty_cycle": round(profiler.duty_cycle() or 0.0, 6),
+                "sample_rate": profiler.profile_sample_rate(),
+            }
+        return body
 
     def close(self, drain_timeout_s: float = 10.0) -> None:
         """Stop the whole fleet: drain-then-close every replica (the

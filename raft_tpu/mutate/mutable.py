@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
@@ -46,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu import obs
+from raft_tpu.obs import profiler
 from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.mutate import compact as compact_mod
@@ -397,11 +399,21 @@ class MutableIndex:
         if q.ndim == 1:
             q = q[None, :]
         nq = q.shape[0]
+        # resource profiler admission (one None read when off): a
+        # sampled blocking call splits host-enqueue vs device-wait
+        # around the sync it was paying anyway
+        prof = block and profiler.sampled()
+        t0 = time.perf_counter()
         entry, dev = self._entry_for(nq, rung_idx, q)
         d, i = entry.run(jnp.asarray(q), dev.delta_data,
                          dev.delta_norms, dev.delta_ids, dev.tomb)
         if block:
-            jax.block_until_ready((d, i))
+            if prof:
+                profiler.record_dispatch(
+                    t0, time.perf_counter(), (d, i), program="mutate",
+                    family=self.family, rung=rung_idx)
+            else:
+                jax.block_until_ready((d, i))
         return d, i
 
     def _entry_for(self, nq: int, rung_idx: int, rep_q):

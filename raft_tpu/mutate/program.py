@@ -28,6 +28,7 @@ invalid/dead slots sit at the convention's worst value.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -35,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu import obs
+from raft_tpu.obs import profiler
 from raft_tpu.core.error import expects
 from raft_tpu.core.precision import matmul_precision
 from raft_tpu.distance.distance_types import DistanceType
@@ -195,9 +197,12 @@ def compile_mutate_program(index, rep_queries, nq: int, k: int, params,
         q_struct = jax.ShapeDtypeStruct((nq, index.dim), jnp.float32)
         # plan-cache idiom: compiled ONCE per (epoch, nq, rung) key and
         # cached on the epoch — the fresh callable never re-traces
+        t_c0 = time.perf_counter()
         executable = jax.jit(fused).lower(  # graftlint: disable=GL002
             q_struct, *operands,
             *_delta_structs(delta_cap, index.dim, tomb_words)).compile()
+        # compile-time ledger (resource profiler): idle-chip seconds
+        profiler.note_compile("mutate", time.perf_counter() - t_c0)
     return MutateExecutable(executable, operands, nq, k, n_probes, cap,
                             delta_cap, tomb_words)
 
@@ -244,9 +249,11 @@ def compile_tail_program(nq: int, k: int, dim: int, metric,
 
     # plan-cache idiom: compiled ONCE per (epoch, nq, delta-rung) key
     # and cached on the epoch — the fresh callable never re-traces
+    t_c0 = time.perf_counter()
     executable = jax.jit(tail).lower(  # graftlint: disable=GL002
         jax.ShapeDtypeStruct((nq, dim), jnp.float32),
         jax.ShapeDtypeStruct((nq, k_main), d_dtype),
         jax.ShapeDtypeStruct((nq, k_main), i_dtype),
         *_delta_structs(delta_cap, dim, tomb_words)).compile()
+    profiler.note_compile("mutate", time.perf_counter() - t_c0)
     return TailExecutable(executable, nq, k, delta_cap, tomb_words)

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu import obs
-from raft_tpu.obs import spans
+from raft_tpu.obs import profiler, spans
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
@@ -126,6 +126,12 @@ class SearchPlan:
         unless ``block``; donation-compiled plans consume the query
         buffer, so a defensive device copy is made when the caller's
         array would otherwise be invalidated."""
+        # resource profiler admission (one None read when off): a
+        # sampled BLOCKING call is split into host work (everything up
+        # to enqueue-complete, conversions and spans included) vs the
+        # device wait — around the sync it was paying anyway
+        prof = block and profiler.sampled()
+        t_call = time.perf_counter()
         q = as_array(queries).astype(jnp.float32)
         expects(q.shape == (self.nq, self.dim),
                 "plan.search: queries %s != plan shape (%d, %d) — build "
@@ -141,8 +147,18 @@ class SearchPlan:
                 q = jnp.array(q, copy=True)  # caller keeps their buffer
             t0 = time.perf_counter()
             d, i = self._run(q)
+            t_enq = t_ready = 0.0
             if block:
+                if prof:
+                    t_enq = time.perf_counter()
                 jax.block_until_ready((d, i))
+                if prof:
+                    t_ready = time.perf_counter()
+                    spans.add_child_span(
+                        profiler.SYNC_SPAN, t_enq, t_ready - t_enq,
+                        program="plan",
+                        host_ms=round((t_enq - t_call) * 1e3, 3),
+                        device_ms=round((t_ready - t_enq) * 1e3, 3))
             # per-stage breakdown of the fused program (attributed —
             # host walls only exist for the whole executable; under
             # async dispatch this is enqueue time unless `block`)
@@ -150,6 +166,15 @@ class SearchPlan:
                 self._stages(), time.perf_counter() - t0,
                 family=self.family, compiled=True)
             sp.set_attr("plan_key", repr(self.key))
+        if prof and block:
+            # the span/trace epilogue above is host work too: charge
+            # everything outside the device wait to the host half, so
+            # host_s + device_s ≈ this call's whole wall
+            profiler.record_sample(
+                program="plan", family=self.family, rung=self.n_probes,
+                host_s=(t_enq - t_call)
+                + (time.perf_counter() - t_ready),
+                device_s=t_ready - t_enq)
         return d, i
 
     def _stages(self):
@@ -554,7 +579,11 @@ def build_plan(index, queries, k: int, params=None,
         donate = _donate_ok()
         jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
         q_struct = jax.ShapeDtypeStruct((nq, index.dim), jnp.float32)
+        t_c0 = time.perf_counter()
         executable = jitted.lower(q_struct, *operands).compile()
+        # compile-time ledger (resource profiler): the seconds the
+        # chip sat idle while the host built this program
+        profiler.note_compile("plan", time.perf_counter() - t_c0)
         plan = SearchPlan(family=family, key=key, nq=nq, dim=index.dim,
                           k=k, n_probes=n_probes, cap=cap,
                           metric=index.metric, _executable=executable,

@@ -17,6 +17,12 @@ three planes sharing ONE ``raft.<module>.<op>`` naming taxonomy:
   ``/healthz`` (comms health gauges) and ``/debug/requests`` (the
   recorder).
 
+Two further planes ride the same taxonomy and load lazily:
+:mod:`raft_tpu.obs.quality` (shadow-exact recall, ISSUE 11) and
+:mod:`raft_tpu.obs.profiler` (sampled device-time attribution, duty
+cycle, HBM accounting — ISSUE 14; ``RAFT_TPU_PROFILE_SAMPLE``,
+``/debug/profile``).
+
 Quick use::
 
     from raft_tpu import obs

@@ -30,6 +30,13 @@ needs inspectable:
   (``obs.serve(fleet=router)``), else the exported ``raft.fleet.*``
   gauges. ``/healthz`` degrades while any replica is out of the
   serving set.
+* ``GET /debug/profile`` — the resource profiler
+  (:mod:`raft_tpu.obs.profiler`): duty cycles, per-program device/host
+  splits, the top device-time programs, the compile ledger, and the
+  per-device HBM table — from the in-process profiler when one is
+  attached, else the exported ``raft.obs.profile.*`` gauges.
+  ``/healthz`` degrades while any device's HBM headroom sits below the
+  profiler's ``hbm_headroom_frac`` guardrail.
 * ``GET /debug/slo`` — the declarative SLO verdict
   (:mod:`raft_tpu.obs.slo`): every objective's per-window burn rates
   and breach flags, from the in-process :class:`~raft_tpu.obs.slo.
@@ -161,6 +168,27 @@ def _health_body(snapshot: dict) -> dict:
                 "engaged": failover_engaged,
                 "coverage": _gsum("raft.serve.failover.coverage"),
             }
+    # resource plane (ISSUE 14): a device whose HBM headroom fell
+    # below the profiler's configured fraction trips low_headroom —
+    # the next allocation (a compaction, a cold-list fetch, a bigger
+    # batch shape) may OOM, so this box must stop reporting healthy
+    # BEFORE that happens, exactly like the stalled-delta guardrail
+    hbm_low = _gsum("raft.obs.profile.hbm.low_headroom")
+    if hbm_low > 0:
+        body["status"] = "degraded"
+    duty = {k: v for k, v in gauges.items()
+            if k.split("{")[0] == "raft.obs.profile.duty_cycle"}
+    if duty or hbm_low:
+        # informational: duty cycle being low is context (diagnose via
+        # /debug/profile), only the HBM guardrail is a verdict
+        body["profile"] = {
+            "duty_cycle": duty,
+            "hbm_low_headroom": hbm_low,
+            "hbm_headroom_frac": {
+                k: v for k, v in gauges.items()
+                if k.split("{")[0]
+                == "raft.obs.profile.hbm.headroom_frac"},
+        }
     # fleet tier (ISSUE 13): a registered replica fleet degrades the
     # verdict while any replica is out of the serving set (draining /
     # bootstrapping / down — a fleet at partial capacity must say so,
@@ -240,12 +268,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, body)
             elif path == "/debug/fleet":
                 self._debug_fleet()
+            elif path == "/debug/profile":
+                # lazy import: profiler pulls spans/jax — keep the
+                # endpoint importable without it resolved
+                from raft_tpu.obs import profiler as _profiler
+                body = _profiler.endpoint_body(self.server.registry
+                                               .snapshot())
+                self._send_json(200, body)
             else:
                 self._send_json(404, {"error": f"no route {path!r}",
                                       "routes": ["/metrics", "/healthz",
                                                  "/debug/requests",
                                                  "/debug/slo",
-                                                 "/debug/fleet"]})
+                                                 "/debug/fleet",
+                                                 "/debug/profile"]})
         except BrokenPipeError:
             pass
 

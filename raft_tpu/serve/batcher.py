@@ -63,7 +63,7 @@ import numpy as np
 from raft_tpu import obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.logger import get_logger
-from raft_tpu.obs import spans
+from raft_tpu.obs import profiler, spans
 from raft_tpu.serve.controller import LoadController
 from raft_tpu.serve.ladder import PlanLadder
 from raft_tpu.serve.types import (DeadlineExceeded, DispatchError,
@@ -160,6 +160,11 @@ class SearchServer:
         self._quality = None
         self._quality_src = None
         self._quality_meta: dict = {}
+        # resource profiler attribution tag (ISSUE 14): the fleet tier
+        # names its replicas here so sampled device time folds into
+        # router.report() per replica; dispatcher-thread-only read,
+        # single plain-attr write at attach — no lock needed
+        self._profile_tag = "server"
         obs.gauge("raft.serve.queue.max").set(self._cfg.max_queue)
         obs.gauge("raft.serve.queue.depth").set(0)
         obs.gauge("raft.serve.shed.rate").set(0.0)
@@ -307,6 +312,13 @@ class SearchServer:
             src.add_epoch_listener(monitor.note_epoch)
         self._quality = monitor
         return monitor
+
+    def set_profile_tag(self, tag: str) -> None:
+        """Name this server's sampled dispatches in the resource
+        profiler's per-tag ledger (``raft_tpu.obs.profiler`` —
+        :class:`~raft_tpu.fleet.Replica` passes its replica name so
+        fleet utilization is attributable per replica)."""
+        self._profile_tag = str(tag)
 
     def _quality_epoch(self) -> int:
         src = self._quality_src
@@ -603,6 +615,10 @@ class SearchServer:
 
     def _execute(self, batch, rows: int, depth: int) -> None:
         cfg = self._cfg
+        # profiler attribution: tag this dispatcher thread so a sampled
+        # dispatch inside plan.search lands in this server's (replica's)
+        # per-tag window — one None read when profiling is off
+        profiler.tag_dispatch(self._profile_tag)
         t_start = time.perf_counter()
         head_wait = t_start - min(r.t_enq for r in batch)
         level = self._controller.observe(head_wait, depth)

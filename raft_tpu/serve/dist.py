@@ -39,7 +39,7 @@ import numpy as np
 from raft_tpu import obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.logger import get_logger
-from raft_tpu.obs import spans
+from raft_tpu.obs import profiler, spans
 from raft_tpu.serve.batcher import SearchServer
 from raft_tpu.serve.ladder import PlanLadder
 from raft_tpu.serve.merge import merge_mode, merge_wire_bytes
@@ -142,6 +142,11 @@ class DistSearchPlan:
         # scans its own lists for all nq rows (cardinality = mesh size)
         obs.counter("raft.serve.dist.shard.rows").inc(
             self.nq * self.n_shards)
+        # resource profiler admission (one None read when off): a
+        # sampled blocking dispatch splits host-enqueue vs device-wait
+        # around the sync it was paying anyway
+        prof = block and profiler.sampled()
+        t0 = time.perf_counter()
         with spans.span("raft.serve.dist.dispatch", family=self.family,
                         nq=self.nq, k=self.k, n_probes=self.n_probes,
                         n_shards=self.n_shards, merge=self.merge,
@@ -156,6 +161,7 @@ class DistSearchPlan:
                     self._index, q, self.k, self._params,
                     mesh=self.mesh, axis=self.axis, comms=self._comms,
                     merge=self.merge)
+        t_enq = time.perf_counter()
         if block:
             if self._sync_timeout_s:
                 # comms-layer completion wait with failure semantics:
@@ -169,6 +175,16 @@ class DistSearchPlan:
                     raise ShardFailedError(
                         f"cross-shard dispatch reported "
                         f"{getattr(st, 'name', st)}", ranks=self.ranks)
+                if prof:
+                    # sync_stream already blocked — result=None means
+                    # "stamp now", no second sync
+                    profiler.record_dispatch(
+                        t0, t_enq, None, program="dist",
+                        family=self.family, rung=self.level)
+            elif prof:
+                profiler.record_dispatch(
+                    t0, t_enq, (d, i), program="dist",
+                    family=self.family, rung=self.level)
             else:
                 import jax
                 jax.block_until_ready((d, i))

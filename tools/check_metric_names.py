@@ -177,6 +177,20 @@ REQUIRED_NAMES = (
     "raft.fleet.replication.lag_records",
     "raft.fleet.replication.lag_seconds",
     "raft.fleet.rolling.total",
+    # resource observability (ISSUE 14): the sampled device/host split
+    # counters, the duty-cycle gauge every "is the chip busy" consumer
+    # reads, the HBM table + the low-headroom guardrail /healthz
+    # degrades on, and the compile-time ledger
+    "raft.obs.profile.samples.total",
+    "raft.obs.profile.device.seconds",
+    "raft.obs.profile.host.seconds",
+    "raft.obs.profile.duty_cycle",
+    "raft.obs.profile.hbm.bytes_in_use",
+    "raft.obs.profile.hbm.peak_bytes",
+    "raft.obs.profile.hbm.limit_bytes",
+    "raft.obs.profile.hbm.headroom_frac",
+    "raft.obs.profile.hbm.low_headroom",
+    "raft.obs.profile.compile.seconds",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -224,6 +238,10 @@ REQUIRED_SPAN_NAMES = (
     # request names which replica answered it and how many re-routes
     # it took
     "raft.fleet.route",
+    # resource observability (ISSUE 14): the profiler's sampled-sync
+    # child span — a MEASURED device/host split under the request
+    # (attributed=False, unlike the raft.plan.stage.* estimates)
+    "raft.obs.profile.sync",
 )
 
 

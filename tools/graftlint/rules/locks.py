@@ -126,10 +126,13 @@ class LockDiscipline(Rule):
                    "ISSUE 9 mutate dispatcher/compactor boundary, and "
                    "the ISSUE 11 shadow/SLO threads)")
     # the threaded modules that postdate PR 6 are scoped explicitly:
-    # quality's shadow thread, the SLO poller, the chaos harness, and
-    # the fleet tier (router callbacks + replicator thread, ISSUE 13)
+    # quality's shadow thread, the SLO poller, the chaos harness, the
+    # fleet tier (router callbacks + replicator thread, ISSUE 13), and
+    # the resource profiler (dispatcher threads + HBM sampler thread
+    # share the ledger, ISSUE 14)
     paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate",
              "raft_tpu/obs/quality.py", "raft_tpu/obs/slo.py",
+             "raft_tpu/obs/profiler.py",
              "raft_tpu/testing/faults.py", "raft_tpu/fleet")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

@@ -398,6 +398,34 @@ def _build_fleet(n: int, dim: int, n_lists: int, k: int,
     return router, q, build_server
 
 
+def profile_report(router=None) -> Optional[dict]:
+    """Resource-observability columns for a loadgen report (ISSUE 14):
+    the measured duty cycle and peak device memory of the run — the
+    columns that say whether shed traffic was a HOST bottleneck (low
+    duty cycle: the chip sat idle while the queue grew) or a DEVICE
+    one (duty cycle ~1: the chip itself was the wall). With a fleet
+    ``router``, adds the per-replica duty-cycle fold. None when the
+    profiler is not attached (``--profile-sample 0``)."""
+    from raft_tpu.obs import profiler
+    rep = profiler.report()
+    if not rep.get("enabled"):
+        return None
+    hbm_peak = max((d.get("peak_bytes", 0) or 0
+                    for d in rep["hbm"].values()), default=0)
+    out = {
+        "duty_cycle": rep["duty_cycle"],
+        "hbm_peak_mb": round(hbm_peak / 2 ** 20, 2),
+        "device_s": rep["device_s"],
+        "host_s": rep["host_s"],
+        "sample_rate": rep["rate"],
+    }
+    if router is not None:
+        out["per_replica"] = {
+            row["name"]: row.get("duty_cycle")
+            for row in router.report()["replicas"]}
+    return out
+
+
 def fleet_route_share(counters_diff: dict) -> dict:
     """Per-replica route share out of a counters diff (the
     ``raft.fleet.route.total{replica=...}`` series)."""
@@ -465,6 +493,13 @@ def main(argv=None) -> int:
                          "an exact scorer off the serving path and the "
                          "report gains a live_recall column (default: "
                          "0, or 0.25 under --demo)")
+    ap.add_argument("--profile-sample", type=float, default=None,
+                    help="resource-profiler sampling rate in [0, 1] "
+                         "(ISSUE 14): sampled dispatches split host vs "
+                         "device time and the report gains duty_cycle/"
+                         "hbm_peak_mb columns — incl. per-replica rows "
+                         "under --fleet (default: 0, or 0.25 under "
+                         "--demo)")
     ap.add_argument("--demo", action="store_true",
                     help="overload demo: offer 2x the calibrated "
                          "sustainable rate and show the ladder holding "
@@ -514,6 +549,11 @@ def main(argv=None) -> int:
     ladder = tuple(int(s) for s in args.probes_ladder.split(","))
     quality_sample = (args.quality_sample if args.quality_sample
                       is not None else (0.25 if args.demo else 0.0))
+    profile_sample = (args.profile_sample if args.profile_sample
+                      is not None else (0.25 if args.demo else 0.0))
+    if profile_sample > 0:
+        from raft_tpu.obs import profiler
+        profiler.enable_profiling(profile_sample)
     if args.fleet:
         # the fleet front door (ISSUE 13): N replicas, one router —
         # run_open_loop drives it unchanged (same submit() shape)
@@ -552,6 +592,9 @@ def main(argv=None) -> int:
         }
         if chaos_events:
             report["chaos"] = {"schedule": args.chaos}
+        prof = profile_report(router)
+        if prof is not None:
+            report["profile"] = prof
         print(json.dumps(report), flush=True)
         router.close()
         return 0
@@ -614,6 +657,10 @@ def main(argv=None) -> int:
                 # the p99 it bought (ISSUE 8 satellite)
                 report["merge_bytes_per_rung"] = merge_bytes_by_rung(
                     report["serve_metrics"])
+            prof = profile_report()
+            if prof is not None:
+                # host- vs device-bound: the overload verdict's cause
+                report["profile"] = prof
             print(json.dumps(report), flush=True)
             # drain: the ladder must step back up once load stops
             t0 = time.perf_counter()
@@ -654,6 +701,9 @@ def main(argv=None) -> int:
                     "compactor_failing_at_end": g.get(
                         "raft.mutate.compactor.failing", 0.0),
                 }
+            prof = profile_report()
+            if prof is not None:
+                report["profile"] = prof
             print(json.dumps(report), flush=True)
     finally:
         if slo_tracker is not None:

@@ -4,9 +4,14 @@ The ISSUE 3 acceptance gate: `bench_suite` `fixed_cost_ms`/`plan_qps`
 for the flat row must regress < 5% with the recorder enabled. This
 tool measures exactly those two figures (the flat row's own
 methodology — warm per-call wall, chained in-jit marginal, warm AOT
-plan per-call wall) twice in one process: tracing OFF
-(`obs.set_trace_enabled(False)`) and tracing ON (spans + flight
-recorder, the shipped default), and writes the comparison to
+plan per-call wall) in one process: tracing OFF
+(`obs.set_trace_enabled(False)`), tracing ON (spans + flight
+recorder, the shipped default), and — ISSUE 14 — PROFILING ON on top
+(the continuous resource profiler attached at its default
+``RAFT_TPU_PROFILE_SAMPLE`` rate; the per-dispatch marginal it adds is
+one Bernoulli draw on the blocking path, gated < 5% too, measured on
+a BLOCKED plan call since the profiler only arms around a sync the
+caller was paying anyway). Writes the comparison to
 ``docs/measurements/trace_overhead_<platform>.json``.
 
 Method notes:
@@ -92,26 +97,49 @@ def measure():
     t = bench_suite._time(lambda: ivf_flat.search(index, q, k, sp),
                           reps=3)
     t_plan = bench_suite._time(lambda: pl.search(q), reps=3)
-    return t, t_plan
+    # the blocking plan call — the serving dispatcher's shape, the
+    # path the resource profiler arms on (ISSUE 14)
+    t_plan_block = bench_suite._time(
+        lambda: pl.search(q, block=True), reps=3)
+    return t, t_plan, t_plan_block
 
+
+from raft_tpu.obs import profiler
 
 modes = {}
-for mode, on in (("trace_off", False), ("trace_on", True)):
+for mode, on, prof_rate in (("trace_off", False, 0.0),
+                            ("trace_on", True, 0.0),
+                            ("profile_on", True, None)):
     obs.set_trace_enabled(on)
+    if prof_rate is None:
+        # the shipped default rate (RAFT_TPU_PROFILE_SAMPLE, 0.01)
+        profiler.enable_profiling()
+    else:
+        profiler.disable_profiling()
     obs.RECORDER.clear()
-    t_best, t_plan_best = measure()
+    t_best, t_plan_best, t_block_best = measure()
     for _ in range(4):
-        t, t_plan = measure()
-        t_best, t_plan_best = min(t_best, t), min(t_plan_best, t_plan)
+        t, t_plan, t_block = measure()
+        t_best, t_plan_best, t_block_best = (
+            min(t_best, t), min(t_plan_best, t_plan),
+            min(t_block_best, t_block))
     modes[mode] = {
         "qps": round(nq / t_best, 1),
         "marginal_qps": round(nq / t_marg, 1),
         "plan_qps": round(nq / t_plan_best, 1),
+        "plan_block_qps": round(nq / t_block_best, 1),
         "fixed_cost_ms": round((t_best - t_marg) * 1e3, 3),
         "plan_percall_ms": round(t_plan_best * 1e3, 3),
+        "plan_block_percall_ms": round(t_block_best * 1e3, 3),
         "recorded_traces": len(obs.RECORDER),
     }
+    if prof_rate is None:
+        modes[mode]["profile_sample_rate"] = \
+            profiler.profile_sample_rate()
+        modes[mode]["profile_samples"] = profiler.report().get(
+            "samples", 0)
     print(mode, json.dumps(modes[mode]), flush=True)
+profiler.disable_profiling()
 obs.set_trace_enabled(True)
 
 off, on = modes["trace_off"], modes["trace_on"]
@@ -133,6 +161,21 @@ delta = {
 delta["gate_lt_5pct"] = bool(
     delta["plan_qps_regression_pct"] < 5.0
     and delta["fixed_cost_delta_pct_of_percall"] < 5.0)
+
+# profiling marginal (ISSUE 14): profile_on vs trace_on — the cost the
+# resource profiler adds ON TOP of the shipped tracing default, at its
+# default sample rate. The GATE reads the BLOCKING plan call only:
+# that is the serving dispatcher's shape and the only path the
+# profiler touches (`prof = block and profiler.sampled()` — the
+# non-blocking path short-circuits before any draw, so its delta is
+# pure machine noise and is reported informationally).
+prof = modes["profile_on"]
+delta["profile_plan_qps_regression_pct"] = round(
+    100.0 * (1.0 - prof["plan_qps"] / on["plan_qps"]), 2)
+delta["profile_block_regression_pct"] = round(
+    100.0 * (1.0 - prof["plan_block_qps"] / on["plan_block_qps"]), 2)
+delta["profile_gate_lt_5pct"] = bool(
+    delta["profile_block_regression_pct"] < 5.0)
 
 artifact = {
     "tool": "measure_trace_overhead",

@@ -103,6 +103,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# resource observability (ISSUE 14): the rate-0 nothing-attached
+# contract, the sampled device/host split accuracy, duty-cycle + HBM
+# gauges under the serve smoke with zero steady-state compiles, the
+# /debug/profile route + healthz headroom guardrail, and the fleet
+# per-replica utilization fold.
+echo "precommit: resource-profiler tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_profiler.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # replica fleet serving (ISSUE 13): the sequenced WAL + positioned
 # reader's rewrite-resume semantics, batcher drain, replica lifecycle,
 # p2c routing / suspect exclusion / deadline-aware re-route, the

@@ -24,6 +24,10 @@
 #        the power-of-two-choices front door, availability through a
 #        full replica kill, one rolling restart under load — first
 #        hardware row of the millions-of-users layer
+#   pr0  resource-observability row (ISSUE 14): the FIRST on-hardware
+#        duty-cycle + HBM row — the serve bench with the continuous
+#        profiler's device_util / hbm_peak_mb keys, real PJRT
+#        allocator stats instead of the CPU live-arrays fallback
 #   h1   headline bench (driver format) so the round has fresh
 #        single-device context for the dist comparison
 #   g0   full gated suite (PERF/RECALL/GAP gates end-to-end on TPU)
@@ -103,6 +107,14 @@ fl0() {  # fleet row (ISSUE 13): replica scaling + kill availability +
   cp -f "$OUT/fleet_r6.log" docs/measurements/
 }
 
+pr0() {  # resource-observability row (ISSUE 14): first on-hardware
+         # duty-cycle + HBM figures — device_util and hbm_peak_mb on
+         # the serve + flat rows, from real PJRT allocator stats
+  BENCH_SERVE_SECONDS=4 python bench_suite.py serve ivf_flat \
+    2>&1 | tee "$OUT/profile_r6.log"
+  cp -f "$OUT/profile_r6.log" docs/measurements/
+}
+
 h1() {  # headline bench rows (driver format, embedded measured_at)
   python bench.py 2>&1 | tee "$OUT/headline_r6.log"
   cp -f "$OUT/headline_r6.log" docs/measurements/
@@ -119,6 +131,7 @@ run mu0 mu0
 run ch0 ch0
 run q0 q0
 run fl0 fl0
+run pr0 pr0
 run h1 h1
 run g0 g0
 echo "[$(stamp)] == r6 campaign complete"
