@@ -446,7 +446,15 @@ class MutableIndex:
                 "warmup() before background prewarm")
         params = _dc.replace(self.params, n_probes=n_probes)
         delta_cap = self.cfg.delta_capacities[delta_rung]
-        entry = program_mod.compile_mutate_program(
+        # GL012 audit (ISSUE 15): `nq` is runtime-derived on the
+        # documented cold path — "a cold shape compiles once" is the
+        # flexible/debug contract of MutableIndex.search. Ladder-served
+        # traffic (build_serve_ladder -> _MutableServePlan) pins nq to
+        # the pre-warmed shape grid, each compile is cached on the
+        # epoch, and the epoch grid is re-warmed by the compactor — so
+        # steady state stays at zero compiles (asserted from
+        # raft.plan.cache.* in tests/test_mutate.py).
+        entry = program_mod.compile_mutate_program(  # compile-surface: bounded=cold-shape compile, once per (nq, rung, epoch); ladder-served traffic pins nq to the warmed grid
             epoch.index, rep, nq, self.k, params, delta_cap,
             epoch.tomb_words, slack=self.cfg.tombstone_slack)
         if warm:

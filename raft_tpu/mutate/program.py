@@ -44,6 +44,25 @@ from raft_tpu.distance.distance_types import DistanceType
 __all__ = ["compile_mutate_program", "compile_tail_program",
            "delta_scores", "mutate_tail"]
 
+# Compile-surface rung declarations (graftlint GL012–GL014): the
+# mutable tier's key dimensions.  delta_cap is the one GRID here —
+# delta growth must swap between pre-warmed capacity rungs, never
+# recompile (the PR 9 discipline GL013 now enforces statically).
+COMPILE_SURFACE_RUNGS = {
+    "delta_cap": ("delta_capacities", (1024, 4096, 16384),
+                  "the delta-segment capacity rung ladder "
+                  "(MutateConfig.delta_capacities) — growth swaps "
+                  "operand shapes between pre-warmed programs"),
+    "delta_rung": ("delta_capacities", None,
+                   "a rung INDEX into delta_capacities"),
+    "tomb_words": ("tomb_words", None,
+                   "packed tombstone bitmap width — fixed per epoch "
+                   "(id_base/32), changes only at compaction swap"),
+    "tombstone_slack": ("tombstone_slack", None,
+                        "k + slack over-fetch — config, fixed per "
+                        "index"),
+}
+
 _SQRT_METRICS = (DistanceType.L2SqrtExpanded,
                  DistanceType.L2SqrtUnexpanded)
 

@@ -53,6 +53,28 @@ from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
 
 
+# Compile-surface rung declarations (graftlint GL012–GL014): the plan
+# key's non-grid dimensions — each fixed per plan/server/index at
+# build time, so the compiled-program count stays a finite product.
+COMPILE_SURFACE_RUNGS = {
+    "k": ("k", None,
+          "result depth — fixed per plan/server at construction"),
+    "cap": ("cap", None,
+            "inverted-table cap — measured ONCE per (shape, params) "
+            "at plan build, then cached (cap_cache)"),
+    "kk": ("kk", None,
+           "rescore over-fetch depth (rescore_factor * k) — fixed "
+           "per plan"),
+    "bins": ("bins", None,
+             "scan binning — derived from (k, n_probes, list cap) at "
+             "build"),
+    "scan_bins": ("scan_bins", None,
+                  "SearchParams.scan_bins — config, fixed per plan"),
+    "slack": ("slack", None,
+              "tombstone over-fetch slack — config, fixed per index"),
+}
+
+
 def _plan_cache_max() -> int:
     """LRU bound on ``index.plan_cache`` (``RAFT_TPU_PLAN_CACHE_MAX``,
     default 64 plans; <= 0 disables the bound). Read per call so tests

@@ -49,6 +49,22 @@ from raft_tpu.util.host_sample import sample_rows
 # core, scalars baked into the closure), so a warm key reuses one
 # compiled callable and the serving call is a single cached dispatch.
 # ---------------------------------------------------------------------------
+# Compile-surface rung declarations (graftlint GL012–GL014): the
+# _shmap_plan key dimensions that are per-index/per-process constants
+# — everything else in a key must be a grid rung, an enum or a
+# structural handle, or GL012 flags the site as a retrace storm.
+COMPILE_SURFACE_RUNGS = {
+    "n_lists": ("n_lists", None,
+                "coarse list count — fixed per index"),
+    "scale": ("scale", None, "quantization scale — fixed per index"),
+    "size": ("size", None, "corpus row count — fixed per epoch"),
+    "ml": ("ml", None, "max list length — fixed per index layout"),
+    "ml_shard": ("ml_shard", None,
+                 "per-shard max list length — fixed per build"),
+    "max_iter": ("max_iter", None, "trainer bound — config"),
+    "tol": ("tol", None, "trainer tolerance — config"),
+}
+
 _SHMAP_PLANS: dict = {}
 
 

@@ -54,6 +54,22 @@ __all__ = [
     "build_failover_ladder",
 ]
 
+# Compile-surface rung declarations (graftlint GL012–GL014): the
+# distributed tier's key dimensions beyond the base ladder's — the
+# fleet/dist audit of ISSUE 15.  `level` indexes the same rungs grid
+# the ladder declares; k_fetch is the tail over-fetch width.
+COMPILE_SURFACE_RUNGS = {
+    "level": ("rungs", None,
+              "degradation-rung index carried by DistSearchPlan "
+              "(level 0 = full quality)"),
+    "k_fetch": ("k_fetch", None,
+                "mesh-wide over-fetch width (k + tombstone_slack) — "
+                "fixed per server"),
+    "rank": ("rank", None,
+             "shard rank — bounded by the mesh shape, fixed per "
+             "process"),
+}
+
 
 def _resolve_family(index) -> str:
     """Which distributed search serves this list-sharded index."""

@@ -28,6 +28,24 @@ from raft_tpu.core.error import expects
 
 __all__ = ["PlanLadder"]
 
+# Compile-surface rung declarations (graftlint GL012–GL014,
+# docs/static_analysis.md "The compile-surface manifest"): every
+# dimension a serving-path compile key may draw from, with the grid it
+# is bounded by.  A set name DIFFERENT from the dim name declares a
+# pre-warmed grid (GL013 requires a warmup loop over it); values are
+# the statically-known default grid, None when config-supplied.
+COMPILE_SURFACE_RUNGS = {
+    "nq": ("shapes", (1, 8, 32, 128),
+           "PlanLadder batch shapes — the smallest shape that fits "
+           "the coalesced rows serves the batch; one compiled "
+           "program per shape"),
+    "n_probes": ("rungs", None,
+                 "the n_probes degradation ladder (rung 0 = full "
+                 "quality); config-supplied via probes_ladder"),
+    "rung": ("rungs", None,
+             "a rung INDEX into the degradation ladder"),
+}
+
 
 class PlanLadder:
     """(shape, rung) → a plan-like object with ``.search(q, block=)``,

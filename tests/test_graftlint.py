@@ -47,7 +47,7 @@ class TestFramework:
         rules = core.all_rules()
         for code in ("GL001", "GL002", "GL003", "GL004", "GL005",
                      "GL006", "GL007", "GL008", "GL009", "GL010",
-                     "GL011"):
+                     "GL011", "GL012", "GL013", "GL014"):
             assert code in rules, f"rule {code} missing from registry"
 
     def test_syntax_error_reported_not_crashed(self, tmp_path):
@@ -470,7 +470,7 @@ class TestCLI:
         assert r.returncode == 0
         for code in ("GL001", "GL002", "GL003", "GL004", "GL005",
                      "GL006", "GL007", "GL008", "GL009", "GL010",
-                     "GL011"):
+                     "GL011", "GL012", "GL013", "GL014"):
             assert code in r.stdout
 
     def test_seeded_bug_fails_the_gate(self, tmp_path):

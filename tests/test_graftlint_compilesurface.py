@@ -1,0 +1,388 @@
+"""Compile-surface analysis tests (ISSUE 15).
+
+GL012/GL013/GL014 positive/negative fixtures, rung-set extraction on
+the REAL ladder/delta/dist grids, the manifest golden pin, prewarm-gap
+detection on a seeded unwarmed rung, the seeded unbounded-key fixture
+failing the gate rc=1, the SARIF output schema, and the shared-model
+perf budget (full-tree wall ≤ 3 s via timings_ms).
+
+Fixtures are mini ``raft_tpu/`` trees under tmp_path (the
+tests/test_graftlint.py idiom): the analyzer scopes by rel path, so a
+synthesized ``raft_tpu/serve/x.py`` enters the same contracts as the
+real one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftlint import compilesurface, core, engine  # noqa: E402
+
+
+def _write(tmp_path, rel, text):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+    return p
+
+
+def _run(root, select=None):
+    findings, suppressed = engine.run(str(root), select=select)
+    return findings, suppressed
+
+
+def _codes(findings):
+    return [f.rule for f in findings]
+
+
+# a mini serving module: declarations + plan cache + entry point.
+# The GOOD server keys on declared dims; the BAD server keys on
+# runtime data (the float(cfg.x) / len(queries) retrace-storm shape).
+FIXTURE_COMMON = (
+    "import jax\n"
+    "COMPILE_SURFACE_RUNGS = {\n"
+    "    'nq': ('shapes', (1, 8), 'batch shapes'),\n"
+    "    'rung': ('rungs', (0, 1), 'degradation rung'),\n"
+    "}\n"
+    "_PLANS = {}\n"
+    "def _shmap_plan(key, builder):\n"
+    "    fn = _PLANS.get(key)\n"
+    "    if fn is None:\n"
+    "        fn = _PLANS[key] = builder()\n"
+    "    return fn\n"
+    "def _compile_point(nq, rung):\n"
+    "    def build():\n"
+    "        return jax.jit(lambda q: q * rung)\n"
+    "    return _shmap_plan(('scan', nq, rung), build)\n"
+)
+
+FIXTURE_WARM = (
+    "def prewarm(shapes, rungs):\n"
+    "    for s in shapes:\n"
+    "        for r in rungs:\n"
+    "            _compile_point(s, r)\n"
+)
+
+FIXTURE_GOOD = FIXTURE_COMMON + FIXTURE_WARM + (
+    "class GoodSearchServer:\n"
+    "    def search(self, queries, nq, rung):\n"
+    "        plan = _compile_point(nq, rung)\n"
+    "        return plan(queries)\n"
+)
+
+FIXTURE_BAD = FIXTURE_COMMON + FIXTURE_WARM + (
+    "class BadSearchServer:\n"
+    "    def search(self, queries, cfg):\n"
+    "        def build():\n"
+    "            return jax.jit(lambda q: q)\n"
+    "        plan = _shmap_plan(\n"
+    "            ('scan', float(cfg.x), len(queries)), build)\n"
+    "        return plan(queries)\n"
+)
+
+
+class TestGL012UnboundedKey:
+    def test_flags_runtime_keyed_dispatch(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_BAD)
+        findings, _ = _run(tmp_path, select=["GL012"])
+        assert _codes(findings) == ["GL012"]
+        msg = findings[0].message
+        assert "unbounded" in msg
+        assert "x" in msg and "queries" in msg
+
+    def test_declared_rung_key_stays_silent(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD)
+        findings, _ = _run(tmp_path, select=["GL012"])
+        assert findings == []
+
+    def test_non_serving_site_not_flagged(self, tmp_path):
+        # same unbounded key OUTSIDE any serving entry point: a
+        # build-time compile keyed on its inputs is the normal case
+        src = FIXTURE_COMMON + (
+            "def offline_build(queries, cfg):\n"
+            "    def build():\n"
+            "        return jax.jit(lambda q: q)\n"
+            "    return _shmap_plan(('b', len(queries)), build)\n"
+        )
+        _write(tmp_path, "raft_tpu/neighbors/b.py", src)
+        findings, _ = _run(tmp_path, select=["GL012"])
+        assert findings == []
+
+    def test_uncached_jit_on_serving_path_flagged(self, tmp_path):
+        src = (
+            "import jax\n"
+            "class RawSearchServer:\n"
+            "    def search(self, queries):\n"
+            "        fn = jax.jit(step)\n"
+            "        return fn(queries)\n"
+            "def step(q):\n"
+            "    return q\n"
+        )
+        _write(tmp_path, "raft_tpu/serve/raw.py", src)
+        findings, _ = _run(tmp_path, select=["GL012"])
+        assert _codes(findings) == ["GL012"]
+        assert "uncached" in findings[0].message
+
+    def test_bounded_pragma_justifies_cold_path(self, tmp_path):
+        src = FIXTURE_COMMON + FIXTURE_WARM + (
+            "class ColdSearchServer:\n"
+            "    def search(self, queries):\n"
+            "        plan = _shmap_plan(  "
+            "# compile-surface: bounded=cold shape, compiled once\n"
+            "            ('cold', len(queries)), lambda: None)\n"
+            "        return plan\n"
+        )
+        _write(tmp_path, "raft_tpu/serve/srv.py", src)
+        findings, _ = _run(tmp_path, select=["GL012"])
+        assert findings == []
+
+
+class TestGL013UnwarmedRung:
+    def test_seeded_unwarmed_rung_flagged(self, tmp_path):
+        # declared grid, serveable key on it, NO prewarm loop
+        src = FIXTURE_COMMON + (
+            "class LadderSearchServer:\n"
+            "    def search(self, queries, nq, rung):\n"
+            "        plan = _compile_point(nq, rung)\n"
+            "        return plan(queries)\n"
+        )
+        _write(tmp_path, "raft_tpu/serve/srv.py", src)
+        findings, _ = _run(tmp_path, select=["GL013"])
+        assert set(_codes(findings)) == {"GL013"}
+        sets = {f.message.split("`")[3] for f in findings}
+        assert sets == {"shapes", "rungs"}
+        assert any("steady-state compile" in f.message
+                   for f in findings)
+
+    def test_warm_loop_clears_the_gap(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD)
+        findings, _ = _run(tmp_path, select=["GL013"])
+        assert findings == []
+
+
+class TestGL014SurfaceDrift:
+    def test_no_golden_no_findings(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD)
+        findings, _ = _run(tmp_path, select=["GL014"])
+        assert findings == []
+
+    def test_pinned_surface_round_trips_clean(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD)
+        surface = engine.build_surface(str(tmp_path))
+        (tmp_path / "tools").mkdir()
+        engine.write_surface_golden(
+            str(tmp_path / engine.SURFACE_GOLDEN), surface)
+        findings, _ = _run(tmp_path, select=["GL014"])
+        assert findings == []
+
+    def test_new_site_fails_against_pin(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD)
+        surface = engine.build_surface(str(tmp_path))
+        (tmp_path / "tools").mkdir()
+        engine.write_surface_golden(
+            str(tmp_path / engine.SURFACE_GOLDEN), surface)
+        # grow the surface: a second keyed dispatch point
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD + (
+            "def another(nq):\n"
+            "    return _shmap_plan(('other', nq), lambda: None)\n"
+        ))
+        findings, _ = _run(tmp_path, select=["GL014"])
+        assert _codes(findings) == ["GL014"]
+        assert "not in the pinned compile surface" in \
+            findings[0].message
+
+    def test_removed_site_reports_stale_pin(self, tmp_path):
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_GOOD)
+        surface = engine.build_surface(str(tmp_path))
+        (tmp_path / "tools").mkdir()
+        engine.write_surface_golden(
+            str(tmp_path / engine.SURFACE_GOLDEN), surface)
+        _write(tmp_path, "raft_tpu/serve/srv.py", FIXTURE_COMMON)
+        findings, _ = _run(tmp_path, select=["GL014"])
+        assert findings and all(c == "GL014" for c in
+                                _codes(findings))
+        assert any("disappeared" in f.message for f in findings)
+
+
+class TestRealTreeContract:
+    """ISSUE 15 acceptance on the real tree."""
+
+    def test_rules_registered(self):
+        rules = core.all_rules()
+        for code in ("GL012", "GL013", "GL014"):
+            assert code in rules
+
+    def test_rung_extraction_real_grids(self):
+        """The declared rung sets of the real ladder/delta/dist
+        grids, extracted statically."""
+        surface = engine.build_surface(REPO)
+        rungs = surface.rungs
+        assert rungs["nq"].set_name == "shapes"
+        assert rungs["nq"].values == (1, 8, 32, 128)
+        assert rungs["n_probes"].set_name == "rungs"
+        assert rungs["delta_cap"].set_name == "delta_capacities"
+        assert rungs["delta_cap"].values == (1024, 4096, 16384)
+        assert rungs["level"].set_name == "rungs"
+        # the three serving grids all have pre-warm coverage
+        assert {"shapes", "rungs", "delta_capacities"} <= \
+            surface.warm_sets
+
+    def test_every_serving_site_classifies_finite(self):
+        """The zero-steady-state-compile contract, statically: every
+        serving-reachable trace site's key dimensions are FINITE (or
+        carry a written bounded= justification)."""
+        surface = engine.build_surface(REPO)
+        serving = surface.serving_sites()
+        assert serving, "no serving-reachable sites found"
+        for site in serving:
+            assert site.unbounded_dims() == [], (
+                f"{site.rel}:{site.line} keys on "
+                f"{[d.name for d in site.unbounded_dims()]}")
+
+    def test_manifest_pinned_against_golden(self):
+        """Tier-1 manifest pin: site count and totals match the
+        checked-in tools/compile_surface.json."""
+        surface = engine.build_surface(REPO)
+        manifest = surface.to_manifest()
+        with open(os.path.join(REPO, engine.SURFACE_GOLDEN)) as f:
+            golden = json.load(f)
+        assert manifest["totals"]["sites"] == \
+            golden["totals"]["sites"]
+        assert manifest["totals"]["serving_reachable"] == \
+            golden["totals"]["serving_reachable"]
+        assert manifest["totals"]["serving_unbounded_dims"] == 0
+        # the known serving cache boundaries are enumerated
+        files = {s["file"] for s in manifest["sites"]
+                 if s["serving_reachable"]}
+        assert "raft_tpu/parallel/ivf.py" in files
+        assert "raft_tpu/mutate/mutable.py" in files
+
+    def test_real_tree_clean_with_empty_baseline(self):
+        findings, _ = engine.run(
+            REPO, select=["GL012", "GL013", "GL014"])
+        assert findings == []
+        allow = engine.load_baseline(
+            os.path.join(REPO, engine.DEFAULT_BASELINE))
+        assert not [k for k in allow
+                    if k[0] in ("GL012", "GL013", "GL014")]
+
+    def test_mutable_cold_path_carries_justification(self):
+        """The one real GL012 finding the audit surfaced — the
+        arbitrary-nq cold compile in MutableIndex._build_entry — is
+        justified in-line, not silently exempt."""
+        surface = engine.build_surface(REPO)
+        cold = [s for s in surface.sites
+                if s.rel == "raft_tpu/mutate/mutable.py"
+                and s.kind == "plan_build"
+                and s.bounded_pragma is not None]
+        assert cold, "expected a bounded= pragma on _build_entry"
+        assert "cold-shape" in cold[0].bounded_pragma
+
+    def test_fleet_dist_tail_and_failover_keys_finite(self):
+        """ISSUE 15 audit: the PR 10–13 key spaces — the dist tail
+        program and the failover ladder — classify FINITE end to
+        end."""
+        surface = engine.build_surface(REPO)
+        tail = [s for s in surface.sites
+                if s.func.endswith("MutableIndex._build_tail")]
+        assert tail and tail[0].serving_reachable
+        assert tail[0].unbounded_dims() == []
+        shmap = [s for s in surface.sites
+                 if s.kind == "shmap_plan" and s.serving_reachable]
+        assert shmap, "dist dispatch _shmap_plan sites not found"
+        for site in shmap:
+            assert site.unbounded_dims() == []
+
+
+class TestCLI:
+    def _cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", *args],
+            cwd=REPO, capture_output=True, text=True, timeout=300)
+
+    def test_compile_surface_emits_manifest(self):
+        r = self._cli("--compile-surface")
+        assert r.returncode == 0, r.stderr
+        obj = json.loads(r.stdout)
+        assert obj["version"] == compilesurface.MANIFEST_VERSION
+        assert obj["totals"]["serving_unbounded_dims"] == 0
+        assert obj["totals"]["sites"] >= 50
+        assert {"sites", "rungs", "warm_coverage", "totals"} <= \
+            set(obj)
+
+    def test_seeded_gl012_fails_gate_rc1(self, tmp_path):
+        """ISSUE 15 satellite acceptance: a float(cfg.x)-keyed jit in
+        a serving path fails the precommit graftlint line rc=1."""
+        p = tmp_path / "seeded_serving.py"
+        p.write_text(FIXTURE_BAD)
+        r = self._cli(str(p))
+        assert r.returncode == 1
+        assert "GL012" in r.stdout
+        assert "unbounded" in r.stdout
+
+    def test_list_rules_includes_compile_surface(self):
+        r = self._cli("--list-rules")
+        assert r.returncode == 0
+        for code in ("GL012", "GL013", "GL014"):
+            assert code in r.stdout
+
+
+class TestSarif:
+    def _cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", *args],
+            cwd=REPO, capture_output=True, text=True, timeout=300)
+
+    def test_sarif_schema_pinned(self, tmp_path):
+        p = tmp_path / "seeded.py"
+        p.write_text("import time\nt = time.time()\n")
+        r = self._cli(str(p), "--sarif", "--no-baseline")
+        assert r.returncode == 1
+        obj = json.loads(r.stdout)
+        assert obj["version"] == engine.SARIF_VERSION == "2.1.0"
+        assert "sarif-schema-2.1.0" in obj["$schema"]
+        run = obj["runs"][0]
+        assert run["tool"]["driver"]["name"] == "graftlint"
+        rule_ids = {x["id"] for x in run["tool"]["driver"]["rules"]}
+        res = run["results"][0]
+        assert res["ruleId"] in rule_ids
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("seeded.py")
+        assert loc["region"]["startLine"] == 2
+        assert loc["region"]["startColumn"] >= 1
+
+    def test_sarif_clean_tree_empty_results(self, tmp_path):
+        p = tmp_path / "clean.py"
+        p.write_text("x = 1\n")
+        r = self._cli(str(p), "--sarif", "--no-baseline")
+        assert r.returncode == 0
+        obj = json.loads(r.stdout)
+        assert obj["runs"][0]["results"] == []
+
+
+class TestEnginePerf:
+    def test_full_tree_within_budget_and_model_shared(self):
+        """ISSUE 15 satellite: the callgraph/compile-surface model is
+        built once per invocation and shared across GL007–GL014 —
+        full-tree wall stays ≤ 3 s on CPU (timings_ms)."""
+        timings = {}
+        engine.run(REPO, timings=timings)
+        total_ms = sum(timings.values()) * 1e3
+        assert total_ms <= 3000, f"full-tree lint took {total_ms:.0f}ms"
+        assert "model" in timings, "shared model not built/timed"
+        # the consumers of the shared model are nearly free: they must
+        # not re-fingerprint the tree per rule
+        for code in ("GL007", "GL008", "GL009", "GL013", "GL014"):
+            assert timings.get(code, 0.0) * 1e3 < 200.0, (
+                f"{code} re-analyzed the tree "
+                f"({timings[code] * 1e3:.0f}ms)")

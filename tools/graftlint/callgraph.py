@@ -587,6 +587,11 @@ class Program:
         self.classes: Dict[str, ClassInfo] = {}
         self.functions: Dict[str, FuncInfo] = {}
         self.rel_to_module: Dict[str, str] = {}
+        # retained whole-module ASTs + raw sources: the compile-surface
+        # analyzer re-walks them (sites, pragmas, rung declarations)
+        # without re-reading the tree from disk
+        self.trees: Dict[str, ast.AST] = {}
+        self.sources: Dict[str, str] = {}
         self._bodies: Dict[str, ast.AST] = {}
         self._method_index: Dict[str, List[str]] = {}
         self._cb_attr_names: Set[str] = set()
@@ -599,6 +604,7 @@ class Program:
     def build(cls, trees: Dict[str, ast.AST]) -> "Program":
         """``trees``: repo-relative path → parsed module AST."""
         p = cls()
+        p.trees = dict(trees)
         for rel in sorted(trees):
             _collect_module(p, rel, trees[rel])
         for name, fi in p.functions.items():
@@ -1010,6 +1016,12 @@ def get_program(contexts: Dict[str, object],
         except SyntaxError:
             continue        # GL000 reports it when in scope
     prog = Program.build(trees)
+    for rel, ctx in contexts.items():
+        if getattr(ctx, "tree", None) is not None:
+            prog.sources[rel] = ctx.text
+    for rel, text in texts.items():
+        if rel in trees:
+            prog.sources[rel] = text
     if len(_CACHE) >= _CACHE_MAX:
         _CACHE.pop(next(iter(_CACHE)))
     _CACHE[key] = prog

@@ -137,6 +137,25 @@ def run(root: str = REPO, files: Optional[Sequence[str]] = None,
                 continue
             for f in timed_iter(rule, rule.check(ctx)):
                 (suppressed if ctx.suppressed(f) else findings).append(f)
+    # one whole-program model per run, shared by every
+    # interprocedural rule (GL007–GL009, GL012–GL014): without this,
+    # each rule re-fingerprints the tree in finalize
+    shared_program = None
+    for rule in rules:
+        if not getattr(rule, "wants_program", False):
+            continue
+        if shared_program is None:
+            rule_contexts = getattr(rule, "_contexts", None)
+            if not rule_contexts:
+                continue
+            from tools.graftlint import callgraph
+            t0 = time.perf_counter()
+            shared_program = callgraph.get_program(
+                rule_contexts, getattr(rule, "_root", None))
+            if timings is not None:
+                timings["model"] = timings.get("model", 0.0) \
+                    + (time.perf_counter() - t0)
+        rule.set_program(shared_program)
     for rule in rules:
         for f in timed_iter(rule, rule.finalize()):
             ctx = contexts.get(f.file)
@@ -170,6 +189,53 @@ def lock_graph_dot(root: str = REPO,
             contexts[rel] = ctx
     program = callgraph.get_program(contexts, root)
     return program.lock_order_dot(), program.lock_cycles()
+
+
+def build_surface(root: str = REPO,
+                  files: Optional[Sequence[str]] = None):
+    """The whole-program compile surface (``--compile-surface`` /
+    ``--write-compile-surface`` and the tier-1 manifest pin). Scans
+    ``raft_tpu`` under ``root`` by default."""
+    from tools.graftlint import callgraph, compilesurface
+    paths = ([os.path.abspath(f) for f in files] if files
+             else [os.path.join(root, "raft_tpu")])
+    contexts: Dict[str, FileContext] = {}
+    for path in iter_source_files(root, paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        ctx = FileContext(path, rel, text)
+        if ctx.tree is not None:
+            contexts[rel] = ctx
+    program = callgraph.get_program(contexts, root)
+    return compilesurface.get_surface(program)
+
+
+SURFACE_GOLDEN = os.path.join("tools", "compile_surface.json")
+
+
+def write_surface_golden(path: str, surface) -> dict:
+    """Pin the compile surface: stable per-site signatures (no line
+    numbers — the pin survives unrelated drift) plus the totals the
+    tier-1 test asserts."""
+    manifest = surface.to_manifest()
+    obj = {
+        "version": manifest["version"],
+        "comment": ("pinned compile surface — every trace site and "
+                    "its key-dimension classification; regenerate "
+                    "with `python -m tools.graftlint "
+                    "--write-compile-surface` (code review owns the "
+                    "diff)"),
+        "totals": manifest["totals"],
+        "sites": [s.signature() for s in surface.sites],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return obj
 
 
 # --------------------------------------------------------------------------
@@ -250,6 +316,56 @@ def to_json(new: Sequence[Finding], grandfathered: Sequence[Finding],
     }
 
 
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(new: Sequence[Finding]) -> dict:
+    """The ``--sarif`` output (SARIF 2.1.0): findings as results CI
+    code review renders as inline annotations. Schema pinned by
+    tests/test_graftlint.py."""
+    rules_meta = []
+    seen = set()
+    catalog = all_rules()
+    for f in new:
+        if f.rule in seen:
+            continue
+        seen.add(f.rule)
+        cls = catalog.get(f.rule)
+        rules_meta.append({
+            "id": f.rule,
+            "name": getattr(cls, "name", "") or f.rule,
+            "shortDescription": {
+                "text": getattr(cls, "description", "") or f.rule},
+        })
+    results = [{
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.file},
+                "region": {"startLine": f.line,
+                           "startColumn": f.col + 1},
+            },
+        }],
+    } for f in new]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
@@ -271,9 +387,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "graph as Graphviz DOT (to FILE, default "
                          "stdout) and exit; exit 1 if the graph has "
                          "cycles")
+    ap.add_argument("--compile-surface", nargs="?", const="-",
+                    metavar="FILE", default=None,
+                    dest="compile_surface",
+                    help="emit the enumerated compile-surface "
+                         "manifest (GL012–GL014's model) as JSON (to "
+                         "FILE, default stdout) and exit; exit 1 if "
+                         "any serving-reachable site keys on an "
+                         "unbounded dimension")
+    ap.add_argument("--write-compile-surface", action="store_true",
+                    help=f"pin the current compile surface into "
+                         f"{SURFACE_GOLDEN} (the GL014 gate) and "
+                         f"exit 0")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output (includes per-rule "
                          "timings_ms)")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 output (CI code-review "
+                         "annotations); exit semantics unchanged")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help=f"baseline file (default: {DEFAULT_BASELINE} "
                          f"when it exists)")
@@ -292,6 +423,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{code}  {cls.name}  [{scope}]")
             if cls.description:
                 print(f"       {cls.description}")
+        return 0
+
+    if args.compile_surface is not None or args.write_compile_surface:
+        surface = build_surface(REPO, files=args.paths or None)
+        if args.write_compile_surface:
+            path = os.path.join(REPO, SURFACE_GOLDEN)
+            obj = write_surface_golden(path, surface)
+            print(f"graftlint: pinned {obj['totals']['sites']} trace "
+                  f"site(s) to {path}")
+            return 0
+        manifest = surface.to_manifest()
+        out = json.dumps(manifest, indent=2)
+        if args.compile_surface == "-":
+            print(out)
+        else:
+            with open(args.compile_surface, "w", encoding="utf-8") as f:
+                f.write(out + "\n")
+            print(f"graftlint: wrote compile-surface manifest to "
+                  f"{args.compile_surface}")
+        if manifest["totals"]["serving_unbounded_dims"]:
+            print(f"graftlint: "
+                  f"{manifest['totals']['serving_unbounded_dims']} "
+                  f"unbounded serving key dimension(s)",
+                  file=sys.stderr)
+            return 1
         return 0
 
     if args.lock_graph is not None:
@@ -354,6 +510,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.as_json:
         print(json.dumps(to_json(new, grandfathered, suppressed,
                                  timings), indent=2))
+    elif args.sarif:
+        print(json.dumps(to_sarif(new), indent=2))
     else:
         for f in new:
             print(f.render())

@@ -3,6 +3,7 @@
 from tools.graftlint.rules import blocking  # noqa: F401
 from tools.graftlint.rules import callback  # noqa: F401
 from tools.graftlint.rules import clock  # noqa: F401
+from tools.graftlint.rules import compile_surface  # noqa: F401
 from tools.graftlint.rules import host_sync  # noqa: F401
 from tools.graftlint.rules import lockorder  # noqa: F401
 from tools.graftlint.rules import locks  # noqa: F401

@@ -1,0 +1,206 @@
+"""GL012/GL013/GL014 — the compile-surface contract at lint time.
+
+The zero-steady-state-compile invariant every serving test re-asserts
+from ``raft.plan.cache.*`` / ``raft.parallel.plan.*`` counters,
+enforced statically from :mod:`tools.graftlint.compilesurface`:
+
+* **GL012 unbounded-compile-key** — a trace site reachable from a
+  serving entry point whose key includes a dimension the dataflow
+  classifies UNBOUNDED (``len(queries)``-derived shapes, undeclared
+  config attributes, wall-clock state).  This is the static form of
+  the retrace-storm bug PR 2's ``_shmap_plan`` and PR 9's
+  ``delta_capacities`` ladder were built to kill: such a site compiles
+  a new program per distinct runtime value, under traffic.  A
+  deliberate cold-path compile carries ``# compile-surface:
+  bounded=<reason>`` on the site's first line (the reason lands in the
+  ``--compile-surface`` manifest).
+* **GL013 unwarmed-rung** — a serving-reachable site keys on a
+  declared grid rung set (``shapes``, ``rungs``,
+  ``delta_capacities``), but no pre-warm loop anywhere in the program
+  iterates that set and reaches a compile: a serveable key nobody
+  warms is a GUARANTEED steady-state compile on first use.
+* **GL014 compile-surface-drift** — the enumerated surface is pinned
+  in ``tools/compile_surface.json``; any new/removed/reclassified
+  site fails the gate with a diff naming the site.  Regenerate with
+  ``python -m tools.graftlint --write-compile-surface`` (code review
+  owns the diff, exactly like the findings baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+from tools.graftlint import compilesurface
+from tools.graftlint.core import Finding, register
+from tools.graftlint.rules.interproc import InterproceduralRule
+
+GOLDEN_PATH = os.path.join("tools", "compile_surface.json")
+
+
+def _dims_desc(dims) -> str:
+    return ", ".join(f"{d.name}<-{d.source}" for d in dims)
+
+
+class _CompileSurfaceRule(InterproceduralRule):
+    """Base: one shared Surface per Program (weak-keyed memo in
+    :mod:`compilesurface`), findings filtered to the selected files."""
+
+    paths = ("raft_tpu",)
+    report_paths = ("raft_tpu",)
+
+    def surface(self) -> compilesurface.Surface:
+        return compilesurface.get_surface(self.program())
+
+
+@register
+class UnboundedCompileKey(_CompileSurfaceRule):
+    code = "GL012"
+    name = "unbounded-compile-key"
+    description = ("a trace site reachable from a serving entry point "
+                   "(batcher dispatch, FleetRouter.search, "
+                   "MutableIndex search/mutate, plan.search) keys on "
+                   "an UNBOUNDED dimension — one compile per distinct "
+                   "runtime value, under traffic (the PR 2 "
+                   "_shmap_plan retrace-storm class); declare a rung "
+                   "set or mark the cold path `# compile-surface: "
+                   "bounded=<reason>`")
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._contexts:
+            return
+        for site in self.surface().serving_sites():
+            if not self._eligible(site.rel):
+                continue
+            bad = site.unbounded_dims()
+            if bad:
+                dims = "; ".join(
+                    f"`{d.name}` ({d.source})" for d in bad)
+                yield self.finding_at(
+                    site.rel, site.line,
+                    f"serving-reachable {site.kind} site in "
+                    f"`{site.func.rsplit('.', 1)[-1]}` keys on "
+                    f"unbounded dimension(s): {dims} — each distinct "
+                    f"value compiles a new program under traffic; "
+                    f"draw it from a declared rung set "
+                    f"(COMPILE_SURFACE_RUNGS) or justify with "
+                    f"`# compile-surface: bounded=<reason>`")
+                continue
+            if site.kind in ("jit", "aot") and site.cached_by is None \
+                    and site.bounded_pragma is None:
+                yield self.finding_at(
+                    site.rel, site.line,
+                    f"uncached {site.kind} wrapper built inside "
+                    f"serving-reachable "
+                    f"`{site.func.rsplit('.', 1)[-1]}` — a fresh "
+                    f"callable identity re-traces per call; route it "
+                    f"through a keyed cache (_shmap_plan / "
+                    f"plan-cache idiom) or hoist to module scope")
+
+
+@register
+class UnwarmedRung(_CompileSurfaceRule):
+    code = "GL013"
+    name = "unwarmed-rung"
+    description = ("a serving-reachable trace site keys on a declared "
+                   "grid rung set that NO pre-warm loop compiles — a "
+                   "serveable key nobody warms is a guaranteed "
+                   "steady-state compile on first use")
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._contexts:
+            return
+        surface = self.surface()
+        for site in surface.serving_sites():
+            if not self._eligible(site.rel) or \
+                    site.bounded_pragma is not None:
+                continue
+            missing = []
+            for d in site.dims:
+                if d.cls != compilesurface.FINITE or \
+                        not d.source.startswith("rung:"):
+                    continue
+                set_name = d.source[len("rung:"):].split("|")[0]
+                decl = next((r for r in surface.rungs.values()
+                             if r.set_name == set_name), None)
+                if decl is not None and decl.is_grid and \
+                        set_name not in surface.warm_sets:
+                    missing.append((d.name, set_name))
+            for dim, set_name in missing:
+                yield self.finding_at(
+                    site.rel, site.line,
+                    f"serveable key dimension `{dim}` draws from rung "
+                    f"set `{set_name}` but no pre-warm site compiles "
+                    f"that grid — the first request at any rung pays "
+                    f"a steady-state compile; add a warmup loop over "
+                    f"`{set_name}` (the PlanLadder.build / "
+                    f"MutableIndex.warmup discipline)")
+
+
+@register
+class CompileSurfaceDrift(_CompileSurfaceRule):
+    code = "GL014"
+    name = "compile-surface-drift"
+    description = ("the enumerated compile surface no longer matches "
+                   "the pinned manifest (tools/compile_surface.json): "
+                   "a new, removed or reclassified trace site changes "
+                   "the compiled-program budget — review and "
+                   "regenerate with --write-compile-surface")
+
+    def _golden(self) -> Optional[dict]:
+        if self._root is None:
+            return None
+        path = os.path.join(self._root, GOLDEN_PATH)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._contexts:
+            return
+        golden = self._golden()
+        if golden is None:
+            return                  # no pin yet (fixture trees)
+        surface = self.surface()
+
+        def key(sig: dict) -> tuple:
+            return (sig["file"], sig["function"], sig["kind"],
+                    tuple(sig["dims"]), bool(sig["serving_reachable"]),
+                    bool(sig.get("bounded", False)))
+
+        current = {}
+        for site in surface.sites:
+            current.setdefault(key(site.signature()), []).append(site)
+        pinned = {}
+        for sig in golden.get("sites", []):
+            pinned[key(sig)] = pinned.get(key(sig), 0) + 1
+
+        for k, sites in sorted(current.items()):
+            extra = len(sites) - pinned.get(k, 0)
+            for site in sites[:max(0, extra)]:
+                if not self._eligible(site.rel):
+                    continue
+                serving = (" [serving-reachable]"
+                           if site.serving_reachable else "")
+                yield self.finding_at(
+                    site.rel, site.line,
+                    f"trace site not in the pinned compile surface: "
+                    f"{site.kind} in `{site.func}`{serving} "
+                    f"({_dims_desc(site.dims) or 'no key dims'}) — "
+                    f"review the compiled-program budget and "
+                    f"regenerate with --write-compile-surface")
+        for k, n in sorted(pinned.items()):
+            have = len(current.get(k, ()))
+            if have >= n:
+                continue
+            rel = k[0]
+            if not self._eligible(rel):
+                continue
+            yield self.finding_at(
+                rel, 1,
+                f"pinned trace site disappeared: {k[2]} in `{k[1]}` "
+                f"({n - have} instance(s)) — the manifest is stale; "
+                f"regenerate with --write-compile-surface")

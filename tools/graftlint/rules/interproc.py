@@ -47,11 +47,19 @@ class InterproceduralRule(Rule):
     # explicitly-named CLI files are always eligible
     report_paths: tuple = ("raft_tpu",)
     excludes = ("tools/graftlint",)
+    # the engine builds ONE Program per run and injects it into every
+    # rule that wants it (GL007–GL009, GL012–GL014) — without this,
+    # each rule would pay the model fingerprint sweep in finalize
+    wants_program = True
 
     def __init__(self):
         self._contexts: Dict[str, FileContext] = {}
         self._explicit: Set[str] = set()
         self._root: Optional[str] = None
+        self._program: Optional[callgraph.Program] = None
+
+    def set_program(self, program: callgraph.Program) -> None:
+        self._program = program
 
     def applies_to(self, rel: str, explicit: bool = False) -> bool:
         ok = super().applies_to(rel, explicit)
@@ -78,7 +86,10 @@ class InterproceduralRule(Rule):
         return False
 
     def program(self) -> callgraph.Program:
-        return callgraph.get_program(self._contexts, self._root)
+        if self._program is None:
+            self._program = callgraph.get_program(self._contexts,
+                                                  self._root)
+        return self._program
 
     def finding_at(self, rel: str, line: int, message: str) -> Finding:
         return self._contexts[rel].finding(self.code, line, message)

@@ -20,19 +20,15 @@ else
     python -m compileall -q raft_tpu || fail=1
 fi
 
-# graftlint (ISSUE 6, interprocedural since ISSUE 12): the JAX/TPU-
-# aware static-analysis gate — host syncs in jit, retrace hazards,
-# serve/comms lock discipline, missing matmul precision, wall-clock
-# misuse, metric-name taxonomy, PLUS the whole-program concurrency
-# rules: GL007 lock-order cycles (the global graph must stay acyclic),
-# GL008 blocking-under-lock and GL009 callback-under-lock across
-# serve/mutate/obs/comms. Strict on new code with an EMPTY baseline:
-# any live finding — a seeded lock-order inversion included — fails
-# this line (docs/static_analysis.md has the suppression workflow;
-# `--changed-only` is the fast dev loop, CI stays full-tree).
-echo "precommit: graftlint static analysis (full tree, all rules)"
-python -m tools.graftlint --baseline tools/graftlint_baseline.json \
-    || fail=1
+# graftlint fast path (ISSUE 15 satellite): lint ONLY the files
+# changed vs HEAD first — seconds instead of the full sweep, so a
+# fresh GL012 unbounded-compile-key (or any other rule) in the code
+# you just touched fails within the first moments of the gate. The
+# whole-program rules (GL007–GL009, GL012–GL014) still model the FULL
+# tree underneath; only reporting is scoped. The authoritative
+# full-tree strict run happens below, before tier-1.
+echo "precommit: graftlint static analysis (changed files, fast path)"
+python -m tools.graftlint --changed-only || fail=1
 
 echo "precommit: metric + span name taxonomy lint"
 python tools/check_metric_names.py || fail=1
@@ -130,6 +126,23 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
 echo "precommit: distributed serving tests"
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve_dist.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
+# graftlint full tree (ISSUE 6, interprocedural since ISSUE 12,
+# compile-surface since ISSUE 15): the JAX/TPU-aware static-analysis
+# gate — host syncs in jit, retrace hazards, serve/comms lock
+# discipline, missing matmul precision, wall-clock misuse, metric-name
+# taxonomy, the whole-program concurrency rules (GL007 lock-order
+# cycles, GL008 blocking-under-lock, GL009 callback-under-lock), PLUS
+# the compile-surface contract: GL012 flags any serving-reachable
+# trace site keyed on an unbounded dimension (the retrace-storm
+# class), GL013 flags serveable rungs no warmup compiles, GL014 pins
+# the enumerated surface against tools/compile_surface.json. Strict
+# on new code with an EMPTY baseline: any live finding — a seeded
+# float(cfg.x)-keyed jit in a serving path included — fails this line
+# rc=1 (docs/static_analysis.md has the suppression workflow).
+echo "precommit: graftlint static analysis (full tree, all rules)"
+python -m tools.graftlint --baseline tools/graftlint_baseline.json \
     || fail=1
 
 echo "precommit: tier-1 pytest (ROADMAP.md)"
