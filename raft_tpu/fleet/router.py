@@ -226,12 +226,20 @@ class FleetRouter:
         return duel[0] if la <= lb else duel[1]
 
     def submit(self, queries, k: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               trace_context: Optional[str] = None) -> Future:
         """Route one request → ``Future`` (same result contract as
         :meth:`SearchServer.submit`). The future resolves with the
         chosen replica's answer, after up to ``max_retries`` re-routes
         on dispatch-class failures — or with the typed error when the
-        fleet cannot serve it."""
+        fleet cannot serve it.
+
+        ``trace_context`` is an optional upstream ``traceparent``
+        (e.g. from the HTTP endpoint's request header): the
+        ``raft.fleet.route`` span adopts it, and the replica-side
+        ``raft.serve.request`` root in turn parents under the route
+        span — one trace id end to end. Defaults to the caller
+        thread's open span, if any."""
         q = np.asarray(queries, np.float32)
         if q.ndim == 1:
             q = q[None, :]
@@ -239,9 +247,11 @@ class FleetRouter:
             deadline_ms = self._cfg.default_deadline_ms
         t_deadline = (time.perf_counter() + deadline_ms / 1e3
                       if deadline_ms and deadline_ms > 0 else None)
+        if trace_context is None:
+            trace_context = spans.current_traceparent()
         outer: Future = Future()
         self._dispatch(outer, q, k, t_deadline, attempt=0,
-                       tried=frozenset())
+                       tried=frozenset(), trace_ctx=trace_context)
         return outer
 
     def search(self, queries, k: Optional[int] = None,
@@ -258,7 +268,8 @@ class FleetRouter:
 
     def _dispatch(self, outer: Future, q, k,
                   t_deadline: Optional[float], attempt: int,
-                  tried: frozenset) -> None:
+                  tried: frozenset,
+                  trace_ctx: Optional[str] = None) -> None:
         remaining = self._remaining_ms(t_deadline)
         if remaining is not None and remaining <= 0:
             obs.counter("raft.fleet.deadline.total").inc()
@@ -280,7 +291,12 @@ class FleetRouter:
                 f"suspects={list(self.suspects())})"))
             return
         obs.counter("raft.fleet.route.total", replica=rep.name).inc()
-        with spans.span("raft.fleet.route", replica=rep.name,
+        # the route span stays open across srv.submit, so the replica's
+        # SearchServer captures it as the request's trace context (its
+        # raft.serve.request root parents here); remote_parent hooks
+        # THIS span under the upstream caller (HTTP handler / retries)
+        with spans.span("raft.fleet.route", remote_parent=trace_ctx,
+                        replica=rep.name,
                         nq=int(q.shape[0]), attempt=attempt):
             srv = rep.server
             try:
@@ -293,15 +309,16 @@ class FleetRouter:
                 inner = srv.submit(q, k=k, deadline_ms=remaining)
             except Exception as e:
                 self._on_failure(outer, q, k, t_deadline, attempt,
-                                 tried, rep, e)
+                                 tried, rep, e, trace_ctx)
                 return
         inner.add_done_callback(
             lambda f: self._complete(f, outer, q, k, t_deadline,
-                                     attempt, tried, rep))
+                                     attempt, tried, rep, trace_ctx))
 
     def _complete(self, inner: Future, outer: Future, q, k,
                   t_deadline: Optional[float], attempt: int,
-                  tried: frozenset, rep: Replica) -> None:
+                  tried: frozenset, rep: Replica,
+                  trace_ctx: Optional[str] = None) -> None:
         exc = inner.exception()
         if exc is None:
             if attempt:
@@ -310,11 +327,12 @@ class FleetRouter:
             outer.set_result(inner.result())
             return
         self._on_failure(outer, q, k, t_deadline, attempt, tried, rep,
-                         exc)
+                         exc, trace_ctx)
 
     def _on_failure(self, outer: Future, q, k,
                     t_deadline: Optional[float], attempt: int,
-                    tried: frozenset, rep: Replica, exc) -> None:
+                    tried: frozenset, rep: Replica, exc,
+                    trace_ctx: Optional[str] = None) -> None:
         # dispatch-class failures implicate the replica: out of the
         # routing set for suspect_ms. A shed (RejectedError) is load,
         # not sickness — reroute without suspecting. A deadline is the
@@ -333,7 +351,7 @@ class FleetRouter:
             return
         obs.counter("raft.fleet.retry.total").inc()
         self._dispatch(outer, q, k, t_deadline, attempt + 1,
-                       tried | {rep.name})
+                       tried | {rep.name}, trace_ctx=trace_ctx)
 
     # -- surfaces ----------------------------------------------------------
     def report(self) -> dict:

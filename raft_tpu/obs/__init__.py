@@ -17,11 +17,13 @@ three planes sharing ONE ``raft.<module>.<op>`` naming taxonomy:
   ``/healthz`` (comms health gauges) and ``/debug/requests`` (the
   recorder).
 
-Two further planes ride the same taxonomy and load lazily:
-:mod:`raft_tpu.obs.quality` (shadow-exact recall, ISSUE 11) and
+Further planes ride the same taxonomy and load lazily:
+:mod:`raft_tpu.obs.quality` (shadow-exact recall, ISSUE 11),
 :mod:`raft_tpu.obs.profiler` (sampled device-time attribution, duty
 cycle, HBM accounting — ISSUE 14; ``RAFT_TPU_PROFILE_SAMPLE``,
-``/debug/profile``).
+``/debug/profile``) and :mod:`raft_tpu.obs.federation` (cross-process
+metric federation + fleet rollup — ISSUE 16; ``obs.serve(
+federator=...)`` turns the endpoint into the fleet aggregator).
 
 Quick use::
 
@@ -66,6 +68,8 @@ from raft_tpu.obs.spans import (
     span,
     current_span,
     current_trace_id,
+    current_traceparent,
+    parse_traceparent,
     add_stage_spans,
     set_trace_enabled,
     trace_enabled,
@@ -100,6 +104,8 @@ __all__ = [
     "span",
     "current_span",
     "current_trace_id",
+    "current_traceparent",
+    "parse_traceparent",
     "add_stage_spans",
     "set_trace_enabled",
     "trace_enabled",

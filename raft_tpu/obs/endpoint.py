@@ -44,6 +44,35 @@ needs inspectable:
   ``raft.slo.*`` gauges otherwise. Breached objectives also degrade
   ``/healthz``.
 
+Fleet observability plane (ISSUE 16) — ``obs.serve(federator=fed)``
+turns this endpoint into the fleet AGGREGATOR:
+
+* ``GET /metrics`` then serves the federation-merged fleet body
+  (per-replica series under ``instance`` labels + summed rollups —
+  the aggregator's one-scrape fleet view; also at
+  ``GET /fleet/metrics``).
+* ``GET /fleet/healthz`` — worst-of fleet verdict: per-replica
+  verdicts, staleness, replication lag, the router's suspect set.
+* ``GET /fleet/trace?trace=<id>`` — the stitched cross-process
+  Chrome trace: local fragments + every URL instance's fragments
+  (:func:`raft_tpu.obs.recorder.stitch_from_endpoints`).
+* ``GET /debug/fleet`` gains a ``federation`` section (per-instance
+  scrape state, well-known per-replica gauges, scrape overhead).
+
+Trace propagation rides ``POST /search``: an incoming ``traceparent``
+header parents the handler's ``raft.serve.http`` span (and through it
+the whole routed request); the response carries the request's
+``trace_id`` (header + body) so a caller can fetch its stitched
+trace. ``GET /debug/requests?trace=<id>&all=1`` returns EVERY local
+fragment of a trace (``{"trace_id", "fragments", "now_unix"}``,
+always 200) — the wire format ``fetch_fragments`` consumes.
+
+Request handling is thread-per-connection (``ThreadingHTTPServer``)
+with a concurrency bound (``RAFT_TPU_ENDPOINT_THREADS``, default 8):
+a burst of slow debug fetches saturates the bound and further
+connections are refused at accept — a federator scrape can never
+head-of-line block ``POST /search`` into unbounded thread growth.
+
 Use::
 
     from raft_tpu import obs
@@ -60,6 +89,7 @@ beyond the host.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -250,9 +280,20 @@ class _Handler(BaseHTTPRequestHandler):
         path = url.path.rstrip("/") or "/"
         try:
             if path == "/metrics":
-                text = self.server.registry.to_prometheus_text()
+                fed = getattr(self.server, "federator", None)
+                # an aggregator's /metrics IS the fleet view: one
+                # scrape target for the whole fleet, per-replica
+                # series under instance labels, counters summed
+                text = (fed.merged_text() if fed is not None
+                        else self.server.registry.to_prometheus_text())
                 self._send(200, text.encode("utf-8"),
                            "text/plain; version=0.0.4")
+            elif path == "/fleet/metrics":
+                self._fleet_metrics()
+            elif path == "/fleet/healthz":
+                self._fleet_healthz()
+            elif path == "/fleet/trace":
+                self._fleet_trace(q)
             elif path == "/healthz":
                 body = _health_body(self.server.registry.snapshot())
                 self._send_json(200 if body["status"] == "ok" else 503,
@@ -278,6 +319,9 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(404, {"error": f"no route {path!r}",
                                       "routes": ["/metrics", "/healthz",
+                                                 "/fleet/metrics",
+                                                 "/fleet/healthz",
+                                                 "/fleet/trace",
                                                  "/debug/requests",
                                                  "/debug/slo",
                                                  "/debug/fleet",
@@ -320,22 +364,76 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request body: {e!r}"})
             return
         from raft_tpu.obs import spans as _spans
+        # cross-process propagation in: an upstream traceparent header
+        # parents this handler's span — and through the open span, the
+        # router/replica submits made inside it — under the caller
+        incoming = self.headers.get("traceparent")
+        trace_id = None
         try:
-            with _spans.span("raft.serve.http", route="/search"):
+            with _spans.span("raft.serve.http", remote_parent=incoming,
+                             route="/search") as sp:
+                trace_id = sp.trace_id or None
                 d, i = srv.search(queries, k=k, deadline_ms=deadline_ms)
         except RejectedError as e:
-            self._send_json(429, {"error": "rejected", "detail": str(e)})
+            self._send_json(429, {"error": "rejected", "detail": str(e),
+                                  "trace_id": trace_id})
             return
         except DeadlineExceeded as e:
-            self._send_json(504, {"error": "deadline", "detail": str(e)})
+            self._send_json(504, {"error": "deadline", "detail": str(e),
+                                  "trace_id": trace_id})
             return
         except Exception as e:
             self._send_json(500, {"error": type(e).__name__,
-                                  "detail": str(e)[:500]})
+                                  "detail": str(e)[:500],
+                                  "trace_id": trace_id})
             return
+        # propagation out: the trace id rides the response so the
+        # caller can fetch /fleet/trace?trace=<id> (or the fragments)
         self._send_json(200, {"distances": d.tolist(), "ids": i.tolist(),
                               "nq": len(i), "k": len(i[0]) if len(i)
-                              else 0})
+                              else 0, "trace_id": trace_id})
+
+    def _fleet_metrics(self) -> None:
+        fed = getattr(self.server, "federator", None)
+        if fed is None:
+            self._send_json(404, {"error": "no federator attached "
+                                           "(obs.serve(federator=...))"})
+            return
+        self._send(200, fed.merged_text().encode("utf-8"),
+                   "text/plain; version=0.0.4")
+
+    def _fleet_healthz(self) -> None:
+        fed = getattr(self.server, "federator", None)
+        if fed is None:
+            self._send_json(404, {"error": "no federator attached "
+                                           "(obs.serve(federator=...))"})
+            return
+        body = fed.healthz()
+        self._send_json(200 if body["status"] == "ok" else 503, body)
+
+    def _fleet_trace(self, q: dict) -> None:
+        """``GET /fleet/trace?trace=<id>`` — the stitched Chrome trace
+        of one routed request: local recorder fragments + every URL
+        instance's fragments fetched over ``/debug/requests?trace=&
+        all=1``."""
+        fed = getattr(self.server, "federator", None)
+        if fed is None:
+            self._send_json(404, {"error": "no federator attached "
+                                           "(obs.serve(federator=...))"})
+            return
+        trace_id = q.get("trace", [None])[0]
+        if not trace_id:
+            self._send_json(400, {"error": "trace=<id> is required"})
+            return
+        peers = fed.url_instances()
+        body = _recorder.stitch_from_endpoints(
+            trace_id, peers, recorder=self.server.recorder,
+            timeout_s=fed.timeout_s)
+        if not any(e.get("ph") == "X" for e in body["traceEvents"]):
+            self._send_json(404, {"error": f"trace {trace_id!r} not "
+                                           f"found on any instance"})
+            return
+        self._send_json(200, body)
 
     def _debug_fleet(self) -> None:
         """``GET /debug/fleet`` — the fleet router's full report when
@@ -343,8 +441,15 @@ class _Handler(BaseHTTPRequestHandler):
         state/load/route share, suspects), else reconstructed from the
         exported ``raft.fleet.*`` gauges."""
         router = getattr(self.server, "fleet", None)
+        fed = getattr(self.server, "federator", None)
         if router is not None:
-            self._send_json(200, router.report())
+            body = router.report()
+            if fed is not None:
+                body["federation"] = fed.report()
+            self._send_json(200, body)
+            return
+        if fed is not None:
+            self._send_json(200, {"federation": fed.report()})
             return
         gauges = self.server.registry.snapshot().get("gauges", {})
         fleet_g = {k: v for k, v in gauges.items()
@@ -367,6 +472,19 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._send_json(400, {"error": "n must be an integer"})
                 return
+        if trace_id is not None and \
+                q.get("all", ["0"])[0] not in ("0", "", "false"):
+            # the stitch wire format (recorder.fetch_fragments): every
+            # local fragment of the trace + our clock, ALWAYS 200 — a
+            # peer with no fragments is an answer, not an error
+            import time as _time
+            self._send_json(200, {
+                "trace_id": trace_id,
+                "fragments": rec.fragments(trace_id),
+                # skew estimation wants wall clock (see recorder)
+                "now_unix": _time.time(),  # graftlint: disable=GL005
+            })
+            return
         if trace_id is not None:
             trace = rec.get(trace_id)
             if trace is None:
@@ -405,7 +523,8 @@ class DebugServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, recorder=None, registry=None,
-                 searcher=None, fleet=None):
+                 searcher=None, fleet=None, federator=None,
+                 max_threads: Optional[int] = None):
         super().__init__(addr, _Handler)
         self.recorder = recorder if recorder is not None \
             else _recorder.RECORDER
@@ -416,7 +535,31 @@ class DebugServer(ThreadingHTTPServer):
         self.searcher = searcher
         # optional raft_tpu.fleet.FleetRouter backing GET /debug/fleet
         self.fleet = fleet
+        # optional obs.federation.MetricsFederator: makes this endpoint
+        # the fleet aggregator (/metrics merged, /fleet/*)
+        self.federator = federator
+        if max_threads is None:
+            try:
+                max_threads = int(os.environ.get(
+                    "RAFT_TPU_ENDPOINT_THREADS", "8"))
+            except ValueError:
+                max_threads = 8
+        # thread-per-connection with a hard bound: N slow debug
+        # fetches can occupy N threads, connection N+1 is refused
+        # instead of growing the pool without limit
+        self._slots = threading.BoundedSemaphore(max(1, max_threads))
         self._thread: Optional[threading.Thread] = None
+
+    def process_request_thread(self, request, client_address):
+        if not self._slots.acquire(timeout=0.5):
+            # saturated: drop the connection — the client sees a
+            # reset, not an unbounded queue behind a stuck handler
+            self.shutdown_request(request)
+            return
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._slots.release()
 
     @property
     def port(self) -> int:
@@ -450,14 +593,18 @@ class DebugServer(ThreadingHTTPServer):
 
 
 def serve(host: str = "127.0.0.1", port: int = 0, recorder=None,
-          registry=None, searcher=None, fleet=None) -> DebugServer:
+          registry=None, searcher=None, fleet=None,
+          federator=None) -> DebugServer:
     """Start the debug endpoint in a daemon thread → running
     :class:`DebugServer` (``.url``, ``.port``, ``.close()``).
     ``port=0`` binds an ephemeral port (tests, side-by-side procs).
     ``searcher`` (a :class:`raft_tpu.serve.SearchServer` or a
     :class:`raft_tpu.fleet.FleetRouter` — same call shape) enables the
     ``POST /search`` JSON route; ``fleet`` (a ``FleetRouter``) enables
-    the full ``GET /debug/fleet`` report."""
+    the full ``GET /debug/fleet`` report; ``federator`` (a
+    :class:`raft_tpu.obs.federation.MetricsFederator`) makes this the
+    fleet aggregator (merged ``/metrics``, ``/fleet/healthz``,
+    ``/fleet/trace``)."""
     return DebugServer((host, port), recorder=recorder,
                        registry=registry, searcher=searcher,
-                       fleet=fleet).start()
+                       fleet=fleet, federator=federator).start()

@@ -20,18 +20,37 @@ Knobs (read at construction):
 Exports: :meth:`FlightRecorder.to_json` (the ``/debug/requests``
 body) and :func:`to_chrome_trace` — any recorded trace as Chrome
 trace-event JSON, loadable in Perfetto / ``chrome://tracing``.
+
+Cross-process stitching (ISSUE 16): one routed request leaves trace
+FRAGMENTS in several recorders — the router's ``raft.fleet.route``
+root in its process, each replica's ``raft.serve.request`` root
+(remote-parented, same trace id) in its own.
+:meth:`FlightRecorder.fragments` finds every local fragment of a
+trace id, :func:`fetch_fragments` pulls a peer endpoint's fragments
+over ``/debug/requests?trace=<id>&all=1`` (estimating clock skew from
+the scrape round trip), and :func:`stitch_chrome_trace` merges them
+into ONE Chrome trace — one ``pid`` lane per fragment/instance,
+reusing the rank→pid convention, with each lane's estimated skew
+stamped as ``clock_skew_ms`` on its events rather than silently
+baked into the timestamps. :func:`stitch_from_endpoints` is the
+one-call form the debug endpoint serves at ``/fleet/trace``.
 """
 
 from __future__ import annotations
 
 import collections
+import json
 import os
 import threading
-from typing import List, Optional
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from raft_tpu.obs import registry as _registry
 
-__all__ = ["FlightRecorder", "RECORDER", "to_chrome_trace"]
+__all__ = ["FlightRecorder", "RECORDER", "to_chrome_trace",
+           "fetch_fragments", "stitch_chrome_trace",
+           "stitch_from_endpoints"]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -121,6 +140,20 @@ class FlightRecorder:
                     return t
         return None
 
+    def fragments(self, trace_id: str) -> List[dict]:
+        """EVERY recorded fragment of ``trace_id``, oldest first. A
+        remote-parented trace shares its id with the upstream root, so
+        one routed request can leave several fragments even in one
+        recorder (router root + N in-process replica roots). Dedupes
+        ring/slow by object identity."""
+        with self._lock:
+            seen_ids, out = set(), []
+            for t in list(self._ring) + list(self._slow):
+                if t.get("trace_id") == trace_id and id(t) not in seen_ids:
+                    seen_ids.add(id(t))
+                    out.append(t)
+        return out
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -146,6 +179,9 @@ class FlightRecorder:
             "slow_threshold_ms": self.slow_ms,
             "recorded_total": self.recorded_total,
             "slow_trace_ids": slow_ids,
+            # wall clock at export: the remote stitcher estimates this
+            # process's clock skew from it (see fetch_fragments)
+            "now_unix": time.time(),  # graftlint: disable=GL005
             "traces": traces,
         }
 
@@ -189,6 +225,134 @@ def to_chrome_trace(trace: dict) -> dict:
             "otherData": {"trace_id": trace.get("trace_id"),
                           "name": trace.get("name"),
                           "duration_ms": trace.get("duration_ms")}}
+
+
+def stitch_chrome_trace(fragments: Sequence[dict],
+                        instances: Optional[Sequence[str]] = None,
+                        skews_s: Optional[Sequence[float]] = None
+                        ) -> dict:
+    """Merge the fragments of ONE distributed trace into a single
+    Chrome trace. Each fragment gets its own ``pid`` lane (named after
+    ``instances[i]`` when given — the replica/router endpoint it came
+    from — reusing the rank→pid lane convention of
+    :func:`to_chrome_trace`). ``skews_s[i]`` is the estimated clock
+    skew of fragment *i*'s process (remote − local, seconds): it is
+    APPLIED to that lane's timestamps so the lanes line up, and
+    stamped on each of its events as ``clock_skew_ms`` so a reader
+    can tell corrected time from measured time. Fragment order is by
+    ``start_unix`` (skew-corrected), so the upstream root lane comes
+    first."""
+    frags = list(fragments)
+    n = len(frags)
+    insts = list(instances) if instances is not None else [""] * n
+    skews = list(skews_s) if skews_s is not None else [0.0] * n
+    order = sorted(
+        range(n),
+        key=lambda i: float(frags[i].get("start_unix", 0.0)) - skews[i])
+    trace_id = frags[order[0]].get("trace_id", "") if n else ""
+    events: List[dict] = []
+    total_spans = 0
+    for lane, i in enumerate(order):
+        frag, inst, skew = frags[i], insts[i], skews[i]
+        pid = lane
+        label = inst or frag.get("name", "") or f"fragment-{lane}"
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{label} {frag.get('trace_id', '')}"},
+        })
+        base_us = (float(frag.get("start_unix", 0.0)) - skew) * 1e6
+        skew_ms = round(skew * 1e3, 3)
+        for sp in frag.get("spans", ()):
+            args = {"trace_id": frag.get("trace_id"),
+                    "span_id": sp.get("span_id")}
+            if sp.get("parent_id"):
+                args["parent_id"] = sp["parent_id"]
+            if inst:
+                args["instance"] = inst
+            if skew_ms:
+                args["clock_skew_ms"] = skew_ms
+            args.update(sp.get("attrs", {}))
+            events.append({
+                "name": sp.get("name", ""),
+                "cat": "raft",
+                "ph": "X",
+                "ts": base_us + sp.get("t_start_ms", 0.0) * 1e3,
+                "dur": max(0.0, sp.get("duration_ms", 0.0) * 1e3),
+                "pid": pid,
+                "tid": int(sp.get("tid", 0)) % (1 << 31),
+                "args": args,
+            })
+            total_spans += 1
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": trace_id,
+                          "fragments": n,
+                          "spans": total_spans,
+                          "stitched": True}}
+
+
+def fetch_fragments(base_url: str, trace_id: str,
+                    timeout_s: float = 2.0
+                    ) -> Tuple[List[dict], float]:
+    """Pull one peer endpoint's fragments of ``trace_id`` over
+    ``GET /debug/requests?trace=<id>&all=1`` → ``(fragments,
+    skew_s)``. The skew estimate is the peer's export-time wall clock
+    minus the midpoint of our request round trip (the standard
+    NTP-style offset under a symmetric-delay assumption) — good to
+    ~half the round trip, which is plenty to line up millisecond
+    span lanes. Network errors raise (the caller decides whether a
+    missing peer is fatal)."""
+    url = (f"{base_url.rstrip('/')}/debug/requests"
+           f"?trace={trace_id}&all=1")
+    # wall-clock midpoint wants the same clock the peer exports
+    t0 = time.time()  # graftlint: disable=GL005
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        body = json.loads(resp.read().decode("utf-8"))
+    t1 = time.time()  # graftlint: disable=GL005
+    remote_now = float(body.get("now_unix", (t0 + t1) / 2.0))
+    skew_s = remote_now - (t0 + t1) / 2.0
+    return list(body.get("fragments", ())), skew_s
+
+
+def stitch_from_endpoints(trace_id: str,
+                          peers: Dict[str, str],
+                          recorder: Optional[FlightRecorder] = None,
+                          timeout_s: float = 2.0) -> dict:
+    """One-call stitch: local fragments (from ``recorder``, default
+    the process recorder) + every peer endpoint's fragments, merged
+    by :func:`stitch_chrome_trace`. ``peers`` maps instance name →
+    base URL. Unreachable peers contribute nothing (their absence is
+    recorded in ``otherData["unreachable"]``) — a stitch must degrade,
+    not fail, when a replica is down."""
+    # lazy import: spans depends on recorder (one-way), so the stitch
+    # span is opened via the module registry rather than a top import
+    from raft_tpu.obs import spans as _spans
+    with _spans.span("raft.obs.fed.stitch", peers=len(peers)) as sp:
+        frags: List[dict] = []
+        insts: List[str] = []
+        skews: List[float] = []
+        rec = recorder if recorder is not None else RECORDER
+        for f in rec.fragments(trace_id):
+            frags.append(f)
+            insts.append("local")
+            skews.append(0.0)
+        unreachable = []
+        for name, url in sorted(peers.items()):
+            try:
+                peer_frags, skew = fetch_fragments(
+                    url, trace_id, timeout_s=timeout_s)
+            except Exception:
+                unreachable.append(name)
+                continue
+            for f in peer_frags:
+                frags.append(f)
+                insts.append(name)
+                skews.append(skew)
+        out = stitch_chrome_trace(frags, instances=insts,
+                                  skews_s=skews)
+        out["otherData"]["unreachable"] = unreachable
+        sp.set_attrs(fragments=len(frags),
+                     unreachable=len(unreachable))
+    return out
 
 
 # the process-wide recorder every completed root span lands in; tests

@@ -339,18 +339,31 @@ def _prom_labels(key: Tuple[Tuple[str, str], ...], **extra) -> str:
     items = list(key) + sorted(extra.items())
     if not items:
         return ""
+    # exposition-format escapes, in spec order (backslash FIRST so the
+    # escapes it introduces are not re-escaped): \\ , \" , \n
     body = ",".join(
-        '{}="{}"'.format(k, str(v).replace("\\", r"\\").replace('"', r'\"'))
+        '{}="{}"'.format(k, str(v).replace("\\", r"\\")
+                         .replace('"', r'\"').replace("\n", r"\n"))
         for k, v in items)
     return "{" + body + "}"
 
 
 def _fmt(v: float) -> str:
+    """Prometheus sample-value rendering. Must be a true inverse of
+    ``float()`` over its image (the federation parser round-trips
+    exported text byte-stably): ±Inf and NaN use the exposition
+    spellings, integral floats drop the ``.0``, everything else uses
+    ``repr`` (shortest float round trip)."""
+    v = float(v)
     if v == math.inf:
         return "+Inf"
-    if float(v).is_integer() and abs(v) < 1e15:
+    if v == -math.inf:
+        return "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v.is_integer() and abs(v) < 1e15:
         return str(int(v))
-    return repr(float(v))
+    return repr(v)
 
 
 # the process-wide default registry every instrumented raft_tpu module

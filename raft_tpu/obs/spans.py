@@ -52,6 +52,20 @@ Semantics and caveats:
   deterministic tests).
 * **threads** — the active trace is thread-local; a trace never leaks
   across requests served on different threads.
+* **cross-process propagation** (ISSUE 16) — a span can be parented
+  across a thread or process boundary: :func:`current_traceparent`
+  renders the innermost open span as a W3C-style ``traceparent``
+  header value (``00-<trace_id>-<span_id>-01``), and ``span(name,
+  remote_parent=hdr)`` roots a NEW local trace that *adopts* the
+  remote trace id and records the remote span as its parent — the
+  replica-side ``raft.serve.request`` root becomes a child of the
+  router's ``raft.fleet.route`` span even when the two run in
+  different processes. Each side records its own trace *fragment*;
+  :func:`raft_tpu.obs.recorder.stitch_chrome_trace` merges fragments
+  sharing one trace id back into ONE Chrome trace. A remote-parented
+  root bypasses per-request sampling (the upstream root already made
+  the admission decision — a trace must never lose its tail to an
+  independent coin flip downstream).
 """
 
 from __future__ import annotations
@@ -71,6 +85,8 @@ __all__ = [
     "spanned",
     "current_span",
     "current_trace_id",
+    "current_traceparent",
+    "parse_traceparent",
     "add_stage_spans",
     "add_child_span",
     "set_trace_enabled",
@@ -135,10 +151,16 @@ class _TraceState:
     """Per-thread in-flight trace: the stack of open spans plus the
     records of finished ones."""
 
-    __slots__ = ("trace_id", "spans", "stack", "t0", "t0_unix")
+    __slots__ = ("trace_id", "spans", "stack", "t0", "t0_unix",
+                 "remote_parent")
 
-    def __init__(self):
-        self.trace_id = f"{os.getpid():x}-{_new_id()}"
+    def __init__(self, trace_id: Optional[str] = None,
+                 remote_parent: Optional[str] = None):
+        self.trace_id = (trace_id if trace_id is not None
+                         else f"{os.getpid():x}-{_new_id()}")
+        # span id of the remote parent this trace fragment hangs under
+        # (cross-process propagation, ISSUE 16); None for a local root
+        self.remote_parent = remote_parent
         self.spans: List[dict] = []
         self.stack: List["Span"] = []
         self.t0 = time.perf_counter()
@@ -151,9 +173,10 @@ class Span:
     """One open scope. Use via :func:`span`; context-manager only."""
 
     __slots__ = ("name", "attrs", "span_id", "parent_id", "trace_id",
-                 "_t0", "_trace", "_range", "_tid", "_root")
+                 "_t0", "_trace", "_range", "_tid", "_root", "_remote")
 
-    def __init__(self, name: str, attrs: Dict[str, object]):
+    def __init__(self, name: str, attrs: Dict[str, object],
+                 remote: Optional[Tuple[str, str]] = None):
         if not NAME_RE.match(name):
             raise ValueError(
                 f"span name {name!r} violates the raft.<module>.<op> "
@@ -168,6 +191,9 @@ class Span:
         self._range = None
         self._tid = 0
         self._root = False
+        # parsed (trace_id, span_id) of a remote parent — consumed only
+        # when this span roots a new trace
+        self._remote = remote
 
     # -- attributes --------------------------------------------------------
     def set_attr(self, key: str, value) -> None:
@@ -190,7 +216,14 @@ class Span:
     def __enter__(self) -> "Span":
         tr = getattr(_tls, "trace", None)
         if tr is None:
-            tr = _TraceState()
+            if self._remote is not None:
+                # adopt the remote trace id so every fragment of one
+                # routed request shares it; the remote span id becomes
+                # this root's parent link
+                tr = _TraceState(trace_id=self._remote[0],
+                                 remote_parent=self._remote[1])
+            else:
+                tr = _TraceState()
             _tls.trace = tr
             self._root = True
         self._trace = tr
@@ -198,6 +231,8 @@ class Span:
         self.span_id = _new_id()
         if tr.stack:
             self.parent_id = tr.stack[-1].span_id
+        elif tr.remote_parent is not None:
+            self.parent_id = tr.remote_parent
         tr.stack.append(self)
         self._tid = threading.get_ident()
         # the span IS the profiler range (shared taxonomy): cheap no-op
@@ -290,21 +325,34 @@ class _VetoSpan(_NullSpan):
 _VETO_SPAN = _VetoSpan()
 
 
-def span(name: str, **attrs) -> Span:
+def span(name: str, remote_parent: Optional[str] = None,
+         **attrs) -> Span:
     """Open a span named under the ``raft.<module>.<op>`` taxonomy.
     Returns the shared null object when tracing is disabled, or when
     this would start a new trace and per-request sampling
-    (``RAFT_TPU_TRACE_SAMPLE``) rejects it."""
+    (``RAFT_TPU_TRACE_SAMPLE``) rejects it.
+
+    ``remote_parent`` (a :func:`current_traceparent` value, usually
+    carried in an HTTP header or a ``submit(trace_context=...)``
+    field) parents the span across a process/thread boundary: when
+    this span roots a new trace, the trace adopts the remote trace id
+    and the span records the remote span as its parent — and sampling
+    is bypassed (the upstream root already admitted the request).
+    Ignored when a trace is already open on this thread (a nested span
+    has a real local parent) or when the value is malformed
+    (propagation must never fail a request)."""
     if not _enabled:
         return _NULL_SPAN
-    if getattr(_tls, "trace", None) is None:
+    remote = (parse_traceparent(remote_parent)
+              if remote_parent is not None else None)
+    if getattr(_tls, "trace", None) is None and remote is None:
         # root-span admission: one Bernoulli draw per request; the
         # veto depth extends a rejection to the whole request
         if getattr(_tls, "veto", 0):
             return _VETO_SPAN
         if _sample_rate < 1.0 and _sample_rng.random() >= _sample_rate:
             return _VETO_SPAN
-    return Span(name, attrs)
+    return Span(name, attrs, remote=remote)
 
 
 def spanned(name: str, **attrs):
@@ -338,6 +386,43 @@ def current_span():
 def current_trace_id() -> Optional[str]:
     tr = getattr(_tls, "trace", None)
     return tr.trace_id if tr is not None else None
+
+
+def current_traceparent() -> Optional[str]:
+    """Render the innermost open span as a W3C-style ``traceparent``
+    value (``00-<trace_id>-<span_id>-01``) for cross-process
+    propagation, or None when no span is open (or tracing is off).
+    The flags byte is always ``01`` (sampled): an open span means the
+    admission decision already said yes."""
+    if not _enabled:
+        return None
+    tr = getattr(_tls, "trace", None)
+    if tr is None or not tr.stack:
+        return None
+    return f"00-{tr.trace_id}-{tr.stack[-1].span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """Parse a ``traceparent`` value into ``(trace_id, span_id)``, or
+    None when missing/malformed — propagation must never fail a
+    request. Lenient on the trace-id charset because our ids embed a
+    dash (``{pid:x}-{counter:08x}``): split the version off the front,
+    then the span id + flags off the back, and the middle is the trace
+    id verbatim."""
+    if not header:
+        return None
+    try:
+        version, rest = header.strip().split("-", 1)
+        trace_id, span_id, _flags = rest.rsplit("-", 2)
+    except ValueError:
+        return None
+    if version != "00" or not trace_id or not span_id:
+        return None
+    if len(_flags) != 2 or not all(c in "0123456789abcdefABCDEF"
+                                   for c in _flags):
+        return None
+    return trace_id, span_id
 
 
 def add_stage_spans(stages: Sequence[Tuple[str, float]], total_s: float,
@@ -412,6 +497,10 @@ def _finalize(tr: _TraceState, root: Span, dur_s: float) -> None:
     }
     if root.attrs:
         trace["attrs"] = dict(root.attrs)
+    if tr.remote_parent is not None:
+        # marks this trace as a child FRAGMENT of a remote trace; the
+        # stitcher uses it to tell router-side roots from replica-side
+        trace["remote_parent"] = tr.remote_parent
     # lazy import: recorder depends on registry/logger only, so the
     # dependency between the two obs submodules stays one-way
     from raft_tpu.obs import recorder as _recorder

@@ -332,12 +332,20 @@ class SearchServer:
 
     # -- admission ---------------------------------------------------------
     def submit(self, queries, k: Optional[int] = None,
-               deadline_ms: Optional[float] = None):
+               deadline_ms: Optional[float] = None,
+               trace_context: Optional[str] = None):
         """Enqueue one request → ``Future`` resolving to ``(dists,
         ids)``, each ``(nq, k)`` numpy arrays. Admission is decided NOW:
         a full queue or a closed server fails the future immediately
         with :class:`RejectedError` (explicit backpressure, never
-        unbounded growth)."""
+        unbounded growth).
+
+        ``trace_context`` is an optional ``traceparent`` value; when
+        omitted it defaults to the caller thread's innermost open span
+        (so a submit made under a router's ``raft.fleet.route`` span —
+        or any other span — automatically parents this request's
+        ``raft.serve.request`` root, which otherwise opens on the
+        dispatcher thread with no trace of its own)."""
         q = np.asarray(queries, np.float32)
         if q.ndim == 1:
             q = q[None, :]
@@ -356,10 +364,13 @@ class SearchServer:
         if deadline_ms is None:
             deadline_ms = self._cfg.default_deadline_ms
         now = time.perf_counter()
+        if trace_context is None:
+            trace_context = spans.current_traceparent()
         req = _Request(queries=q, nq=nq, k=k, t_enq=now,
                        deadline=(now + deadline_ms / 1e3
                                  if deadline_ms and deadline_ms > 0
-                                 else None))
+                                 else None),
+                       trace_ctx=trace_context)
         obs.counter("raft.serve.requests.total").inc()
         obs.counter("raft.serve.queries.total").inc(nq)
         with self._cond:
@@ -441,7 +452,9 @@ class SearchServer:
         obs.counter("raft.serve.shed.total", reason=reason).inc()
         self._shed_times.append(time.monotonic())
         self._update_shed_rate_locked()
-        with spans.span("raft.serve.request", nq=req.nq, k=req.k,
+        with spans.span("raft.serve.request",
+                        remote_parent=req.trace_ctx,
+                        nq=req.nq, k=req.k,
                         outcome="shed", reason=reason):
             pass
         req.future.set_exception(RejectedError(
@@ -471,7 +484,9 @@ class SearchServer:
     def _fail_deadline(self, req: _Request, now: float) -> None:
         waited_ms = round((now - req.t_enq) * 1e3, 3)
         obs.counter("raft.serve.deadline.total").inc()
-        with spans.span("raft.serve.request", nq=req.nq, k=req.k,
+        with spans.span("raft.serve.request",
+                        remote_parent=req.trace_ctx,
+                        nq=req.nq, k=req.k,
                         outcome="deadline", waited_ms=waited_ms):
             spans.add_child_span("raft.serve.queue_wait", req.t_enq,
                                  now - req.t_enq)
@@ -738,7 +753,9 @@ class SearchServer:
             # per-request root trace: queue-wait + (shared) execution
             # children under one raft.serve.request root — the flight
             # recorder shows each caller's story, batch sharing included
-            with spans.span("raft.serve.request", nq=r.nq, k=r.k,
+            with spans.span("raft.serve.request",
+                            remote_parent=r.trace_ctx,
+                            nq=r.nq, k=r.k,
                             outcome="partial" if partial else "ok",
                             level=level, batch_shape=shape,
                             latency_ms=round(lat * 1e3, 3)):

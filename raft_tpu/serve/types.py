@@ -203,3 +203,7 @@ class _Request:
     future: Future = field(default_factory=Future)
     t_enq: float = 0.0          # perf_counter at admission
     deadline: Optional[float] = None   # absolute perf_counter, or None
+    # traceparent captured at admission (cross-process propagation,
+    # ISSUE 16): the dispatcher-thread root span adopts it so the
+    # replica fragment hangs under the router's route span
+    trace_ctx: Optional[str] = None

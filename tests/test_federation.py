@@ -1,0 +1,685 @@
+"""Tests for the fleet observability plane (ISSUE 16): the
+Prometheus exposition round trip (byte-stable, +Inf buckets, label
+escaping, NaN/±Inf gauges), instance-label merge semantics per
+instrument kind, federator staleness (a killed replica reads as
+absent, never frozen-healthy — with zero federator hangs), traceparent
+propagation through router + replica, cross-endpoint trace stitching
+into ONE Chrome trace that passes ``check_metric_names --trace``, and
+the aggregator endpoint routes (/metrics merged, /fleet/healthz,
+/fleet/trace, /debug/requests?all=1)."""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import fleet, obs
+from raft_tpu.obs import endpoint as endpoint_mod
+from raft_tpu.obs import federation as fed_mod
+from raft_tpu.obs import recorder as recorder_mod
+from raft_tpu.obs import spans
+from raft_tpu.obs.registry import MetricsRegistry
+from raft_tpu.serve import SearchServer, ServeConfig
+from raft_tpu.serve.ladder import PlanLadder
+from raft_tpu.testing import faults
+from tools.check_metric_names import lint_chrome_trace
+
+
+@pytest.fixture
+def tracing():
+    """Tracing on + a clean global recorder, state restored after."""
+    prev = spans.trace_enabled()
+    spans.set_trace_enabled(True)
+    obs.RECORDER.clear()
+    yield obs.RECORDER
+    obs.RECORDER.clear()
+    spans.set_trace_enabled(prev)
+
+
+class _FakePlan:
+    """Deterministic plan: each row's first feature echoed as id."""
+
+    def __init__(self, nq, n_probes, k=4):
+        self.nq = nq
+        self.n_probes = n_probes
+        self.k = k
+
+    def search(self, q, block=True):
+        m = np.asarray(q)[:, :1]
+        return (np.repeat(m.astype(np.float32), self.k, axis=1),
+                np.repeat(m.astype(np.int64), self.k, axis=1))
+
+
+def _fake_server(shapes=(1, 4), max_wait_ms=0.5):
+    plans = {(s, 0): _FakePlan(s, 8) for s in shapes}
+    ladder = PlanLadder(shapes=shapes, rungs=(8,), plans=plans, dim=4,
+                        k=4)
+    return SearchServer(ladder, ServeConfig(batch_sizes=shapes,
+                                            max_wait_ms=max_wait_ms))
+
+
+def _get_json(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# exposition round trip (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    def test_exporter_parser_exporter_byte_stable(self):
+        r = MetricsRegistry()
+        r.counter("raft.t.requests.total", help="requests").inc(5)
+        r.counter("raft.t.shed.total", reason="queue_full").inc(2)
+        r.gauge("raft.t.depth").set(3)
+        r.gauge("raft.t.frac").set(0.25)
+        h = r.histogram("raft.t.lat.seconds", buckets=(0.01, 0.1, 1.0))
+        h.observe(0.05)
+        h.observe(50.0)  # lands in the +Inf bucket only
+        text = r.to_prometheus_text()
+        fams = fed_mod.parse_prometheus_text(text)
+        assert fed_mod.render_prometheus_text(fams) == text
+
+    def test_label_escaping_round_trips(self):
+        r = MetricsRegistry()
+        nasty = 'quote:" backslash:\\ newline:\n mixed:\\n'
+        r.gauge("raft.t.weird", note=nasty).set(1)
+        text = r.to_prometheus_text()
+        assert "\n" == text[-1]
+        # escaped newline, not a literal line break mid-sample
+        assert r'newline:\n' in text
+        fams = fed_mod.parse_prometheus_text(text)
+        assert fed_mod.render_prometheus_text(fams) == text
+        (sample,) = fams[0].samples
+        assert dict(sample.labels)["note"] == nasty
+
+    def test_nan_and_inf_gauges_round_trip(self):
+        r = MetricsRegistry()
+        r.gauge("raft.t.nan").set(float("nan"))
+        r.gauge("raft.t.pinf").set(float("inf"))
+        r.gauge("raft.t.ninf").set(float("-inf"))
+        text = r.to_prometheus_text()
+        assert "raft_t_nan NaN" in text
+        assert "raft_t_pinf +Inf" in text
+        assert "raft_t_ninf -Inf" in text
+        fams = fed_mod.parse_prometheus_text(text)
+        assert fed_mod.render_prometheus_text(fams) == text
+        by_name = {f.name: f for f in fams}
+        assert math.isnan(by_name["raft_t_nan"].samples[0].value)
+        assert by_name["raft_t_pinf"].samples[0].value == math.inf
+
+    def test_plus_inf_bucket_emitted_and_parsed(self):
+        r = MetricsRegistry()
+        h = r.histogram("raft.t.lat.seconds", buckets=(0.1,))
+        h.observe(5.0)
+        text = r.to_prometheus_text()
+        assert 'le="+Inf"} 1' in text
+        fams = fed_mod.parse_prometheus_text(text)
+        assert fed_mod.render_prometheus_text(fams) == text
+
+    def test_live_registry_round_trips(self):
+        # the process-global registry, with whatever the suite has
+        # accumulated — the real-world pin
+        text = obs.to_prometheus_text()
+        fams = fed_mod.parse_prometheus_text(text)
+        assert fed_mod.render_prometheus_text(fams) == text
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+
+class TestMerge:
+    def _two(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("raft.t.reqs.total").inc(5)
+        b.counter("raft.t.reqs.total").inc(7)
+        a.gauge("raft.t.depth").set(3)
+        b.gauge("raft.t.depth").set(9)
+        for reg, v in ((a, 0.05), (b, 0.5)):
+            reg.histogram("raft.t.lat.seconds",
+                          buckets=(0.1, 1.0)).observe(v)
+        return (fed_mod.parse_prometheus_text(a.to_prometheus_text()),
+                fed_mod.parse_prometheus_text(b.to_prometheus_text()))
+
+    def test_counters_sum_under_instance_labels(self):
+        fa, fb = self._two()
+        text = fed_mod.render_prometheus_text(
+            fed_mod.merge_families({"a": fa, "b": fb}))
+        assert 'raft_t_reqs_total_total{instance="a"} 5' in text
+        assert 'raft_t_reqs_total_total{instance="b"} 7' in text
+        assert "\nraft_t_reqs_total_total 12" in text
+
+    def test_gauges_stay_per_instance_no_text_rollup(self):
+        fa, fb = self._two()
+        text = fed_mod.render_prometheus_text(
+            fed_mod.merge_families({"a": fa, "b": fb}))
+        assert 'raft_t_depth{instance="a"} 3' in text
+        assert 'raft_t_depth{instance="b"} 9' in text
+        assert "\nraft_t_depth 12" not in text
+
+    def test_histogram_buckets_add(self):
+        fa, fb = self._two()
+        text = fed_mod.render_prometheus_text(
+            fed_mod.merge_families({"a": fa, "b": fb}))
+        assert 'raft_t_lat_seconds_bucket{instance="a",le="0.1"} 1' \
+            in text
+        assert 'raft_t_lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'raft_t_lat_seconds_bucket{le="1"} 2' in text
+        assert 'raft_t_lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "\nraft_t_lat_seconds_count 2" in text
+
+    def test_existing_instance_label_becomes_exported_instance(self):
+        # a scraped target that itself carries an `instance` label
+        # (a downstream federator's self-metrics; the shared-registry
+        # single-process fleet) must not yield a duplicate label key
+        a = MetricsRegistry()
+        a.counter("raft.t.fed.scrapes.total", instance="inner").inc(3)
+        fa = fed_mod.parse_prometheus_text(a.to_prometheus_text())
+        merged = fed_mod.merge_families({"outer": fa})
+        text = fed_mod.render_prometheus_text(merged)
+        assert ('raft_t_fed_scrapes_total_total'
+                '{exported_instance="inner",instance="outer"} 3'
+                in text)
+        # the rollup gets the same rename — the inner target's
+        # `instance` never reappears as OUR instance dimension
+        assert ('\nraft_t_fed_scrapes_total_total'
+                '{exported_instance="inner"} 3' in text)
+        assert 'instance="inner"}' not in text.replace(
+            'exported_instance="inner"', "")
+        # the output stays parseable and byte-stable
+        assert fed_mod.render_prometheus_text(
+            fed_mod.parse_prometheus_text(text)) == text
+
+    def test_merge_keeps_existing_labels(self):
+        a = MetricsRegistry()
+        a.counter("raft.t.shed.total", reason="full").inc(2)
+        fa = fed_mod.parse_prometheus_text(a.to_prometheus_text())
+        text = fed_mod.render_prometheus_text(
+            fed_mod.merge_families({"x": fa}))
+        assert ('raft_t_shed_total_total{instance="x",reason="full"} 2'
+                in text)
+        assert '\nraft_t_shed_total_total{reason="full"} 2' in text
+
+
+# ---------------------------------------------------------------------------
+# federator: scrape, staleness, chaos
+# ---------------------------------------------------------------------------
+
+
+class TestFederator:
+    def test_scrapes_registries_and_merges(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("raft.t.reqs.total").inc(1)
+        b.counter("raft.t.reqs.total").inc(2)
+        fed = fed_mod.MetricsFederator({"a": a, "b": b},
+                                       interval_s=60.0)
+        out = fed.scrape_once()
+        assert out == {"scraped": 2, "errors": 0}
+        assert fed.live_instances() == ["a", "b"]
+        assert "\nraft_t_reqs_total_total 3" in fed.merged_text()
+
+    def test_scrapes_http_endpoints(self, tracing):
+        reg = MetricsRegistry()
+        reg.counter("raft.t.reqs.total").inc(4)
+        srv = endpoint_mod.serve(registry=reg)
+        try:
+            fed = fed_mod.MetricsFederator({"r0": srv.url},
+                                           interval_s=60.0)
+            assert fed.scrape_once()["errors"] == 0
+            assert ('raft_t_reqs_total_total{instance="r0"} 4'
+                    in fed.merged_text())
+        finally:
+            srv.close()
+
+    def test_dead_replica_goes_stale_absent_not_frozen(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.gauge("raft.t.depth").set(1)
+        b.gauge("raft.t.depth").set(2)
+        fed = fed_mod.MetricsFederator({"a": a, "b": b},
+                                       interval_s=60.0,
+                                       stale_after_s=0.05)
+        fed.scrape_once()
+        assert fed.stale_instances() == []
+        # "kill" b: every further scrape of it fails
+        with faults.inject_fault("fed.scrape", error=RuntimeError,
+                                 match={"instance": "b"}):
+            time.sleep(0.08)
+            fed.scrape_once()
+        text = fed.merged_text()
+        assert 'raft_t_depth{instance="a"} 1' in text
+        # b aged out: ABSENT — the frozen value 2 must NOT reappear
+        assert 'instance="b"' not in text
+        assert fed.stale_instances() == ["b"]
+        assert fed.healthz()["status"] == "degraded"
+        assert "b" in fed.healthz()["stale"]
+
+    def test_kill_mid_scrape_no_hang_and_counted(self):
+        a = MetricsRegistry()
+        a.counter("raft.t.reqs.total").inc(1)
+        before = obs.snapshot()["counters"]
+        fed = fed_mod.MetricsFederator({"a": a}, interval_s=60.0,
+                                       stale_after_s=0.01)
+        done = threading.Event()
+
+        def sweep():
+            with faults.inject_fault("fed.scrape",
+                                     error=RuntimeError):
+                fed.scrape_once()
+            done.set()
+
+        t = threading.Thread(target=sweep, daemon=True)
+        t.start()
+        assert done.wait(5.0), "federator hung on a failing scrape"
+        diff = obs.snapshot()["counters"]
+        key = "raft.obs.fed.scrape.errors{instance=a}"
+        assert diff.get(key, 0) - before.get(key, 0) >= 1
+        assert fed.stale_instances() == ["a"]
+
+    def test_unreachable_endpoint_times_out_no_hang(self):
+        # a port nothing listens on: connection refused fast, scrape
+        # is an error, the sweep returns
+        fed = fed_mod.MetricsFederator(
+            {"gone": "http://127.0.0.1:9"}, interval_s=60.0,
+            timeout_s=0.5)
+        t0 = time.monotonic()
+        out = fed.scrape_once()
+        assert out["errors"] == 1
+        assert time.monotonic() - t0 < 5.0
+        assert fed.merged_text() == ""
+
+    def test_scraper_thread_runs_on_cadence(self):
+        a = MetricsRegistry()
+        a.gauge("raft.t.depth").set(1)
+        fed = fed_mod.MetricsFederator({"a": a}, interval_s=0.05)
+        with fed:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if fed.report()["instances"].get("a", {}) \
+                        .get("scrapes", 0) >= 2:
+                    break
+                time.sleep(0.02)
+        assert fed.report()["instances"]["a"]["scrapes"] >= 2
+
+    def test_report_gauge_rollups_and_overhead(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.gauge("raft.t.depth").set(1)
+        b.gauge("raft.t.depth").set(5)
+        fed = fed_mod.MetricsFederator({"a": a, "b": b},
+                                       interval_s=60.0)
+        fed.scrape_once()
+        rep = fed.report()
+        roll = rep["gauge_rollups"]["raft_t_depth"]
+        assert roll == {"sum": 6, "min": 1, "max": 5}
+        assert rep["scrape_overhead"]["frac"] >= 0.0
+        assert rep["instances"]["a"]["state"] == "live"
+
+
+# ---------------------------------------------------------------------------
+# traceparent propagation (tentpole a)
+# ---------------------------------------------------------------------------
+
+
+class TestTracePropagation:
+    def test_current_traceparent_and_parse(self, tracing):
+        assert spans.current_traceparent() is None
+        with spans.span("raft.t.root") as sp:
+            hdr = spans.current_traceparent()
+            assert hdr == f"00-{sp.trace_id}-{sp.span_id}-01"
+            assert spans.parse_traceparent(hdr) == (sp.trace_id,
+                                                    sp.span_id)
+
+    def test_malformed_traceparent_never_fails(self, tracing):
+        for bad in (None, "", "junk", "00-x", "01-a-b-c", "00--x-01"):
+            assert spans.parse_traceparent(bad) is None
+        with spans.span("raft.t.root", remote_parent="garbage") as sp:
+            assert sp.trace_id  # fresh local trace
+
+    def test_remote_parent_adopts_trace_and_parents(self, tracing):
+        box = {}
+        with spans.span("raft.t.upstream") as up:
+            box["hdr"] = spans.current_traceparent()
+            box["tid"] = up.trace_id
+            box["sid"] = up.span_id
+
+        def downstream():
+            with spans.span("raft.t.downstream",
+                            remote_parent=box["hdr"]):
+                pass
+
+        t = threading.Thread(target=downstream)
+        t.start()
+        t.join()
+        frags = obs.RECORDER.fragments(box["tid"])
+        assert len(frags) == 2
+        child = [f for f in frags if f["name"] == "raft.t.downstream"][0]
+        assert child["remote_parent"] == box["sid"]
+        assert child["spans"][0]["parent_id"] == box["sid"]
+
+    def test_remote_parent_bypasses_sampling(self, tracing):
+        with spans.span("raft.t.upstream"):
+            hdr = spans.current_traceparent()
+        spans.set_trace_sample_rate(0.0, seed=7)
+        try:
+            n0 = obs.RECORDER.recorded_total
+
+            def downstream():
+                with spans.span("raft.t.downstream",
+                                remote_parent=hdr):
+                    pass
+
+            t = threading.Thread(target=downstream)
+            t.start()
+            t.join()
+            assert obs.RECORDER.recorded_total == n0 + 1
+        finally:
+            spans.set_trace_sample_rate(1.0)
+
+    def test_nested_span_ignores_remote_parent(self, tracing):
+        with spans.span("raft.t.root") as root:
+            with spans.span("raft.t.child",
+                            remote_parent="00-other-ff-01") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+
+    def test_routed_request_one_trace_replica_under_route(self,
+                                                          tracing):
+        """One FleetRouter request → the replica's raft.serve.request
+        root shares the router's trace id and parents under the
+        raft.fleet.route span."""
+        reps = [fleet.Replica("r0", _fake_server()),
+                fleet.Replica("r1", _fake_server())]
+        router = fleet.FleetRouter(reps, fleet.FleetConfig())
+        try:
+            with spans.span("raft.t.client") as client:
+                tid = client.trace_id
+                d, i = router.submit(_rows_one()).result(timeout=10.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                frags = obs.RECORDER.fragments(tid)
+                if len(frags) >= 2:
+                    break
+                time.sleep(0.01)
+            frags = obs.RECORDER.fragments(tid)
+            names = {f["name"] for f in frags}
+            assert "raft.serve.request" in names, names
+            outer = [f for f in frags if f["name"] == "raft.t.client"][0]
+            route_sp = [s for s in outer["spans"]
+                        if s["name"] == "raft.fleet.route"][0]
+            req = [f for f in frags
+                   if f["name"] == "raft.serve.request"][0]
+            assert req["remote_parent"] == route_sp["span_id"]
+            assert req["spans"][-1]["parent_id"] == route_sp["span_id"]
+        finally:
+            router.close()
+
+
+def _rows_one():
+    out = np.zeros((1, 4), np.float32)
+    out[0, 0] = 3.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# stitching (tentpole a, across two real endpoints)
+# ---------------------------------------------------------------------------
+
+
+class TestStitching:
+    def test_fragments_and_local_stitch(self, tracing):
+        box = {}
+        with spans.span("raft.t.upstream") as up:
+            box["hdr"] = spans.current_traceparent()
+            tid = up.trace_id
+
+        def downstream():
+            with spans.span("raft.t.downstream",
+                            remote_parent=box["hdr"]):
+                pass
+
+        t = threading.Thread(target=downstream)
+        t.start()
+        t.join()
+        frags = obs.RECORDER.fragments(tid)
+        chrome = recorder_mod.stitch_chrome_trace(
+            frags, instances=["router", "replica"],
+            skews_s=[0.0, 0.25])
+        evs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+        assert len(evs) == 2
+        assert len({e["pid"] for e in evs}) == 2
+        skewed = [e for e in evs
+                  if e["args"].get("clock_skew_ms")]
+        assert len(skewed) == 1
+        assert skewed[0]["args"]["clock_skew_ms"] == 250.0
+        assert lint_chrome_trace(json.dumps(chrome)) == []
+
+    def test_two_real_endpoints_one_merged_chrome_trace(self, tracing):
+        """The satellite contract: router registry + replica registry
+        behind two REAL endpoints in one process; one routed request
+        yields one merged Chrome trace that passes
+        ``check_metric_names --trace``, replica root parented under
+        the route span."""
+        # replica-side recorder behind its own endpoint
+        rep_reg = MetricsRegistry()
+        rep_rec = recorder_mod.FlightRecorder(registry=rep_reg)
+        rep_srv = endpoint_mod.serve(registry=rep_reg,
+                                     recorder=rep_rec)
+        # router-side recorder behind the aggregator endpoint
+        rtr_rec = recorder_mod.FlightRecorder()
+        fed = fed_mod.MetricsFederator({"replica0": rep_srv.url},
+                                       interval_s=60.0)
+        agg = endpoint_mod.serve(recorder=rtr_rec, federator=fed)
+        try:
+            box = {}
+            with spans.span("raft.fleet.route", replica="r0") as rt:
+                box["hdr"] = spans.current_traceparent()
+                tid = rt.trace_id
+                route_sid = rt.span_id
+
+            def replica_side():
+                with spans.span("raft.serve.request",
+                                remote_parent=box["hdr"], nq=1):
+                    pass
+
+            t = threading.Thread(target=replica_side)
+            t.start()
+            t.join()
+            # split the two fragments across the two "processes"
+            for f in obs.RECORDER.fragments(tid):
+                (rep_rec if f.get("remote_parent") else
+                 rtr_rec).record(f)
+
+            code, body = _get_json(
+                f"{agg.url}/fleet/trace?trace={tid}")
+            assert code == 200
+            evs = [e for e in body["traceEvents"] if e["ph"] == "X"]
+            by_name = {e["name"]: e for e in evs}
+            assert set(by_name) == {"raft.fleet.route",
+                                    "raft.serve.request"}
+            # distinct lanes, correct cross-process parent link
+            assert (by_name["raft.fleet.route"]["pid"]
+                    != by_name["raft.serve.request"]["pid"])
+            assert (by_name["raft.serve.request"]["args"]["parent_id"]
+                    == route_sid)
+            assert body["otherData"]["fragments"] == 2
+            assert lint_chrome_trace(json.dumps(body)) == []
+        finally:
+            agg.close()
+            rep_srv.close()
+
+    def test_stitch_degrades_on_unreachable_peer(self, tracing):
+        with spans.span("raft.t.upstream") as up:
+            tid = up.trace_id
+        chrome = recorder_mod.stitch_from_endpoints(
+            tid, {"gone": "http://127.0.0.1:9"}, timeout_s=0.5)
+        assert chrome["otherData"]["unreachable"] == ["gone"]
+        evs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+        assert len(evs) == 1  # the local fragment still renders
+
+
+# ---------------------------------------------------------------------------
+# aggregator endpoint routes (tentpole b)
+# ---------------------------------------------------------------------------
+
+
+class TestAggregatorEndpoint:
+    def test_metrics_merged_when_federator_attached(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("raft.serve.completed.total").inc(3)
+        b.counter("raft.serve.completed.total").inc(4)
+        fed = fed_mod.MetricsFederator({"a": a, "b": b},
+                                       interval_s=60.0)
+        fed.scrape_once()
+        srv = endpoint_mod.serve(federator=fed)
+        try:
+            with urllib.request.urlopen(f"{srv.url}/metrics",
+                                        timeout=5.0) as resp:
+                text = resp.read().decode()
+            assert ('raft_serve_completed_total_total{instance="a"} 3'
+                    in text)
+            assert "\nraft_serve_completed_total_total 7" in text
+            # /fleet/metrics is the explicit alias
+            with urllib.request.urlopen(f"{srv.url}/fleet/metrics",
+                                        timeout=5.0) as resp:
+                assert resp.read().decode() == text
+        finally:
+            srv.close()
+
+    def test_fleet_healthz_worst_of(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.gauge("raft.t.x").set(1)
+        b.gauge("raft.t.x").set(1)
+        fed = fed_mod.MetricsFederator({"a": a, "b": b},
+                                       interval_s=60.0,
+                                       stale_after_s=0.05)
+        fed.scrape_once()
+        srv = endpoint_mod.serve(federator=fed)
+        try:
+            code, body = _get_json(f"{srv.url}/fleet/healthz")
+            assert code == 200 and body["status"] == "ok"
+            assert set(body["instances"]) == {"a", "b"}
+            # kill b: it ages out, the fleet verdict degrades
+            with faults.inject_fault("fed.scrape", error=RuntimeError,
+                                     match={"instance": "b"}):
+                time.sleep(0.08)
+                fed.scrape_once()
+            code, body = _get_json(f"{srv.url}/fleet/healthz")
+            assert code == 503 and body["status"] == "degraded"
+            assert body["instances"]["b"]["status"] == "stale"
+            assert body["instances"]["a"]["status"] == "ok"
+        finally:
+            srv.close()
+
+    def test_debug_requests_all_param_wire_format(self, tracing):
+        with spans.span("raft.t.upstream") as up:
+            tid = up.trace_id
+        srv = endpoint_mod.serve()
+        try:
+            code, body = _get_json(
+                f"{srv.url}/debug/requests?trace={tid}&all=1")
+            assert code == 200
+            assert body["trace_id"] == tid
+            assert len(body["fragments"]) == 1
+            assert body["now_unix"] > 0
+            # unknown trace: STILL 200, empty — absence is an answer
+            code, body = _get_json(
+                f"{srv.url}/debug/requests?trace=nope&all=1")
+            assert code == 200 and body["fragments"] == []
+        finally:
+            srv.close()
+
+    def test_debug_fleet_federation_section(self):
+        a = MetricsRegistry()
+        a.gauge("raft.t.x").set(1)
+        fed = fed_mod.MetricsFederator({"a": a}, interval_s=60.0)
+        fed.scrape_once()
+        srv = endpoint_mod.serve(federator=fed)
+        try:
+            code, body = _get_json(f"{srv.url}/debug/fleet")
+            assert code == 200
+            sec = body["federation"]
+            assert sec["instances"]["a"]["state"] == "live"
+            assert "scrape_overhead" in sec
+        finally:
+            srv.close()
+
+    def test_search_response_carries_trace_id(self, tracing):
+        srv = _fake_server()
+        web = endpoint_mod.serve(searcher=srv)
+        try:
+            req = urllib.request.Request(
+                f"{web.url}/search",
+                data=json.dumps({"queries": [[3, 0, 0, 0]]})
+                .encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                body = json.loads(resp.read().decode())
+            assert body["trace_id"]
+            # the handler root + the request fragment share the id
+            frags = obs.RECORDER.fragments(body["trace_id"])
+            assert any(f["name"] == "raft.serve.http" for f in frags)
+        finally:
+            web.close()
+            srv.close()
+
+    def test_search_adopts_incoming_traceparent(self, tracing):
+        srv = _fake_server()
+        web = endpoint_mod.serve(searcher=srv)
+        try:
+            hdr = "00-feed-beef-01"
+            req = urllib.request.Request(
+                f"{web.url}/search",
+                data=json.dumps({"queries": [[3, 0, 0, 0]]})
+                .encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": hdr})
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                body = json.loads(resp.read().decode())
+            assert body["trace_id"] == "feed"
+            frags = obs.RECORDER.fragments("feed")
+            http_root = [f for f in frags
+                         if f["name"] == "raft.serve.http"][0]
+            assert http_root["remote_parent"] == "beef"
+        finally:
+            web.close()
+            srv.close()
+
+    def test_endpoint_concurrency_bounded(self):
+        srv = endpoint_mod.DebugServer(("127.0.0.1", 0),
+                                       max_threads=2)
+        srv.start()
+        try:
+            # the bound is a semaphore: more than max_threads slow
+            # requests cannot run handlers concurrently; fast ones
+            # still all complete
+            results = []
+
+            def hit():
+                try:
+                    with urllib.request.urlopen(
+                            f"{srv.url}/metrics", timeout=5.0) as r:
+                        results.append(r.status)
+                except Exception as e:  # refused under saturation
+                    results.append(type(e).__name__)
+
+            threads = [threading.Thread(target=hit)
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10.0)
+            assert len(results) == 6
+            assert results.count(200) >= 2
+        finally:
+            srv.close()

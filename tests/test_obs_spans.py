@@ -543,3 +543,74 @@ class TestKernelPrecisionThreading:
             outs.append(np.asarray(idx.pq_centers))
         # highest and the None default (highest) agree exactly on CPU
         np.testing.assert_allclose(outs[0], outs[3])
+
+
+class TestTraceparent:
+    """W3C-style cross-process propagation (ISSUE 16): the header is
+    `00-<trace_id>-<span_id>-01`, trace_id itself contains a dash
+    (`{pid:x}-{counter:08x}`) so parsing is anchored at both ends."""
+
+    def test_header_round_trips(self, tracing):
+        with spans.span("raft.t.root") as sp:
+            hdr = spans.current_traceparent()
+            assert hdr == f"00-{sp.trace_id}-{sp.span_id}-01"
+            assert spans.parse_traceparent(hdr) == (sp.trace_id,
+                                                    sp.span_id)
+
+    def test_no_open_span_means_no_header(self, tracing):
+        assert spans.current_traceparent() is None
+        with spans.span("raft.t.root"):
+            pass
+        assert spans.current_traceparent() is None
+
+    def test_disabled_tracing_means_no_header(self, tracing):
+        spans.set_trace_enabled(False)
+        with spans.span("raft.t.root"):
+            assert spans.current_traceparent() is None
+
+    def test_parse_is_lenient_never_raises(self, tracing):
+        for bad in (None, "", " ", "junk", "00", "00-", "00-a",
+                    "00-a-", "01-a-b-01", "00--b-01", "00-a--01",
+                    "zz-a-b-01", "00-a-b-01-extra-extra"):
+            assert spans.parse_traceparent(bad) is None
+        # whitespace around a valid header is tolerated
+        assert spans.parse_traceparent("  00-1a-2b-3c-01  ") == \
+            ("1a-2b", "3c")
+
+    def test_remote_parent_links_across_threads(self, tracing):
+        import threading
+
+        box = {}
+        with spans.span("raft.t.upstream") as up:
+            box["hdr"] = spans.current_traceparent()
+
+        def worker():
+            with spans.span("raft.t.remote_child",
+                            remote_parent=box["hdr"]) as ch:
+                box["tid"] = ch.trace_id
+                box["pid"] = ch.parent_id
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert box["tid"] == up.trace_id
+        assert box["pid"] == up.span_id
+        child = [f for f in tracing.fragments(up.trace_id)
+                 if f["name"] == "raft.t.remote_child"][0]
+        assert child["remote_parent"] == up.span_id
+
+    def test_malformed_remote_parent_roots_fresh_trace(self, tracing):
+        with spans.span("raft.t.root", remote_parent="not-a-header") \
+                as sp:
+            assert sp.trace_id
+            assert sp.parent_id is None
+
+    def test_fragments_dedupes_slow_and_ring(self, tracing):
+        # a slow REQUEST trace lands in both the ring and the slow
+        # log; fragments() must return it once
+        rec = recorder_mod.FlightRecorder(slow_ms=0.0)
+        with spans.span("raft.t.search", request=True) as sp:
+            tid = sp.trace_id
+        tr = obs.RECORDER.requests(1)[0]
+        rec.record(tr)
+        assert len(rec.fragments(tid)) == 1

@@ -191,6 +191,14 @@ REQUIRED_NAMES = (
     "raft.obs.profile.hbm.headroom_frac",
     "raft.obs.profile.hbm.low_headroom",
     "raft.obs.profile.compile.seconds",
+    # fleet observability plane (ISSUE 16): the metric federator's own
+    # plane — per-instance scrape counts/errors/durations plus the
+    # membership and staleness gauges a fleet dashboard alarms on
+    "raft.obs.fed.scrapes.total",
+    "raft.obs.fed.scrape.errors",
+    "raft.obs.fed.scrape.seconds",
+    "raft.obs.fed.instances",
+    "raft.obs.fed.stale",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -242,6 +250,11 @@ REQUIRED_SPAN_NAMES = (
     # child span — a MEASURED device/host split under the request
     # (attributed=False, unlike the raft.plan.stage.* estimates)
     "raft.obs.profile.sync",
+    # fleet observability plane (ISSUE 16): each federator sweep and
+    # each cross-process trace stitch opens one span — the
+    # aggregator's own overhead is itself traced
+    "raft.obs.fed.scrape",
+    "raft.obs.fed.stitch",
 )
 
 

@@ -127,12 +127,14 @@ class LockDiscipline(Rule):
                    "the ISSUE 11 shadow/SLO threads)")
     # the threaded modules that postdate PR 6 are scoped explicitly:
     # quality's shadow thread, the SLO poller, the chaos harness, the
-    # fleet tier (router callbacks + replicator thread, ISSUE 13), and
-    # the resource profiler (dispatcher threads + HBM sampler thread
-    # share the ledger, ISSUE 14)
+    # fleet tier (router callbacks + replicator thread, ISSUE 13), the
+    # resource profiler (dispatcher threads + HBM sampler thread
+    # share the ledger, ISSUE 14), and the metric federator (scraper
+    # thread × merge/report readers, ISSUE 16)
     paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate",
              "raft_tpu/obs/quality.py", "raft_tpu/obs/slo.py",
              "raft_tpu/obs/profiler.py",
+             "raft_tpu/obs/federation.py",
              "raft_tpu/testing/faults.py", "raft_tpu/fleet")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

@@ -482,6 +482,16 @@ def main(argv=None) -> int:
                          "for the availability-through-replica-kill "
                          "row. CPU smoke shares one device; real "
                          "fleets put each replica on its own host")
+    ap.add_argument("--federate", action="store_true",
+                    help="with --fleet: stand up one debug endpoint "
+                         "per replica plus a federating aggregator "
+                         "over them (ISSUE 16) — the report gains a "
+                         "'federation' section (fleet QPS from summed "
+                         "counters vs router-measured, per-instance "
+                         "staleness, aggregator scrape overhead). "
+                         "CPU-smoke caveat: in-process replicas share "
+                         "ONE registry, so the summed/router ratio "
+                         "reads ~N — the sum semantics made visible")
     ap.add_argument("--mutate-frac", type=float, default=0.0,
                     help="fraction of arrivals that are WRITES "
                          "(upsert/delete against a MutableIndex with a "
@@ -532,6 +542,9 @@ def main(argv=None) -> int:
     if args.fleet and args.fleet < 2:
         ap.error("--fleet needs >= 2 replicas (1 replica is just "
                  "--server single)")
+    if args.federate and not args.fleet:
+        ap.error("--federate aggregates replica endpoints — it needs "
+                 "--fleet N")
     chaos_events = (parse_chaos_spec(args.chaos, args.chaos_duration)
                     if args.chaos else None)
     if chaos_events and any(e[1] in ("kill_compactor", "fail_transfer")
@@ -561,6 +574,21 @@ def main(argv=None) -> int:
         router, q, build_server = _build_fleet(
             args.n, args.dim, args.n_lists, args.k, ladder,
             args.deadline_ms, args.fleet, chaos=bool(chaos_events))
+        endpoints, federator, agg = [], None, None
+        if args.federate:
+            # fleet observability plane (ISSUE 16): one scrape target
+            # per replica + one aggregator federating them. The CPU
+            # smoke's replicas share the process-global registry, so
+            # each endpoint exports the same body — the federated sum
+            # reads ~N x the router's own counters, which is the sum
+            # semantics demonstrated, not a bug (reported below as
+            # instances_share_registry)
+            from raft_tpu.obs import federation as _federation
+            endpoints = [obs.serve() for _ in range(args.fleet)]
+            federator = _federation.MetricsFederator(
+                {f"r{i}": e.url for i, e in enumerate(endpoints)},
+                interval_s=0.5, fleet=router).start()
+            agg = obs.serve(federator=federator, fleet=router)
         stop = threading.Event()
         chaos_t = (run_chaos_schedule(chaos_events, stop,
                                       router=router,
@@ -592,6 +620,38 @@ def main(argv=None) -> int:
         }
         if chaos_events:
             report["chaos"] = {"schedule": args.chaos}
+        if federator is not None:
+            # one final sweep so the section reflects end-of-run
+            # counters, and so its cost is measured explicitly
+            t_sweep = time.perf_counter()
+            federator.scrape_once()
+            final_scrape_s = time.perf_counter() - t_sweep
+            fed_rep = federator.report()
+            summed = 0.0
+            for fam in federator.merged():
+                if fam.name == "raft_serve_completed_total_total":
+                    summed += sum(
+                        s.value for s in fam.samples
+                        if all(k_ != "instance" for k_, _ in s.labels))
+            router_total = obs.snapshot()["counters"].get(
+                "raft.serve.completed.total", 0.0)
+            report["federation"] = {
+                "instances": {name: row["state"] for name, row
+                              in fed_rep["instances"].items()},
+                "stale": federator.stale_instances(),
+                "fleet_completed_summed": int(summed),
+                "router_completed_total": int(router_total),
+                "summed_over_router_ratio": round(
+                    summed / max(1.0, router_total), 3),
+                "instances_share_registry": True,
+                "scrape_overhead_frac":
+                    fed_rep["scrape_overhead"]["frac"],
+                "final_scrape_s": round(final_scrape_s, 6),
+            }
+            federator.close()
+            agg.close()
+            for e in endpoints:
+                e.close()
         prof = profile_report(router)
         if prof is not None:
             report["profile"] = prof

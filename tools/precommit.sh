@@ -119,6 +119,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# fleet observability plane (ISSUE 16): the exposition round-trip
+# byte-stability pin, instance-label merge semantics per instrument
+# kind, traceparent propagation + cross-endpoint trace stitching, the
+# kill-mid-scrape STALE contract (no federator hangs), and the
+# aggregator endpoint routes.
+echo "precommit: federation + trace-propagation tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_federation.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # distributed serving tier (ISSUE 8): the int8 merge codec round-trip
 # + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
 # mesh, pad-row non-leakage through the distributed scatter, and the
