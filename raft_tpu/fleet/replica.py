@@ -86,7 +86,7 @@ class Replica:
     # static race contract (tools/graftlint GL003): router threads,
     # the rolling-restart operator and the replication thread meet on
     # these fields — touch them only under `with self._lock`
-    GUARDED_BY = ("_state", "_server", "_replicator")
+    GUARDED_BY = ("_state", "_server", "_replicator", "_blackbox")
 
     def __init__(self, name: str, server=None,
                  state: Optional[ReplicaState] = None, replicator=None):
@@ -95,6 +95,7 @@ class Replica:
         self._lock = threading.Lock()
         self._server = server
         self._replicator = replicator
+        self._blackbox = None
         self._state = (state if state is not None else
                        (ReplicaState.SERVING if server is not None
                         else ReplicaState.BOOTSTRAPPING))
@@ -126,6 +127,30 @@ class Replica:
     def replicator(self):
         with self._lock:
             return self._replicator
+
+    def set_blackbox(self, box) -> "Replica":
+        """Attach a per-replica black box (ISSUE 18, duck-typed:
+        anything with ``flush(reason)`` and a ``dir``). kill()/stop()
+        flush it so even a no-drain death leaves the final state
+        transition on disk, and :meth:`describe` carries the dump path
+        into ``router.report()``."""
+        with self._lock:
+            self._blackbox = box
+        return self
+
+    def _flush_blackbox(self, reason: str) -> None:
+        with self._lock:
+            box = self._blackbox
+        if box is None:
+            return
+        try:
+            box.flush(reason)
+        except Exception:
+            # forensics are best-effort on the death path — a broken
+            # flush must never turn kill()/stop() into a raise
+            get_logger("fleet").warning(
+                "replica %s: blackbox flush (%s) failed",
+                self.name, reason)
 
     def set_server(self, server, replicator=None) -> "Replica":
         """Install a (new) server — the bootstrap/rolling-restart
@@ -234,6 +259,7 @@ class Replica:
             state = self._state
         if state is not ReplicaState.DOWN:
             self.to(ReplicaState.DOWN)
+        self._flush_blackbox("stop")
         return drained
 
     def kill(self) -> None:
@@ -252,13 +278,22 @@ class Replica:
             repl.close()
         if srv is not None:
             srv.close()
+        # the last act of a killed replica: spill the black box AFTER
+        # the DOWN transition so the dump's final frame/snapshot shows
+        # the death, not the life before it
+        self._flush_blackbox("kill")
 
     def describe(self) -> dict:
         """Structured snapshot for ``/debug/fleet``."""
         with self._lock:
             srv = self._server
             state = self._state
+            box = self._blackbox
         body = {"name": self.name, "state": state.value}
+        if box is not None:
+            # the post-mortem pointer: where tools/doctor.py should
+            # look when this row says "down"
+            body["blackbox"] = getattr(box, "dir", None)
         if srv is not None and state is not ReplicaState.DOWN:
             try:
                 body["load"] = srv.load()

@@ -21,9 +21,15 @@ Further planes ride the same taxonomy and load lazily:
 :mod:`raft_tpu.obs.quality` (shadow-exact recall, ISSUE 11),
 :mod:`raft_tpu.obs.profiler` (sampled device-time attribution, duty
 cycle, HBM accounting — ISSUE 14; ``RAFT_TPU_PROFILE_SAMPLE``,
-``/debug/profile``) and :mod:`raft_tpu.obs.federation` (cross-process
+``/debug/profile``), :mod:`raft_tpu.obs.federation` (cross-process
 metric federation + fleet rollup — ISSUE 16; ``obs.serve(
-federator=...)`` turns the endpoint into the fleet aggregator).
+federator=...)`` turns the endpoint into the fleet aggregator), and
+the post-mortem pair :mod:`raft_tpu.obs.history` +
+:mod:`raft_tpu.obs.blackbox` (metrics history ring with mean-shift
+anomaly detection at ``/debug/history``, plus the crash-durable
+black-box flight data recorder — ISSUE 18;
+``RAFT_TPU_BLACKBOX=<dir>`` ambient-attaches both, and
+``tools/doctor.py`` reads the dumps).
 
 Quick use::
 
@@ -117,3 +123,23 @@ __all__ = [
     "DebugServer",
     "serve",
 ]
+
+# -- black-box ambient attach (ISSUE 18) ----------------------------------
+# RAFT_TPU_BLACKBOX=<dir> attaches the metrics-history sampler and the
+# crash-durable black box at import, exactly like the profiler's
+# RAFT_TPU_PROFILE_SAMPLE knob. Unset/0/off leaves BOTH modules
+# unimported — the off state is one env read here and `_STATE is None`
+# in each module, nothing else (the < 2% overhead gate is structural).
+# The attach lives HERE rather than at blackbox-module import so
+# tools/doctor.py can import the modules to READ a dump without ever
+# starting a recorder into it.
+import os as _os
+
+_bb_dir = _os.environ.get("RAFT_TPU_BLACKBOX", "")
+if _bb_dir and _bb_dir.lower() not in ("0", "false", "off", "no"):
+    from raft_tpu.obs import blackbox as _blackbox
+    from raft_tpu.obs import history as _history
+
+    _history.enable_history()
+    _blackbox.enable_blackbox(_bb_dir)
+del _os, _bb_dir

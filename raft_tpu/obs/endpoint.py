@@ -219,6 +219,15 @@ def _health_body(snapshot: dict) -> dict:
                 if k.split("{")[0]
                 == "raft.obs.profile.hbm.headroom_frac"},
         }
+    # history plane (ISSUE 18): active mean-shift anomalies ride the
+    # body informationally — a shifted signal says WHERE to look
+    # (/debug/history), the underlying plane (serve/profiler/SLO/
+    # fleet) owns the degrade verdict for it
+    anomalies = sorted(
+        k for k, v in gauges.items()
+        if k.split("{")[0] == "raft.obs.history.anomaly" and v > 0)
+    if anomalies:
+        body["history"] = {"anomalies": anomalies}
     # fleet tier (ISSUE 13): a registered replica fleet degrades the
     # verdict while any replica is out of the serving set (draining /
     # bootstrapping / down — a fleet at partial capacity must say so,
@@ -316,6 +325,13 @@ class _Handler(BaseHTTPRequestHandler):
                 body = _profiler.endpoint_body(self.server.registry
                                                .snapshot())
                 self._send_json(200, body)
+            elif path == "/debug/history":
+                # lazy import: history only attaches when enabled
+                # (ISSUE 18) — the route answers 404, not ImportError,
+                # on a box without it
+                from raft_tpu.obs import history as _history
+                code, body = _history.endpoint_body(q)
+                self._send_json(code, body)
             else:
                 self._send_json(404, {"error": f"no route {path!r}",
                                       "routes": ["/metrics", "/healthz",
@@ -325,7 +341,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                  "/debug/requests",
                                                  "/debug/slo",
                                                  "/debug/fleet",
-                                                 "/debug/profile"]})
+                                                 "/debug/profile",
+                                                 "/debug/history"]})
         except BrokenPipeError:
             pass
 

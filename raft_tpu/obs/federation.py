@@ -319,7 +319,8 @@ class MetricsFederator:
     Network and peer-registry I/O always happens OUTSIDE the lock —
     a slow replica can delay freshness, never block a reader."""
 
-    GUARDED_BY = ("_sources", "_instances", "_scrape_s_total")
+    GUARDED_BY = ("_sources", "_instances", "_scrape_s_total",
+                  "_blackboxes")
 
     def __init__(self, instances: Optional[Dict[str, Source]] = None,
                  interval_s: float = 5.0,
@@ -335,6 +336,10 @@ class MetricsFederator:
         self._lock = threading.Lock()
         self._sources: Dict[str, Source] = dict(instances or {})
         self._instances: Dict[str, _Instance] = {}
+        # per-instance black-box dump paths (ISSUE 18): the aggregator
+        # remembers where each replica's flight recorder spills so a
+        # dead instance's report row still points at its forensics
+        self._blackboxes: Dict[str, str] = {}
         self._scrape_s_total = 0.0
         self._t_started = time.monotonic()
         self._stop = threading.Event()
@@ -345,10 +350,24 @@ class MetricsFederator:
         with self._lock:
             self._sources[name] = source
 
+    def set_blackbox_path(self, name: str, path: Optional[str]) -> None:
+        """Record (or clear, ``path=None``) where instance ``name``'s
+        black box dumps — surfaced per-row in :meth:`report` so the
+        doctor can be pointed at a dead replica straight from
+        ``/debug/fleet``. Deliberately NOT dropped with the source in
+        :meth:`remove_instance`'s instances map: the path outlives the
+        process it names."""
+        with self._lock:
+            if path is None:
+                self._blackboxes.pop(name, None)
+            else:
+                self._blackboxes[name] = str(path)
+
     def remove_instance(self, name: str) -> None:
         with self._lock:
             self._sources.pop(name, None)
             self._instances.pop(name, None)
+            self._blackboxes.pop(name, None)
 
     def instance_names(self) -> List[str]:
         with self._lock:
@@ -526,13 +545,17 @@ class MetricsFederator:
         now = time.monotonic()
         with self._lock:
             sources = dict(self._sources)
+            blackboxes = dict(self._blackboxes)
             rows: Dict[str, dict] = {}
             gauge_values: Dict[str, Dict[str, float]] = {}
             for name in sorted(sources):
                 inst = self._instances.get(name)
                 if inst is None:
-                    rows[name] = {"state": "absent", "scrapes": 0,
-                                  "errors": 0}
+                    row = {"state": "absent", "scrapes": 0,
+                           "errors": 0}
+                    if name in blackboxes:
+                        row["blackbox"] = blackboxes[name]
+                    rows[name] = row
                     continue
                 state = ("stale" if self._stale_locked(name, now)
                          else "live")
@@ -546,6 +569,10 @@ class MetricsFederator:
                 }
                 if inst.last_error:
                     row["last_error"] = inst.last_error
+                if name in blackboxes:
+                    # the post-mortem pointer: a STALE row plus this
+                    # path is the doctor's entry point
+                    row["blackbox"] = blackboxes[name]
                 if inst.families is not None:
                     for label, prom in (
                             ("duty_cycle",

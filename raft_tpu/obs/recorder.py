@@ -92,6 +92,11 @@ class FlightRecorder:
                 or bool(trace.get("attrs", {}).get("request")))
 
     def record(self, trace: dict) -> None:
+        # wall clock by design (GL005): black-box dumps and history
+        # frames are correlated ACROSS processes by the doctor — every
+        # trace entering the rings carries an absolute arrival stamp
+        # (spans only carry relative durations)
+        trace.setdefault("ts_unix", time.time())  # graftlint: disable=GL005
         dur = trace.get("duration_ms", 0.0)
         slow = dur >= self.slow_ms and self._is_request(trace)
         with self._lock:

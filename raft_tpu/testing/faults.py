@@ -36,6 +36,13 @@ Injection sites wired in this repo (labels in parentheses):
 ``mutate.compact``         :meth:`MutableIndex.compact` entry (``epoch``)
 ``mutate.transfer``        the delta/tombstone host→device refresh
                            (``epoch``)
+``fed.scrape``             one federator scrape of one instance
+                           (``instance``) — delay/error here simulates a
+                           dead or hung replica endpoint
+``obs.blackbox.append``    between a black-box record's header and
+                           payload writes (``kind``, ``box``) — an error
+                           here manufactures the torn tail a kill -9
+                           mid-write leaves, proving recovery truncates
 =========================  ==================================================
 
 Convenience scopes: :func:`stall_shard`, :func:`kill_compactor`,

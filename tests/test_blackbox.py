@@ -1,0 +1,695 @@
+"""Tests for the post-mortem observability plane (ISSUE 18): the
+metrics-history ring (hand-computed rate()/delta() math, delta
+compression, eviction base-folding, the fires-once-per-shift anomaly
+edge, the /debug/history route + healthz fold), the crash-durable
+black box (record round trip, kill-9-mid-flush torn-segment
+truncation + recovery, rotation/pruning, SIGTERM/atexit hooks, the
+zero-overhead nothing-attached contract), the offline doctor (verdict
+units per cause, transitions + final-window deltas from synthetic
+dumps), and the acceptance path: a ``kill()``-ed (no-drain) replica
+under loadgen leaves a dump the doctor diagnoses."""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import blackbox as blackbox_mod
+from raft_tpu.obs import history as history_mod
+from raft_tpu.obs.registry import MetricsRegistry
+from raft_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_modules():
+    """No history/blackbox state (or fault rule) may leak between
+    tests — the tier-1 nothing-attached contract."""
+    yield
+    blackbox_mod.disable_blackbox(flush=False)
+    history_mod.disable_history()
+    faults.reset()
+
+
+def _hist(reg, **kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("capacity", 64)
+    h = history_mod.MetricsHistory(registry=reg, **kw)
+    return h
+
+
+# -- metrics history: math -------------------------------------------------
+
+class TestHistoryMath:
+    def test_rate_and_delta_vs_hand_computed(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        c = reg.counter("raft.t.ops.total")
+        g = reg.gauge("raft.t.depth")
+        # 5 ticks at t=0..4: counter +7 per tick, gauge = 3*t
+        for t in range(5):
+            c.inc(7)
+            g.set(3.0 * t)
+            h.tick(t=float(t))
+        # counter: 7 at t=0, 35 at t=4 -> delta 28, rate 7/s
+        assert h.delta("raft.t.ops.total") == {"raft.t.ops.total": 28.0}
+        assert h.rate("raft.t.ops.total") == {"raft.t.ops.total": 7.0}
+        # gauge: 0 -> 12 over 4s
+        assert h.delta("raft.t.depth") == {"raft.t.depth": 12.0}
+        assert h.rate("raft.t.depth") == {"raft.t.depth": 3.0}
+        # windowed: last 2s of frames (t=2,3,4) -> counter moved 14
+        d = h.delta("raft.t.ops.total", window_s=2.0)
+        assert d["raft.t.ops.total"] == 14.0
+        r = h.rate("raft.t.ops.total", window_s=2.0)
+        assert r["raft.t.ops.total"] == 7.0
+
+    def test_series_points_and_family_prefix_match(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        reg.counter("raft.t.reqs.total", route="a").inc(2)
+        reg.counter("raft.t.reqs.total", route="b").inc(5)
+        h.tick(t=0.0)
+        reg.counter("raft.t.reqs.total", route="a").inc(2)
+        h.tick(t=1.0)
+        pts = h.series("raft.t.reqs.total")
+        assert len(pts) == 2
+        a = pts["raft.t.reqs.total{route=a}"]
+        assert [v for _, v in a] == [2.0, 4.0]
+        # family-prefix match ("raft.t" matches raft.t.*)
+        assert len(h.series("raft.t")) == 2
+
+    def test_delta_compression_quiet_registry(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        reg.counter("raft.t.ops.total").inc(3)
+        reg.gauge("raft.t.depth").set(9.0)
+        h.tick(t=0.0)
+        h.tick(t=1.0)   # nothing moved
+        with h._lock:
+            f0, f1 = h._frames[0], h._frames[1]
+        assert f0.counters == {"raft.t.ops.total": 3.0}
+        assert f0.gauges == {"raft.t.depth": 9.0}
+        # the quiet frame stores NOTHING (delta compression)
+        assert f1.counters == {} and f1.gauges == {}
+
+    def test_eviction_folds_into_base_exactly(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg, capacity=4)
+        c = reg.counter("raft.t.ops.total")
+        for t in range(12):
+            c.inc(1)
+            h.tick(t=float(t))
+        # 8 frames evicted into the base; absolute values stay exact
+        pts = h.series("raft.t.ops.total")["raft.t.ops.total"]
+        assert len(pts) == 4
+        assert [v for _, v in pts] == [9.0, 10.0, 11.0, 12.0]
+        assert h.delta("raft.t.ops.total") == {"raft.t.ops.total": 3.0}
+
+    def test_histograms_fold_as_count_and_sum(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        reg.histogram("raft.t.lat.seconds").observe(0.5)
+        h.tick(t=0.0)
+        reg.histogram("raft.t.lat.seconds").observe(1.5)
+        h.tick(t=1.0)
+        d = h.delta("raft.t.lat.seconds.count")
+        assert d == {"raft.t.lat.seconds.count": 1.0}
+        s = h.delta("raft.t.lat.seconds.sum")
+        assert s == {"raft.t.lat.seconds.sum": 1.5}
+
+    def test_frames_since_for_blackbox(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        for t in range(3):
+            reg.counter("raft.t.ops.total").inc()
+            h.tick(t=float(t))
+        assert len(h.frames_since(0)) == 3
+        assert len(h.frames_since(2)) == 1
+        f = h.frames_since(2)[0]
+        assert f["seq"] == 3 and "t_unix" in f and "counters" in f
+
+
+# -- anomaly detection: the fires-once edge --------------------------------
+
+class TestAnomalyEdge:
+    def _run_signal(self, h, reg, values):
+        g = reg.gauge("raft.serve.shed.rate")
+        events = []
+        for t, v in enumerate(values):
+            g.set(v)
+            h.tick(t=float(t))
+            det = h._detectors["shed_rate"]
+            events.append((det.shifted, det.fired_total))
+        return events
+
+    def test_fires_once_per_shift(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg, anomaly_window=3)
+        # constant 0 for 6 ticks (fills 2w), then a step to 10
+        evs = self._run_signal(h, reg, [0.0] * 6 + [10.0] * 8)
+        fired = [f for _, f in evs]
+        # exactly one firing, and it stays shifted without re-firing
+        assert fired[-1] == 1
+        assert any(s for s, _ in evs)
+        # once the step fully occupies BOTH windows, the shift clears
+        assert evs[-1][0] is False
+        # a second step re-fires exactly once more
+        g = reg.gauge("raft.serve.shed.rate")
+        for t in range(14, 22):
+            g.set(50.0)
+            h.tick(t=float(t))
+        assert h._detectors["shed_rate"].fired_total == 2
+
+    def test_gauge_and_counter_exported_on_edge(self):
+        before = obs.snapshot()
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg, anomaly_window=3)
+        self._run_signal(h, reg, [0.0] * 6 + [10.0] * 3)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        assert diff["counters"].get(
+            "raft.obs.history.anomaly.total{signal=shed_rate}") == 1
+        # anomalies() reports the shifted window
+        a = h.anomalies()["shed_rate"]
+        assert a["shifted"] is True and a["fired_total"] == 1
+
+    def test_absent_signal_never_fires(self):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg, anomaly_window=2)
+        for t in range(10):
+            h.tick(t=float(t))
+        assert all(d.fired_total == 0
+                   for d in h._detectors.values())
+
+
+# -- /debug/history route + healthz fold -----------------------------------
+
+class TestHistoryEndpoint:
+    def test_endpoint_404_when_detached(self):
+        code, body = history_mod.endpoint_body({})
+        assert code == 404 and "error" in body
+
+    def test_endpoint_series_math_and_healthz_fold(self):
+        import urllib.request
+        st = history_mod.enable_history(interval_s=60.0, start=False)
+        try:
+            obs.counter("raft.t.ep.total").inc(4)
+            st.tick(t=0.0)
+            obs.counter("raft.t.ep.total").inc(4)
+            st.tick(t=2.0)
+            srv = obs.serve()
+            try:
+                with urllib.request.urlopen(
+                        srv.url + "/debug/history?name=raft.t.ep.total"
+                        "&points=1") as r:
+                    body = json.loads(r.read())
+                row = body["series"]["raft.t.ep.total"]
+                assert row["delta"] == 4.0
+                assert row["rate_per_s"] == 2.0
+                assert row["kind"] == "counter"
+                assert len(row["values"]) == 2
+                # the 404 routes list names the new route
+                import urllib.error
+                try:
+                    urllib.request.urlopen(srv.url + "/nope")
+                    raise AssertionError("expected 404")
+                except urllib.error.HTTPError as e:
+                    routes = json.loads(e.read())["routes"]
+                    assert "/debug/history" in routes
+            finally:
+                srv.close()
+        finally:
+            history_mod.disable_history()
+
+    def test_healthz_folds_active_anomalies_informationally(self):
+        from raft_tpu.obs.endpoint import _health_body
+        snap = {"gauges": {
+            "raft.obs.history.anomaly{signal=shed_rate}": 1.0,
+            "raft.obs.history.anomaly{signal=recall}": 0.0}}
+        body = _health_body(snap)
+        # informational: named, but does NOT flip the verdict
+        assert body["status"] == "ok"
+        assert body["history"]["anomalies"] == [
+            "raft.obs.history.anomaly{signal=shed_rate}"]
+
+
+# -- black box: durability -------------------------------------------------
+
+class TestBlackBox:
+    def _box(self, tmp_path, **kw):
+        return blackbox_mod.BlackBox(str(tmp_path / "bb"), **kw)
+
+    def test_roundtrip_sections(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        reg.counter("raft.t.ops.total").inc(5)
+        h.tick(t=0.0)
+        bb = self._box(tmp_path, registry=reg, history=h, box="unit")
+        bb.flush("manual")
+        bb.close()
+        recs = blackbox_mod.read_dump(bb.dir)
+        kinds = {r["kind"] for r in recs}
+        assert {"meta", "snapshot", "healthz", "frames",
+                "traces"} <= kinds
+        meta = [r for r in recs if r["kind"] == "meta"]
+        assert meta[0]["box"] == "unit"
+        assert {m["data"]["reason"] for m in meta} >= {"start",
+                                                       "manual",
+                                                       "close"}
+        snap = [r for r in recs if r["kind"] == "snapshot"][-1]
+        assert snap["data"]["counters"]["raft.t.ops.total"] == 5
+
+    def test_frames_deduped_across_flushes(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        h = _hist(reg)
+        bb = self._box(tmp_path, registry=reg, history=h)
+        reg.counter("raft.t.ops.total").inc()
+        h.tick(t=0.0)
+        bb.flush("one")
+        h.tick(t=1.0)
+        bb.flush("two")
+        bb.close()
+        recs = blackbox_mod.read_dump(bb.dir)
+        seqs = [f["seq"] for r in recs if r["kind"] == "frames"
+                for f in r["data"]]
+        assert seqs == sorted(set(seqs)), "frames re-spilled"
+
+    def test_kill9_mid_flush_truncates_and_recovers(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        bb = self._box(tmp_path, registry=reg)
+        bb.flush("good")
+        good = len(blackbox_mod.read_dump(bb.dir))
+        # the kill -9: the fault fires BETWEEN header and payload
+        # writes, so the header reaches disk (unbuffered) and the
+        # payload never does — exactly a process death mid-write
+        before = obs.snapshot()
+        with faults.inject_fault("obs.blackbox.append",
+                                 action="error"):
+            with pytest.raises(faults.FaultError):
+                bb.flush("doomed")
+        # the dump is ALREADY readable (reader stops at the tear)
+        assert len(blackbox_mod.read_dump(bb.dir)) == good
+        # "reboot": a new box on the same dir truncates the tear,
+        # seals the intact prefix and counts the torn segment
+        bb2 = blackbox_mod.BlackBox(str(tmp_path / "bb"),
+                                    registry=reg)
+        diff = obs.snapshot_diff(before, obs.snapshot())
+        assert diff["counters"].get(
+            "raft.obs.blackbox.torn.total") == 1
+        bb2.flush("after")
+        bb2.close()
+        recs = blackbox_mod.read_dump(bb2.dir)
+        reasons = [r["data"]["reason"] for r in recs
+                   if r["kind"] == "meta"]
+        assert "good" in reasons and "doomed" not in reasons
+        assert "after" in reasons
+        # every segment parses cleanly end to end now
+        for p in blackbox_mod._segment_files(bb2.dir):
+            it = blackbox_mod._iter_segment(p)
+            torn = 0
+            while True:
+                try:
+                    next(it)
+                except StopIteration as stop:
+                    torn = stop.value or 0
+                    break
+            assert torn == 0, f"torn bytes left in {p}"
+
+    def test_corrupt_crc_record_stops_read_not_raises(self, tmp_path):
+        bb = self._box(tmp_path)
+        bb.flush("a")
+        bb.close()
+        seg = blackbox_mod._segment_files(bb.dir)[0]
+        with open(seg, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+        recs = blackbox_mod.read_segment(seg)
+        full = blackbox_mod.read_dump(bb.dir)
+        assert len(recs) >= 1     # intact prefix survives
+        assert isinstance(full, list)
+
+    def test_rotation_and_prune(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        # a fat registry so every flush exceeds the (minimum) segment
+        # cap and rotates — pruning then has victims to collect
+        for i in range(300):
+            reg.counter("raft.t.rot.total", series=f"s{i:03d}").inc()
+        bb = self._box(tmp_path, registry=reg,
+                       max_segment_bytes=4096, max_segments=3)
+        for i in range(12):
+            bb.flush(f"f{i}")
+        files = blackbox_mod._segment_files(bb.dir)
+        assert len(files) <= 3
+        # newest records survive, oldest pruned
+        recs = blackbox_mod.read_dump(bb.dir)
+        reasons = [r["data"]["reason"] for r in recs
+                   if r["kind"] == "meta"]
+        assert "f11" in reasons and "f0" not in reasons
+        bb.close()
+
+    def test_degrade_edge_triggers_flush(self, tmp_path):
+        reg = MetricsRegistry(enabled=True)
+        bb = self._box(tmp_path, registry=reg, interval_s=3600.0)
+        # the degrade edge is evaluated against the BOX's registry —
+        # trip the overload gauge there
+        g = reg.gauge("raft.serve.overloaded")
+        try:
+            bb.start()
+            import time as _time
+            g.set(1.0)
+            deadline = _time.monotonic() + 5.0
+            seen = False
+            while _time.monotonic() < deadline:
+                recs = blackbox_mod.read_dump(bb.dir)
+                if any(r["kind"] == "meta"
+                       and r["data"]["reason"] == "degrade"
+                       for r in recs):
+                    seen = True
+                    break
+                _time.sleep(0.05)
+            assert seen, "no degrade-edge flush within 5s"
+        finally:
+            g.set(0.0)
+            bb.close()
+
+    def test_module_flush_noop_when_detached(self):
+        assert blackbox_mod.flush("x") == 0
+        assert blackbox_mod.state() is None
+        assert blackbox_mod.enabled() is False
+
+
+class TestZeroOverhead:
+    def test_env_off_attaches_nothing(self):
+        """RAFT_TPU_BLACKBOX=0: importing raft_tpu.obs must not even
+        import the blackbox/history modules, and explicitly importing
+        them must show nothing attached — the off state is ONE
+        module-level flag read."""
+        env = dict(os.environ, RAFT_TPU_BLACKBOX="0",
+                   JAX_PLATFORMS="cpu")
+        code = (
+            "import sys\n"
+            "import raft_tpu.obs\n"
+            "assert 'raft_tpu.obs.blackbox' not in sys.modules\n"
+            "assert 'raft_tpu.obs.history' not in sys.modules\n"
+            "from raft_tpu.obs import blackbox, history\n"
+            "assert blackbox.state() is None\n"
+            "assert history.history() is None\n"
+            "print('CLEAN')\n")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "CLEAN" in out.stdout
+
+    def test_env_set_attaches_and_dump_survives_exit(self, tmp_path):
+        d = str(tmp_path / "amb")
+        env = dict(os.environ, RAFT_TPU_BLACKBOX=d,
+                   JAX_PLATFORMS="cpu")
+        code = (
+            "from raft_tpu.obs import blackbox, history\n"
+            "assert blackbox.state() is not None\n"
+            "assert history.history() is not None\n"
+            "import raft_tpu.obs as obs\n"
+            "obs.counter('raft.t.sub.total').inc(3)\n")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode == 0, out.stderr
+        recs = blackbox_mod.read_dump(d)
+        reasons = [r["data"]["reason"] for r in recs
+                   if r["kind"] == "meta"]
+        assert "start" in reasons and "atexit" in reasons
+        snap = [r for r in recs if r["kind"] == "snapshot"][-1]
+        assert snap["data"]["counters"].get("raft.t.sub.total") == 3
+
+    def test_sigterm_flushes(self, tmp_path):
+        d = str(tmp_path / "term")
+        env = dict(os.environ, RAFT_TPU_BLACKBOX=d,
+                   JAX_PLATFORMS="cpu")
+        code = (
+            "import os, signal, sys\n"
+            "import raft_tpu.obs\n"
+            "sys.stdout.write('READY\\n'); sys.stdout.flush()\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "import time; time.sleep(10)\n")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode != 0     # SIGTERM killed it
+        recs = blackbox_mod.read_dump(d)
+        reasons = [r["data"]["reason"] for r in recs
+                   if r["kind"] == "meta"]
+        assert "sigterm" in reasons
+
+
+class TestRecorderStamp:
+    def test_every_trace_gets_wall_clock_ts(self):
+        from raft_tpu.obs import spans
+        prev = spans.trace_enabled()
+        spans.set_trace_enabled(True)
+        obs.RECORDER.clear()
+        try:
+            import time as _time
+            t0 = _time.time()
+            with spans.span("raft.t.stamp.search"):
+                pass
+            tr = obs.RECORDER.requests(1)[0]
+            assert "ts_unix" in tr
+            assert t0 - 60 <= tr["ts_unix"] <= _time.time() + 60
+        finally:
+            obs.RECORDER.clear()
+            spans.set_trace_enabled(prev)
+
+
+# -- the offline doctor ----------------------------------------------------
+
+def _load_doctor():
+    sys.path.insert(0, REPO)
+    from tools import doctor
+    return doctor
+
+
+def _frame(seq, t, counters=None, gauges=None):
+    return {"seq": seq, "t_unix": t, "t_mono": t,
+            "counters": counters or {}, "gauges": gauges or {}}
+
+
+def _records(frames, gauges_final=None):
+    recs = [{"kind": "meta", "t_unix": 0.0,
+             "data": {"box": "r1", "pid": 1, "reason": "kill"}}]
+    recs.append({"kind": "frames", "t_unix": 99.0, "data": frames})
+    if gauges_final is not None:
+        recs.append({"kind": "snapshot", "t_unix": 100.0,
+                     "data": {"counters": {},
+                              "gauges": gauges_final,
+                              "histograms": {}}})
+    return recs
+
+
+class TestDoctorVerdicts:
+    def test_device_bound(self):
+        doctor = _load_doctor()
+        frames = [_frame(i, float(i), {"raft.serve.completed.total": 50})
+                  for i in range(1, 6)]
+        d = doctor.diagnose(_records(
+            frames, {"raft.obs.profile.duty_cycle": 0.95}))
+        assert d["verdict"] == "device-bound"
+
+    def test_host_bound(self):
+        doctor = _load_doctor()
+        frames = [_frame(i, float(i), {
+            "raft.serve.completed.total": 100,
+            "raft.serve.shed.total": 1}) for i in range(1, 6)]
+        d = doctor.diagnose(_records(
+            frames, {"raft.obs.profile.duty_cycle": 0.10,
+                     "raft.serve.queue.depth": 40.0}))
+        assert d["verdict"] == "host-bound"
+
+    def test_shed_storm(self):
+        doctor = _load_doctor()
+        frames = [_frame(i, float(i), {
+            "raft.serve.completed.total": 10,
+            "raft.serve.shed.total": 30}) for i in range(1, 6)]
+        d = doctor.diagnose(_records(frames, {}))
+        assert d["verdict"] == "shed storm"
+
+    def test_compile_storm_beats_duty(self):
+        doctor = _load_doctor()
+        frames = [_frame(i, float(i), {
+            "raft.plan.build.total": 3,
+            "raft.serve.completed.total": 5}) for i in range(1, 6)]
+        d = doctor.diagnose(_records(
+            frames, {"raft.obs.profile.duty_cycle": 0.95}))
+        assert d["verdict"] == "compile storm"
+
+    def test_wal_gap(self):
+        doctor = _load_doctor()
+        frames = [_frame(1, 1.0, {
+            "raft.mutate.wal.reader.gaps.total": 1})]
+        d = doctor.diagnose(_records(frames, {}))
+        assert d["verdict"] == "WAL gap"
+
+    def test_low_hbm(self):
+        doctor = _load_doctor()
+        frames = [_frame(1, 1.0, {"raft.serve.completed.total": 5})]
+        d = doctor.diagnose(_records(frames, {
+            "raft.obs.profile.hbm.headroom_frac{device=0}": 0.04}))
+        assert d["verdict"] == "low-HBM"
+
+    def test_healthy(self):
+        doctor = _load_doctor()
+        frames = [_frame(i, float(i), {
+            "raft.serve.completed.total": 100})
+            for i in range(1, 6)]
+        d = doctor.diagnose(_records(frames, {}))
+        assert d["verdict"] == "healthy"
+
+    def test_transitions_and_final_window(self):
+        doctor = _load_doctor()
+        frames = [
+            _frame(1, 1.0, {},
+                   {"raft.fleet.replica.state{replica=r1}": 1.0}),
+            _frame(2, 2.0, {"raft.serve.completed.total": 42}, {}),
+            _frame(3, 3.0, {},
+                   {"raft.fleet.replica.state{replica=r1}": 3.0}),
+        ]
+        d = doctor.diagnose(_records(frames, {}), window_s=10.0)
+        trs = d["transitions"]
+        assert [t["to"] for t in trs] == ["serving", "down"]
+        assert trs[-1]["t_unix"] == 3.0
+        assert d["final_window"]["counter_deltas"][
+            "raft.serve.completed.total"] == 42
+        # human rendering mentions the verdict and the transition
+        text = doctor.format_diagnosis(d)
+        assert "VERDICT" in text and "down" in text
+
+    def test_window_fallback_snapshot_diff(self):
+        doctor = _load_doctor()
+        recs = [
+            {"kind": "snapshot", "t_unix": 1.0,
+             "data": {"counters": {"raft.serve.completed.total": 10},
+                      "gauges": {}, "histograms": {}}},
+            {"kind": "snapshot", "t_unix": 5.0,
+             "data": {"counters": {"raft.serve.completed.total": 60},
+                      "gauges": {}, "histograms": {}}},
+        ]
+        deltas, _, span = doctor.final_window_deltas(recs)
+        assert deltas["raft.serve.completed.total"] == 50
+        assert span == 4.0
+
+
+# -- acceptance: kill_replica under loadgen → doctor-readable dump --------
+
+class TestKillReplicaPostMortem:
+    def test_killed_replica_dump_diagnosable(self, tmp_path):
+        """ISSUE 18 acceptance: a kill()-ed (no-drain) replica under
+        loadgen leaves a dump from which the doctor reports the final
+        DOWN transition, last-window metric deltas, and a
+        host-/device-bound verdict."""
+        from tools import loadgen
+        from raft_tpu.obs import profiler
+        d = str(tmp_path / "bb")
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        try:
+            with redirect_stdout(buf):
+                rc = loadgen.main([
+                    "--fleet", "2", "--n", "3000", "--n-lists", "8",
+                    "--dim", "16", "--rate", "120",
+                    "--duration", "1.5",
+                    "--chaos", "kill_replica:1@t+0.5s+30s",
+                    "--profile-sample", "0.5",
+                    "--blackbox", d])
+        finally:
+            profiler.disable_profiling()
+            history_mod.disable_history()
+            faults.reset()
+        assert rc == 0
+        report = json.loads(buf.getvalue().splitlines()[-1])
+        bb = report["blackbox"]
+        assert bb["killed_replica"]["dump_readable"] is True
+        # independent re-read of the dead replica's dump (post-mortem:
+        # nothing from the live run is consulted)
+        doctor = _load_doctor()
+        diag = doctor.diagnose_dump(os.path.join(d, "r1"))
+        downs = [t for t in diag["transitions"]
+                 if t["replica"] == "r1" and t["to"] == "down"]
+        assert downs, f"no DOWN transition in dump: {diag}"
+        assert diag["final_window"]["counter_deltas"], \
+            "no last-window metric deltas in dump"
+        assert diag["verdict"] in ("host-bound", "device-bound",
+                                   "shed storm", "healthy",
+                                   "compile storm")
+        # the kill flush itself is on disk
+        recs = blackbox_mod.read_dump(os.path.join(d, "r1"))
+        reasons = {r["data"]["reason"] for r in recs
+                   if r["kind"] == "meta"}
+        assert "kill" in reasons
+
+
+# -- fleet surfacing -------------------------------------------------------
+
+class TestFleetSurfacing:
+    def test_replica_kill_flushes_attached_box(self, tmp_path):
+        from raft_tpu import fleet
+        rep = fleet.Replica("rX", server=None,
+                            state=fleet.ReplicaState.SERVING)
+        # the box samples the PROCESS registry — where the replica
+        # exports its state gauge — so the kill flush snapshots DOWN
+        bb = blackbox_mod.BlackBox(str(tmp_path / "rX"), box="rX")
+        rep.set_blackbox(bb)
+        assert rep.describe()["blackbox"] == bb.dir
+        rep.kill()
+        recs = blackbox_mod.read_dump(bb.dir)
+        reasons = [r["data"]["reason"] for r in recs
+                   if r["kind"] == "meta"]
+        assert "kill" in reasons
+        # the kill flush's snapshot carries the DOWN gauge
+        snap = [r for r in recs if r["kind"] == "snapshot"][-1]
+        assert snap["data"]["gauges"][
+            "raft.fleet.replica.state{replica=rX}"] == 3.0
+        bb.close(flush=False)
+
+    def test_federator_report_carries_blackbox_path(self):
+        from raft_tpu.obs import federation
+        reg = MetricsRegistry(enabled=True)
+        fed = federation.MetricsFederator({"r0": reg})
+        fed.set_blackbox_path("r0", "/tmp/bb/r0")
+        fed.scrape_once()
+        row = fed.report()["instances"]["r0"]
+        assert row["blackbox"] == "/tmp/bb/r0"
+        fed.set_blackbox_path("r0", None)
+        assert "blackbox" not in fed.report()["instances"]["r0"]
+        fed.close()
+
+
+# -- wire format sanity ----------------------------------------------------
+
+class TestWireFormat:
+    def test_bad_magic_rejected(self, tmp_path):
+        p = str(tmp_path / "bb-000000.seg")
+        with open(p, "wb") as f:
+            f.write(b"NOTMAGIC" + b"\x00" * 16)
+        assert blackbox_mod.read_segment(p) == []
+
+    def test_oversize_length_treated_as_torn(self, tmp_path):
+        p = str(tmp_path / "bb-000000.seg")
+        payload = json.dumps({"kind": "meta", "t_unix": 0,
+                              "reason": "x", "box": "b",
+                              "data": {}}).encode()
+        with open(p, "wb") as f:
+            f.write(blackbox_mod._MAGIC)
+            f.write(struct.pack("<II", len(payload),
+                                zlib.crc32(payload)))
+            f.write(payload)
+            f.write(struct.pack("<II", 1 << 30, 0))   # absurd length
+        recs = blackbox_mod.read_segment(p)
+        assert len(recs) == 1

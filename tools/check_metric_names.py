@@ -199,6 +199,17 @@ REQUIRED_NAMES = (
     "raft.obs.fed.scrape.seconds",
     "raft.obs.fed.instances",
     "raft.obs.fed.stale",
+    # post-mortem observability (ISSUE 18): the metrics-history ring
+    # (frames sampled, edge-triggered mean-shift anomalies) and the
+    # crash-durable black box (flush/bytes/segment accounting plus the
+    # torn-segment recovery counter the kill-9 test pins)
+    "raft.obs.history.frames.total",
+    "raft.obs.history.anomaly",
+    "raft.obs.history.anomaly.total",
+    "raft.obs.blackbox.flushes.total",
+    "raft.obs.blackbox.bytes.total",
+    "raft.obs.blackbox.segments.total",
+    "raft.obs.blackbox.torn.total",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):

@@ -129,12 +129,16 @@ class LockDiscipline(Rule):
     # quality's shadow thread, the SLO poller, the chaos harness, the
     # fleet tier (router callbacks + replicator thread, ISSUE 13), the
     # resource profiler (dispatcher threads + HBM sampler thread
-    # share the ledger, ISSUE 14), and the metric federator (scraper
-    # thread × merge/report readers, ISSUE 16)
+    # share the ledger, ISSUE 14), the metric federator (scraper
+    # thread × merge/report readers, ISSUE 16), and the post-mortem
+    # pair (history sampler thread × endpoint readers; black-box flush
+    # thread × signal/atexit/kill paths, ISSUE 18)
     paths = ("raft_tpu/serve", "raft_tpu/comms", "raft_tpu/mutate",
              "raft_tpu/obs/quality.py", "raft_tpu/obs/slo.py",
              "raft_tpu/obs/profiler.py",
              "raft_tpu/obs/federation.py",
+             "raft_tpu/obs/history.py",
+             "raft_tpu/obs/blackbox.py",
              "raft_tpu/testing/faults.py", "raft_tpu/fleet")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

@@ -528,6 +528,15 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos-duration", type=float, default=5.0,
                     help="default duration (s) of each chaos event "
                          "without an explicit '+<dur>s' suffix")
+    ap.add_argument("--blackbox", type=str, default=None,
+                    help="black-box dump directory (ISSUE 18): attach "
+                         "the metrics-history sampler + a crash-"
+                         "durable flight recorder for the run and "
+                         "write a dump at run end. Under --fleet each "
+                         "replica gets its own box at <dir>/<name> "
+                         "(flushed by Replica.kill — a --chaos "
+                         "kill_replica's dump is read back through "
+                         "tools/doctor.py in the report)")
     args = ap.parse_args(argv)
     if args.mutate_frac and args.server == "dist":
         ap.error("--mutate-frac rides the single-device server "
@@ -589,6 +598,26 @@ def main(argv=None) -> int:
                 {f"r{i}": e.url for i, e in enumerate(endpoints)},
                 interval_s=0.5, fleet=router).start()
             agg = obs.serve(federator=federator, fleet=router)
+        boxes = {}
+        if args.blackbox:
+            # post-mortem plane (ISSUE 18): one box per replica so a
+            # kill_replica chaos kill leaves ITS forensics behind —
+            # Replica.kill() flushes the attached box on the death
+            # path. The history cadence scales to the run length so
+            # even a sub-second smoke banks a few frames.
+            from raft_tpu.obs import blackbox as _blackbox
+            from raft_tpu.obs import history as _history
+            _history.enable_history(
+                interval_s=min(1.0, max(0.1, args.duration / 20.0)))
+            for rep in router.replicas:
+                box = _blackbox.BlackBox(
+                    os.path.join(args.blackbox, rep.name),
+                    box=rep.name, history=_history.history(),
+                    fleet=router).start()
+                rep.set_blackbox(box)
+                if federator is not None:
+                    federator.set_blackbox_path(rep.name, box.dir)
+                boxes[rep.name] = box
         stop = threading.Event()
         chaos_t = (run_chaos_schedule(chaos_events, stop,
                                       router=router,
@@ -655,6 +684,33 @@ def main(argv=None) -> int:
         prof = profile_report(router)
         if prof is not None:
             report["profile"] = prof
+        if boxes:
+            from raft_tpu.obs import history as _history
+            for box in boxes.values():
+                box.close()     # final flush + seal — the run's dump
+            _history.disable_history()
+            bb = {"dir": os.path.abspath(args.blackbox),
+                  "replicas": {n: b.dir for n, b in boxes.items()}}
+            killed = [e for e in (chaos_events or ())
+                      if e[1] == "kill_replica"]
+            if killed:
+                # the post-mortem proof: read the killed replica's
+                # dump back through the offline doctor — the dump a
+                # real crashed process would have left
+                from tools import doctor as _doctor
+                name = f"r{int(killed[0][2] or 0)}"
+                diag = _doctor.diagnose_dump(boxes[name].dir)
+                downs = [t for t in diag["transitions"]
+                         if t["replica"] == name and t["to"] == "down"]
+                bb["killed_replica"] = {
+                    "name": name,
+                    "dump_readable": diag["records"] > 0,
+                    "verdict": diag["verdict"],
+                    "final_transition": downs[-1] if downs else None,
+                    "final_window_deltas": len(
+                        diag["final_window"]["counter_deltas"]),
+                }
+            report["blackbox"] = bb
         print(json.dumps(report), flush=True)
         router.close()
         return 0
@@ -667,6 +723,16 @@ def main(argv=None) -> int:
     if mindex is not None:
         from raft_tpu import mutate
         comp = mutate.Compactor(mindex)
+    ambient_box = None
+    if args.blackbox:
+        # single-server run: one ambient box (the --fleet path above
+        # uses one box per replica instead)
+        from raft_tpu.obs import blackbox as _blackbox
+        from raft_tpu.obs import history as _history
+        _history.enable_history(
+            interval_s=min(1.0, max(0.1, args.duration / 20.0)))
+        ambient_box = _blackbox.enable_blackbox(
+            args.blackbox, exit_hooks=False)
     slo_tracker = None
     if args.demo:
         # declarative SLOs over the run (ISSUE 11): the p99 watermark,
@@ -764,6 +830,8 @@ def main(argv=None) -> int:
             prof = profile_report()
             if prof is not None:
                 report["profile"] = prof
+            if ambient_box is not None:
+                report["blackbox"] = {"dir": ambient_box.dir}
             print(json.dumps(report), flush=True)
     finally:
         if slo_tracker is not None:
@@ -771,6 +839,12 @@ def main(argv=None) -> int:
         if comp is not None:
             comp.close()
         srv.close()
+        if ambient_box is not None:
+            # the run-end dump: final flush + seal, then detach
+            from raft_tpu.obs import blackbox as _blackbox
+            from raft_tpu.obs import history as _history
+            _blackbox.disable_blackbox()
+            _history.disable_history()
     return 0
 
 

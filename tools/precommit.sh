@@ -129,6 +129,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_federation.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# post-mortem observability (ISSUE 18): history rate()/delta() math
+# vs hand-computed deltas, the fires-once anomaly edge, kill-9-mid-
+# flush torn-segment truncation + recovery, the zero-overhead
+# nothing-attached contract, and the loadgen kill_replica →
+# tools/doctor.py dump-readback acceptance path.
+echo "precommit: black-box + history + doctor tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_blackbox.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # distributed serving tier (ISSUE 8): the int8 merge codec round-trip
 # + id-packing exactness, recall-within-0.005-of-f32 on the 8-way CPU
 # mesh, pad-row non-leakage through the distributed scatter, and the

@@ -54,11 +54,23 @@ run() {
   fi
   probe "$stage"
   echo "[$(stamp)] == $stage: $*"
+  # black-box flight recorder (ISSUE 18): every stage runs with a
+  # crash-durable dump attached, archived on exit SUCCESS OR FAILURE —
+  # a relay-down round that kills the client mid-stage still leaves
+  # forensics for tools/doctor.py (the r4 rounds left nothing)
+  export RAFT_TPU_BLACKBOX="$OUT/blackbox/$stage"
+  rm -rf "$RAFT_TPU_BLACKBOX"; mkdir -p "$RAFT_TPU_BLACKBOX"
   if "$@"; then
     date > "$DONE/$stage"
     echo "[$(stamp)] == $stage banked"
   else
     echo "[$(stamp)] == $stage FAILED (rc=$?) — not marked done"
+  fi
+  unset RAFT_TPU_BLACKBOX
+  if [ -n "$(ls -A "$OUT/blackbox/$stage" 2>/dev/null)" ]; then
+    tar czf "$OUT/blackbox_$stage.tgz" -C "$OUT/blackbox" "$stage" \
+      && cp -f "$OUT/blackbox_$stage.tgz" docs/measurements/ \
+      && echo "[$(stamp)] == $stage black box archived"
   fi
 }
 
