@@ -465,6 +465,35 @@ def child_main():
         except Exception as e:
             out["fleet_error"] = repr(e)[:200]
         print(json.dumps(out), flush=True)
+        # tiered row (ISSUE 19): HBM-budgeted hot tier + host cold tier
+        # — QPS at shrinking hot fractions vs the fully-resident
+        # baseline, the bit-identical-parity and zero-compile
+        # contracts, and the overlap fraction (cold fetches hidden
+        # under the hot-tier scan)
+        try:
+            rows = []
+            bench_suite.bench_tiered(rows, n=min(n_ivf, 120_000))
+            for r in rows:
+                if "parity_ok" in r:
+                    out["tiered_resident_qps"] = r["resident_qps"]
+                    out["tiered_qps_hot_1"] = r["qps_hot_1"]
+                    out["tiered_qps_hot_0_5"] = r["qps_hot_0_5"]
+                    out["tiered_qps_hot_0_25"] = r["qps_hot_0_25"]
+                    out["tiered_parity_ok"] = r["parity_ok"]
+                    out["tiered_steady_state_compiles"] = \
+                        r["steady_state_compiles"]
+                    out["tiered_overlap_frac"] = r["overlap_frac"]
+                    out["tiered_fetch_mb_s"] = r["fetch_mb_s"]
+                    out["tiered_servable_rows_x"] = \
+                        r["servable_rows_x"]
+                    out["tiered_qps_ratio_vs_resident"] = \
+                        r["qps_ratio_vs_resident"]
+                    out["tiered_qps_ratio_ok"] = r["qps_ratio_ok"]
+                elif "error" in r:
+                    out.setdefault("tiered_error", r["error"])
+        except Exception as e:
+            out["tiered_error"] = repr(e)[:200]
+        print(json.dumps(out), flush=True)
     return 0
 
 

@@ -1784,6 +1784,101 @@ def bench_host_ivf(results):
         "build_s": round(t_b, 2)})
 
 
+def bench_tiered(results, n=None, nlists=64):
+    """Tiered-serving bench (ISSUE 19): QPS + recall at hot_frac ∈
+    {1.0, 0.5, 0.25} vs the fully-resident baseline at the SAME
+    (nq, k, n_probes) operating point. The acceptance figures ride
+    the row: bit-identical ids at every hot fraction
+    (``parity_hot_*`` / ``parity_ok``), zero steady-state compiles
+    over the measured windows (``steady_state_compiles`` from
+    ``raft.plan.cache.*``), overlap fraction > 0 (cold fetches hidden
+    under the hot-tier scan) and the servable-rows headline — the
+    corpus-to-budget multiplier at the smallest hot fraction. CPU
+    smoke gate: a corpus larger than the hot budget must serve at
+    ≥ 0.5× the fully-resident QPS (``qps_ratio_ok``).
+
+    Knobs: ``BENCH_TIERED_N`` (rows, default 120k)."""
+    from raft_tpu import obs
+    from raft_tpu.neighbors import ivf_flat, tiered
+    n = int(os.environ.get("BENCH_TIERED_N", n or 120_000))
+    d, nq, k = 64, 128, 32
+    n_probes = min(16, nlists)
+    metric = f"tiered_search_{n//1000}kx{d}_q{nq}_k{k}_p{n_probes}"
+    try:
+        db, q = _ann_dataset(n, d, nq)
+        q_np = np.asarray(q)
+        index = ivf_flat.build(db, ivf_flat.IndexParams(
+            n_lists=nlists, kmeans_n_iters=10))
+        # probe scan order: the order-sensitive top-k tie-break path
+        # the tiered merge reproduces — the parity reference AND the
+        # QPS yardstick
+        sp = ivf_flat.SearchParams(n_probes=n_probes,
+                                   scan_order="probe")
+        t_res = _time(lambda: ivf_flat.search(index, q, k, sp),
+                      reps=3)
+        _, i_ref = ivf_flat.search(index, q, k, sp)
+        i_ref_np = np.asarray(i_ref)
+        qps_res = nq / t_res
+        row = {"metric": metric, "unit": "queries/s",
+               "resident_qps": round(qps_res, 1),
+               "recall": round(_ivf_recall(i_ref_np, db, q, k), 4),
+               "n_probes": n_probes}
+        parity_all, compiles = True, 0
+        overlap_frac = fetch_mb_s = qps_cold = None
+        for hot_frac in (1.0, 0.5, 0.25):
+            tindex = tiered.from_index(
+                index, tiered.TieredConfig(hot_frac=hot_frac))
+            plan = tiered.build_plan(tindex, q_np, k, sp)
+            _, i_t = plan.search(q_np, block=True)      # settle
+            parity = bool(np.array_equal(np.asarray(i_t), i_ref_np))
+            parity_all = parity_all and parity
+            before = obs.snapshot()
+            t = _time(lambda: plan.search(q_np, block=True), reps=3)
+            diff = obs.snapshot_diff(before, obs.snapshot())
+            cnt = diff.get("counters", {})
+
+            def csum(name):
+                return sum(v for k_, v in cnt.items()
+                           if k_ == name or k_.startswith(name + "{"))
+
+            compiles += int(csum("raft.plan.cache.misses")
+                            + csum("raft.plan.build.total"))
+            tag = f"{hot_frac:g}".replace(".", "_")
+            row[f"qps_hot_{tag}"] = round(nq / t, 1)
+            row[f"parity_hot_{tag}"] = parity
+            fetch_s = csum("raft.tiered.fetch.seconds")
+            if hot_frac < 1.0 and fetch_s > 0:
+                overlap_frac = round(
+                    csum("raft.tiered.overlap.seconds") / fetch_s, 4)
+                fetch_mb_s = round(csum("raft.tiered.fetch.bytes")
+                                   / 2 ** 20 / fetch_s, 1)
+            if hot_frac == 0.25:
+                qps_cold = nq / t
+                total_b = tindex.n_lists * tindex.bytes_per_list
+                budget_b = max(1, tindex.budget_bytes)
+                # the headline: rows servable per byte of hot budget —
+                # a corpus this many times the pinned footprint serves
+                # with full parity
+                row["servable_rows"] = n
+                row["servable_rows_x"] = round(total_b / budget_b, 2)
+                row["budget_mb"] = round(budget_b / 2 ** 20, 2)
+                row["hot_lists"] = tindex.hot_lists
+        ratio = (qps_cold / max(qps_res, 1e-9)
+                 if qps_cold is not None else None)
+        row.update({
+            "value": row.get("qps_hot_0_25"),
+            "parity_ok": parity_all,
+            "steady_state_compiles": compiles,
+            "overlap_frac": overlap_frac,
+            "fetch_mb_s": fetch_mb_s,
+            "qps_ratio_vs_resident": None if ratio is None
+            else round(ratio, 3),
+            "qps_ratio_ok": ratio is not None and ratio >= 0.5})
+        results.append(row)
+    except Exception as e:
+        results.append({"metric": metric, "error": repr(e)[:200]})
+
+
 # Value-first order (round-4 lesson: the tunnel dies mid-campaign; with
 # streaming prints, whatever completes is banked — so the headline rows
 # the judge checks come first and the long-compile pairwise family last)
@@ -1792,7 +1887,7 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_pq4,
           bench_ivf_bq, bench_serve, bench_serve_sharded,
           bench_mutate, bench_chaos, bench_quality, bench_fleet,
-          bench_sharded_build,
+          bench_tiered, bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,
           bench_ivf_flat_int8, bench_linalg_random, bench_ball_cover,

@@ -26,6 +26,7 @@ from raft_tpu.neighbors import serialize
 from raft_tpu.neighbors import processing
 from raft_tpu.neighbors import host_memory
 from raft_tpu.neighbors import plan
+from raft_tpu.neighbors import tiered
 
 __all__ = [
     "IndexParams", "SearchParams",
@@ -33,5 +34,5 @@ __all__ = [
     "haversine_knn",
     "eps_neighbors_l2sq", "ivf_flat", "ivf_pq", "ivf_bq", "ball_cover",
     "refine",
-    "serialize", "processing", "host_memory", "plan",
+    "serialize", "processing", "host_memory", "plan", "tiered",
 ]

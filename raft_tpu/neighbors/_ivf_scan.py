@@ -26,10 +26,12 @@ touches probed lists). ``search()`` picks automatically.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from raft_tpu.core.precision import matmul_precision
@@ -233,6 +235,83 @@ def count_coarse_fallback(n_probes: int, use_pallas: bool) -> None:
     if use_pallas and n_probes > 256:
         from raft_tpu import obs
         obs.counter("raft.ivf_scan.coarse.fallback").inc()
+
+
+class ProbeStats:
+    """Bounded host-side per-list probe-mass accumulator — the hotness
+    signal the tiered placement policy (and any future multi-tenant
+    router) reads. One ``np.bincount`` per batch over the
+    already-materialized coarse output; never called from inside a
+    trace (same host-side-only discipline as
+    :func:`count_coarse_fallback`). Memory is bounded: when more than
+    ``2 * bound`` lists are tracked, the tail below the top ``bound``
+    by mass is dropped (probe mass is heavy-headed by construction —
+    that tail is exactly the cold set)."""
+
+    GUARDED_BY = ("_mass", "_batches", "_total")
+
+    def __init__(self, bound: int = 4096):
+        self._lock = threading.Lock()
+        self._bound = max(1, int(bound))
+        self._mass: dict = {}
+        self._batches = 0
+        self._total = 0
+
+    def note(self, probes_np) -> None:
+        """Fold one coarse output (any int array of list ids) in."""
+        flat = np.asarray(probes_np).reshape(-1)
+        if flat.size == 0:
+            return
+        counts = np.bincount(flat)
+        nz = np.nonzero(counts)[0]
+        with self._lock:
+            self._batches += 1
+            self._total += int(flat.size)
+            for lid in nz:
+                li = int(lid)
+                self._mass[li] = self._mass.get(li, 0) + int(counts[li])
+            if len(self._mass) > 2 * self._bound:
+                keep = sorted(self._mass.items(),
+                              key=lambda kv: (-kv[1], kv[0]))
+                self._mass = dict(keep[:self._bound])
+
+    def histogram(self, n: int = 16):
+        """Top-``n`` ``(list_id, probe_mass)`` pairs, mass-descending
+        (ties by list id for determinism)."""
+        with self._lock:
+            items = sorted(self._mass.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+        return items[:max(0, int(n))]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._mass = {}
+            self._batches = 0
+            self._total = 0
+
+
+_GLOBAL_PROBE_STATS = ProbeStats()
+
+
+def note_probes(probes_np, stats: Optional[ProbeStats] = None) -> None:
+    """Export per-list probe mass from one coarse output, cheaply:
+    ``raft.ivf_scan.probes.{batches,mass}`` counters plus the bounded
+    top-N tracker behind :func:`probe_histogram`. Host-side only, like
+    :func:`count_coarse_fallback` — call with the materialized probes,
+    never under a trace."""
+    from raft_tpu import obs
+    flat = np.asarray(probes_np)
+    obs.counter("raft.ivf_scan.probes.batches").inc()
+    obs.counter("raft.ivf_scan.probes.mass").inc(int(flat.size))
+    _GLOBAL_PROBE_STATS.note(flat)
+    if stats is not None:
+        stats.note(flat)
+
+
+def probe_histogram(n: int = 16):
+    """Top-``n`` hottest lists by cumulative probe mass, process-wide
+    (the ``raft.ivf_scan.probes.*`` tracker)."""
+    return _GLOBAL_PROBE_STATS.histogram(n)
 
 
 @functools.partial(jax.jit,

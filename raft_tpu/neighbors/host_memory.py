@@ -28,6 +28,7 @@ from jax import lax
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors._ivf_scan import note_probes
 from raft_tpu.neighbors.ivf_flat import (
     Index,
     IndexParams,
@@ -325,6 +326,7 @@ def search(index: HostIvfFlat, queries, k: int,
     coarse = _coarse_scores(q, index.centers, kind)
     _, probes = lax.top_k(-coarse, n_probes)      # (nq, n_probes)
     probes_np = np.asarray(probes)
+    note_probes(probes_np)     # hotness export (raft.ivf_scan.probes.*)
 
     # host side: union of probed lists, fetched once per batch; pad
     # slots (pow2 bucketing) transfer zeros with -1 ids, never real data

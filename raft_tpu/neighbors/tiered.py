@@ -1,0 +1,678 @@
+"""Tiered HBM/host-RAM IVF serving: hot lists pinned on device, cold
+lists prefetched under the hot-tier scan.
+
+Today an index must be fully device-resident to serve, so HBM — not
+the corpus — caps servable rows per chip (ROADMAP item 3).
+``host_memory`` already serves past HBM but pays the full probe
+working set in transfers every batch. This module splits the
+difference with a two-tier layout:
+
+* **hot tier** — the highest-probe-mass lists live in a fixed-capacity
+  device table (``(hot_cap + 1, max_list, ...)``; the extra slot is a
+  permanent zeros/-1 pad target). Hotness is an EMA over per-list
+  probe mass (the ``_ivf_scan.ProbeStats`` export); promotion /
+  demotion happens ONLY at :meth:`TieredIndex.refresh` boundaries,
+  under an explicit HBM byte budget derived from the profiler's
+  ``headroom_frac`` guardrail (or set explicitly). Capacity moves
+  along the pre-warmed ``hot_capacities`` pow2 ladder, so a budget
+  drop swaps to a smaller compiled shape instead of recompiling — and
+  the placement policy never allocates a table the budget cannot
+  hold, so no OOM path is reachable from it.
+* **cold tier** — everything else stays in host RAM in the
+  ``HostIvfFlat`` transfer-ready padded layout, staged per batch into
+  pre-allocated fixed-shape rungs (``stage_capacities``, pow2 over
+  the unique cold-list count) and ``device_put`` **while the hot-tier
+  scan is already in flight** — the transfer window hides under
+  device compute (async dispatch), measured by
+  ``raft.tiered.overlap.*``.
+
+Search = coarse (centers always resident) → partition probes by tier
+→ enqueue hot scan → stage + ``device_put`` cold payload → pre-warmed
+cold scan → device top-k merge. Both tiers run the shared
+``ivf_flat._fine_phase`` over the same row values, so the merged
+top-k is bit-identical to the fully-resident probe-order search at
+the same ``(nq, k, n_probes)`` point.
+
+Metrics (``raft.tiered.*``): ``probes.{hot,cold}`` tier hit/miss,
+``hit_rate``, ``fetch.{bytes,seconds}``, ``overlap.{seconds,frac}``,
+``{promotions,demotions}.total``, ``refresh.total``, ``search.total``,
+``budget.bytes``, ``hot.{lists,bytes}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors._ivf_scan import ProbeStats, note_probes
+from raft_tpu.neighbors.host_memory import HostIvfFlat, _probe_scan, to_host
+from raft_tpu.neighbors.ivf_flat import (
+    Index,
+    SearchParams,
+    _coarse_scores,
+    _metric_kind,
+    _postprocess,
+)
+from raft_tpu.obs import profiler, spans
+
+__all__ = ["TieredConfig", "TieredIndex", "TieredPlan", "build_plan",
+           "build_ladder", "from_index", "from_host"]
+
+# Compile-surface rung declarations (graftlint GL012–GL014): the
+# tiered tier's key dimensions. ``hot_cap`` and ``stage_cap`` are the
+# GRIDs — capacity moves between pre-warmed pow2 rungs (the mutate
+# delta-ladder trick), never recompiles; ``_prewarm`` is the GL013
+# warm loop over both.
+COMPILE_SURFACE_RUNGS = {
+    "nq": ("shapes", (1, 8, 32, 128),
+           "serving batch shapes — same ladder grid as serve/ladder.py"),
+    "n_probes": ("rungs", None,
+                 "the n_probes degradation ladder — config-supplied; "
+                 "lower rungs probe (and therefore fetch) less"),
+    "hot_cap": ("hot_capacities", None,
+                "pow2 hot-table capacity ladder — demotion under a "
+                "budget drop swaps DOWN between pre-warmed rungs"),
+    "stage_cap": ("stage_capacities", None,
+                  "pow2 cold staging rung ladder — the per-batch "
+                  "unique cold-list count buckets up to a rung"),
+    "k": ("k", None, "result depth — fixed per plan at construction"),
+}
+
+_SQRT_METRICS = (DistanceType.L2SqrtExpanded,
+                 DistanceType.L2SqrtUnexpanded)
+
+
+def _pow2_ladder(top: int, lo: int = 8) -> Tuple[int, ...]:
+    """Ascending pow2 rungs covering ``(0, top]``: ``lo, 2·lo, …`` plus
+    the pow2 ceiling of ``top`` itself."""
+    top = max(1, int(top))
+    cap = 1 << max(top - 1, 0).bit_length()    # pow2 ceiling
+    rungs = []
+    c = min(lo, cap)
+    while c < cap:
+        rungs.append(c)
+        c *= 2
+    rungs.append(cap)
+    return tuple(rungs)
+
+
+@functools.partial(jax.jit, static_argnames=("n_probes", "kind"))
+def _coarse_topk(queries, centers, n_probes: int, kind: str):
+    """Coarse phase on the always-resident centers → (nq, n_probes)
+    probed list ids."""
+    return lax.top_k(-_coarse_scores(queries, centers, kind),
+                     n_probes)[1]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _merge_topk(d_a, i_a, d_b, i_b, k: int):
+    """Fold two per-tier (nq, k) candidate sets into one — the same
+    concat + ``lax.top_k`` merge step ``_fine_phase`` runs per probe
+    rank, so the merged set equals the single-scan result."""
+    cat_d = jnp.concatenate([d_a, d_b], axis=1)
+    cat_i = jnp.concatenate([i_a, i_b], axis=1)
+    nd, sel = lax.top_k(-cat_d, k)
+    return -nd, jnp.take_along_axis(cat_i, sel, axis=1)
+
+
+@dataclasses.dataclass(frozen=True)
+class TieredConfig:
+    """Placement policy knobs.
+
+    Exactly one budget source applies, in precedence order:
+    ``budget_bytes`` (explicit), ``hot_frac`` (that fraction of the
+    total list payload), or the live HBM headroom signal —
+    ``max(0, bytes_limit · (1 - headroom_frac) - bytes_in_use)`` from
+    :func:`raft_tpu.core.memory.hbm_stats`, i.e. pin as much as fits
+    while keeping the PR 14 ``/healthz`` guardrail fraction free."""
+
+    budget_bytes: Optional[int] = None
+    hot_frac: Optional[float] = None
+    headroom_frac: Optional[float] = None
+    ema_decay: float = 0.8
+    # staging rung ceiling: one batch's unique cold lists above this
+    # are staged in multiple chunks (bounds transient device bytes)
+    max_stage_lists: int = 1024
+
+
+class TieredIndex:
+    """Two-tier IVF-Flat index: device-pinned hot lists + host-RAM
+    cold lists behind fixed-shape staging rungs. Build via
+    :func:`from_index` / :func:`from_host`, serve via
+    :func:`build_plan` (or drop it straight into
+    ``SearchServer.from_index`` / ``PlanLadder.build``)."""
+
+    # graftlint GL003: the placement / prefetcher state — every field
+    # is swapped or read under ``_lock`` (search takes an immutable
+    # snapshot; refresh replaces wholesale)
+    GUARDED_BY = ("_hot_slot", "_hot_ids", "_hot_cap", "_hot_tables",
+                  "_mass", "_ema", "_stage", "_budget_bytes",
+                  "_cum_probes", "_cum_hot", "_cum_fetch_s",
+                  "_cum_overlap_s")
+
+    def __init__(self, host: HostIvfFlat,
+                 config: Optional[TieredConfig] = None):
+        self.cfg = config if config is not None else TieredConfig()
+        self.centers = host.centers
+        self.lists_data = host.lists_data
+        self.lists_norms = host.lists_norms
+        self.lists_indices = host.lists_indices
+        self.metric = host.metric
+        self.size = int(host.size)
+        self.scale = float(host.scale)
+        self.plan_cache: Dict[tuple, "TieredPlan"] = {}
+        self.probe_stats = ProbeStats()
+        # per-list payload bytes in the padded layout (the unit of
+        # both the budget math and the fetch accounting)
+        self.bytes_per_list = int(self.lists_data[0].nbytes
+                                  + self.lists_norms[0].nbytes
+                                  + self.lists_indices[0].nbytes)
+        self.hot_capacities = _pow2_ladder(self.n_lists)
+        self.stage_capacities = _pow2_ladder(
+            min(self.n_lists, max(1, int(self.cfg.max_stage_lists))))
+        self._lock = threading.Lock()
+        self._hot_slot = np.full(self.n_lists, -1, np.int32)
+        self._hot_ids = np.zeros(0, np.int64)
+        self._hot_cap = 0
+        self._hot_tables = None      # (data, norms, ids) device arrays
+        self._mass = np.zeros(self.n_lists, np.float64)
+        self._ema = np.zeros(self.n_lists, np.float64)
+        self._stage: Dict[int, dict] = {}
+        self._budget_bytes = 0
+        self._cum_probes = 0
+        self._cum_hot = 0
+        self._cum_fetch_s = 0.0
+        self._cum_overlap_s = 0.0
+        # the highest capacity rung plans will pre-warm — later budget
+        # RAISES clamp here (an unwarmed promotion would compile in
+        # steady state); drops swap down the warmed ladder
+        self._warm_top = self._rung_for(self._derive_budget(None))
+        self.refresh()
+
+    # -- geometry ----------------------------------------------------------
+    @property
+    def n_lists(self) -> int:
+        return int(self.centers.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centers.shape[1])
+
+    @property
+    def max_list(self) -> int:
+        return int(self.lists_data.shape[1])
+
+    @property
+    def hot_lists(self) -> int:
+        with self._lock:
+            return int(len(self._hot_ids))
+
+    @property
+    def budget_bytes(self) -> int:
+        with self._lock:
+            return int(self._budget_bytes)
+
+    def table_bytes(self, cap: int) -> int:
+        """Device bytes of a hot table at capacity rung ``cap`` (the
+        +1 is the permanent pad slot)."""
+        return (int(cap) + 1) * self.bytes_per_list if cap else 0
+
+    # -- placement policy --------------------------------------------------
+    def _derive_budget(self, budget_bytes: Optional[int]) -> int:
+        if budget_bytes is not None:
+            return max(0, int(budget_bytes))
+        if self.cfg.budget_bytes is not None:
+            return max(0, int(self.cfg.budget_bytes))
+        total = self.n_lists * self.bytes_per_list
+        if self.cfg.hot_frac is not None:
+            return max(0, int(float(self.cfg.hot_frac) * total))
+        from raft_tpu.core.memory import hbm_stats
+        stats = hbm_stats(self.centers.devices().pop()
+                          if hasattr(self.centers, "devices")
+                          else None)
+        frac = (self.cfg.headroom_frac
+                if self.cfg.headroom_frac is not None
+                else profiler.ProfilerConfig().hbm_headroom_frac)
+        free = (stats["bytes_limit"] * (1.0 - float(frac))
+                - stats["bytes_in_use"])
+        return max(0, min(int(free), total))
+
+    def _rung_for(self, budget: int) -> int:
+        """Largest capacity rung whose pinned payload fits ``budget``
+        (0 = no hot tier). The permanent pad slot (one list of zeros)
+        rides as fixed overhead rather than against the budget — so
+        ``hot_frac=1.0`` pins the whole index. This is the no-OOM
+        invariant: the policy only ever allocates
+        ``rung * bytes_per_list`` budgeted bytes."""
+        rung = 0
+        for cap in self.hot_capacities:
+            if cap * self.bytes_per_list <= budget:
+                rung = cap
+        return rung
+
+    def refresh(self, budget_bytes: Optional[int] = None) -> dict:
+        """Re-score hotness (EMA over the probe mass since the last
+        refresh) and promote/demote under the byte budget. Returns a
+        summary dict; increments ``raft.tiered.{promotions,demotions}
+        .total``. Capacity only moves along the pre-warmed rung
+        ladder, so a refresh never compiles."""
+        with self._lock:
+            decay = float(self.cfg.ema_decay)
+            self._ema = decay * self._ema + (1.0 - decay) * self._mass
+            self._mass[:] = 0.0
+            budget = self._derive_budget(budget_bytes)
+            rung = min(self._rung_for(budget), self._warm_top)
+            n_pin = min(rung, self.n_lists)
+            # stable mass-descending order → deterministic placement
+            order = np.argsort(-self._ema, kind="stable")
+            new_ids = np.sort(order[:n_pin].astype(np.int64))
+            old = set(int(i) for i in self._hot_ids)
+            new = set(int(i) for i in new_ids)
+            promoted = len(new - old)
+            demoted = len(old - new)
+            if rung != self._hot_cap or promoted or demoted:
+                self._install_hot_locked(rung, new_ids)
+            self._budget_bytes = budget
+        obs.counter("raft.tiered.refresh.total").inc()
+        if promoted:
+            obs.counter("raft.tiered.promotions.total").inc(promoted)
+        if demoted:
+            obs.counter("raft.tiered.demotions.total").inc(demoted)
+        obs.gauge("raft.tiered.budget.bytes").set(float(budget))
+        obs.gauge("raft.tiered.hot.lists").set(float(n_pin))
+        obs.gauge("raft.tiered.hot.bytes").set(
+            float(self.table_bytes(rung)))
+        return {"budget_bytes": budget, "hot_cap": rung,
+                "hot_lists": n_pin, "promoted": promoted,
+                "demoted": demoted}
+
+    def _install_hot_locked(self, rung: int, new_ids) -> None:
+        """Swap the device hot table to ``rung`` holding ``new_ids``
+        (sorted). Caller holds the lock."""
+        if rung == 0:
+            self._hot_tables = None
+            self._hot_ids = np.zeros(0, np.int64)
+            self._hot_slot = np.full(self.n_lists, -1, np.int32)
+            self._hot_cap = 0
+            return
+        n = len(new_ids)
+        data = np.zeros((rung + 1,) + self.lists_data.shape[1:],
+                        self.lists_data.dtype)
+        norms = np.zeros((rung + 1,) + self.lists_norms.shape[1:],
+                         self.lists_norms.dtype)
+        ids = np.full((rung + 1,) + self.lists_indices.shape[1:], -1,
+                      self.lists_indices.dtype)
+        np.take(self.lists_data, new_ids, axis=0, out=data[:n])
+        np.take(self.lists_norms, new_ids, axis=0, out=norms[:n])
+        np.take(self.lists_indices, new_ids, axis=0, out=ids[:n])
+        self._hot_tables = (jnp.asarray(data), jnp.asarray(norms),
+                            jnp.asarray(ids))
+        slot = np.full(self.n_lists, -1, np.int32)
+        slot[new_ids] = np.arange(n, dtype=np.int32)
+        self._hot_slot = slot
+        self._hot_ids = np.asarray(new_ids, np.int64)
+        self._hot_cap = int(rung)
+
+    # -- staging -----------------------------------------------------------
+    def _stage_rung(self, want: int) -> int:
+        for cap in self.stage_capacities:
+            if want <= cap:
+                return cap
+        return self.stage_capacities[-1]
+
+    def _stage_acquire(self, rung: int):
+        """Check the pooled staging buffers for ``rung`` out (or
+        allocate a transient set when another search holds them).
+        Returns ``(bufs, guard)`` — block on ``guard`` before refilling
+        (the previous batch's transfer may still read the buffer)."""
+        with self._lock:
+            entry = self._stage.pop(rung, None)
+        if entry is not None:
+            return entry["bufs"], entry["guard"]
+        data = np.zeros((rung + 1,) + self.lists_data.shape[1:],
+                        self.lists_data.dtype)
+        norms = np.zeros((rung + 1,) + self.lists_norms.shape[1:],
+                         self.lists_norms.dtype)
+        ids = np.full((rung + 1,) + self.lists_indices.shape[1:], -1,
+                      self.lists_indices.dtype)
+        return (data, norms, ids), None
+
+    def _stage_release(self, rung: int, bufs, guard) -> None:
+        with self._lock:
+            if rung not in self._stage:
+                self._stage[rung] = {"bufs": bufs, "guard": guard}
+
+    # -- search ------------------------------------------------------------
+    def _tier_search(self, q, k: int, n_probes: int
+                     ) -> Tuple[jax.Array, jax.Array]:
+        """The prepared two-tier search at one (nq, k, n_probes)
+        point. All compiled shapes were pre-warmed by the owning
+        plan's build, so this path never traces in steady state."""
+        kind = _metric_kind(self.metric)
+        sqrt = self.metric in _SQRT_METRICS
+        if self.metric == DistanceType.CosineExpanded:
+            q = q / jnp.maximum(
+                jnp.linalg.norm(q, axis=1, keepdims=True), 1e-30)
+        scale = jnp.float32(self.scale)
+        probes = _coarse_topk(q, self.centers, n_probes, kind)
+        probes_np = np.asarray(probes)      # the one mid-search sync
+        note_probes(probes_np, stats=self.probe_stats)
+        with self._lock:
+            hot_slot = self._hot_slot
+            hot_cap = self._hot_cap
+            hot_tables = self._hot_tables
+            np.add.at(self._mass, probes_np.reshape(-1), 1.0)
+        pos_hot = hot_slot[probes_np]                  # (nq, n_probes)
+        hot_mask = pos_hot >= 0
+        n_hot = int(hot_mask.sum())
+        n_total = int(probes_np.size)
+
+        parts = []
+        t_enq = time.perf_counter()
+        if hot_tables is not None and n_hot:
+            ph = np.where(hot_mask, pos_hot, hot_cap).astype(np.int32)
+            parts.append(_probe_scan(
+                q, hot_tables[0], hot_tables[1], hot_tables[2],
+                jnp.asarray(ph), scale, k=k, sqrt=sqrt, kind=kind))
+
+        fetch_s = 0.0
+        fetch_bytes = 0
+        ucold = np.unique(probes_np[~hot_mask]) if n_hot < n_total \
+            else np.zeros(0, np.int64)
+        # stage cold lists in rung-sized chunks, each device_put
+        # issued while the hot scan is (asynchronously) in flight
+        off = 0
+        while off < len(ucold):
+            chunk = ucold[off:off + self.stage_capacities[-1]]
+            off += len(chunk)
+            stage_cap = self._stage_rung(len(chunk))
+            bufs, guard = self._stage_acquire(stage_cap)
+            if guard is not None:
+                jax.block_until_ready(guard)
+            t_f0 = time.perf_counter()
+            u = len(chunk)
+            bd, bn, bi = bufs
+            np.take(self.lists_data, chunk, axis=0, out=bd[:u])
+            np.take(self.lists_norms, chunk, axis=0, out=bn[:u])
+            np.take(self.lists_indices, chunk, axis=0, out=bi[:u])
+            dd = jax.device_put(bd)
+            dn = jax.device_put(bn)
+            di = jax.device_put(bi)
+            fetch_s += time.perf_counter() - t_f0
+            fetch_bytes += bd.nbytes + bn.nbytes + bi.nbytes
+            idx = np.searchsorted(chunk, probes_np)
+            idx = np.minimum(idx, u - 1)
+            in_chunk = (~hot_mask) & (chunk[idx] == probes_np)
+            pc = np.where(in_chunk, idx, stage_cap).astype(np.int32)
+            parts.append(_probe_scan(
+                q, dd, dn, di, jnp.asarray(pc), scale, k=k, sqrt=sqrt,
+                kind=kind))
+            self._stage_release(stage_cap, bufs, (dd, dn, di))
+
+        # the overlap accounting: fetch walls above were spent while
+        # the hot-tier program ran under async dispatch — credit them
+        # as hidden only while the hot result is demonstrably not
+        # ready yet (conservative: a finished hot scan credits zero)
+        overlap_s = 0.0
+        if parts and hot_tables is not None and n_hot and fetch_s > 0:
+            is_ready = getattr(parts[0][0], "is_ready", None)
+            inflight = (not is_ready()) if is_ready is not None else True
+            if inflight:
+                overlap_s = fetch_s
+        d, i = parts[0] if parts else (
+            jnp.full((q.shape[0], k), jnp.inf, jnp.float32),
+            jnp.full((q.shape[0], k), -1, jnp.int32))
+        for d_p, i_p in parts[1:]:
+            d, i = _merge_topk(d, i, d_p, i_p, k)
+        self._note_search(n_total, n_hot, fetch_s, fetch_bytes,
+                          overlap_s, time.perf_counter() - t_enq)
+        return _postprocess(d, self.metric), i
+
+    def _note_search(self, n_total: int, n_hot: int, fetch_s: float,
+                     fetch_bytes: int, overlap_s: float,
+                     wall_s: float) -> None:
+        obs.counter("raft.tiered.search.total").inc()
+        obs.counter("raft.tiered.probes.hot").inc(n_hot)
+        obs.counter("raft.tiered.probes.cold").inc(n_total - n_hot)
+        if fetch_bytes:
+            obs.counter("raft.tiered.fetch.bytes").inc(fetch_bytes)
+            obs.counter("raft.tiered.fetch.seconds").inc(fetch_s)
+            obs.counter("raft.tiered.overlap.seconds").inc(overlap_s)
+        with self._lock:
+            self._cum_probes += n_total
+            self._cum_hot += n_hot
+            self._cum_fetch_s += fetch_s
+            self._cum_overlap_s += overlap_s
+            hit = (self._cum_hot / self._cum_probes
+                   if self._cum_probes else 0.0)
+            ofr = (self._cum_overlap_s / self._cum_fetch_s
+                   if self._cum_fetch_s > 0 else 0.0)
+        obs.gauge("raft.tiered.hit_rate").set(hit)
+        obs.gauge("raft.tiered.overlap.frac").set(ofr)
+
+
+class TieredPlan:
+    """The plan-contract handle over one prepared ``(nq, k, n_probes)``
+    point of a :class:`TieredIndex` — drop-in for
+    ``plan.SearchPlan`` in the serve ladder (``.search(q, block=)``,
+    ``.nq`` / ``.k`` / ``.n_probes`` / ``.dim``)."""
+
+    family = "tiered_ivf_flat"
+
+    def __init__(self, index: TieredIndex, nq: int, k: int,
+                 n_probes: int, key: tuple):
+        self.index = index
+        self.nq = int(nq)
+        self.k = int(k)
+        self.n_probes = int(n_probes)
+        self.dim = index.dim
+        self.key = key
+
+    def search(self, queries, block: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+        """Serve one batch of exactly ``plan.nq`` queries → (dists,
+        ids). The coarse→partition step syncs once mid-call (the
+        probe ids drive the host-side staging); everything after is
+        async until ``block``."""
+        prof = block and profiler.sampled()
+        t_call = time.perf_counter()
+        q = as_array(queries).astype(jnp.float32)
+        expects(q.shape == (self.nq, self.dim),
+                "tiered plan.search: queries %s != plan shape (%d, %d)",
+                q.shape, self.nq, self.dim)
+        obs.counter("raft.plan.search.total").inc()
+        obs.counter("raft.plan.search.queries").inc(self.nq)
+        with spans.span("raft.tiered.search", nq=self.nq, k=self.k,
+                        n_probes=self.n_probes,
+                        hot_lists=self.index.hot_lists,
+                        blocked=block):
+            d, i = self.index._tier_search(q, self.k, self.n_probes)
+            t_enq = t_ready = 0.0
+            if block:
+                t_enq = time.perf_counter()
+                jax.block_until_ready((d, i))
+                t_ready = time.perf_counter()
+                if prof:
+                    spans.add_child_span(
+                        profiler.SYNC_SPAN, t_enq, t_ready - t_enq,
+                        program="tiered",
+                        host_ms=round((t_enq - t_call) * 1e3, 3),
+                        device_ms=round((t_ready - t_enq) * 1e3, 3))
+        if prof and block:
+            profiler.record_sample(
+                program="tiered", family=self.family,
+                rung=self.n_probes,
+                host_s=(t_enq - t_call)
+                + (time.perf_counter() - t_ready),
+                device_s=t_ready - t_enq)
+        return d, i
+
+    def search_batched(self, queries, block: bool = True
+                       ) -> Tuple[jax.Array, jax.Array]:
+        """Arbitrary query counts through the plan's compiled shape
+        (pad-to-shape per sub-batch, concatenate, one trim)."""
+        q = as_array(queries).astype(jnp.float32)
+        expects(q.shape[1] == self.dim,
+                "tiered plan.search_batched: dim mismatch (%d != %d)",
+                q.shape[1], self.dim)
+        if q.shape[0] == self.nq:
+            return self.search(q, block=block)
+        outs = []
+        for off in range(0, q.shape[0], self.nq):
+            qb = q[off:off + self.nq]
+            if qb.shape[0] < self.nq:
+                qb = jnp.concatenate(
+                    [qb, jnp.zeros((self.nq - qb.shape[0], self.dim),
+                                   jnp.float32)])
+            outs.append(self.search(qb, block=False))
+        d = jnp.concatenate([o[0] for o in outs])[:q.shape[0]]
+        i = jnp.concatenate([o[1] for o in outs])[:q.shape[0]]
+        if block:
+            jax.block_until_ready((d, i))
+        return d, i
+
+
+def from_host(host: HostIvfFlat,
+              config: Optional[TieredConfig] = None) -> TieredIndex:
+    """Wrap a host-resident index (its payload arrays are shared, not
+    copied)."""
+    return TieredIndex(host, config)
+
+
+def from_index(index: Index,
+               config: Optional[TieredConfig] = None) -> TieredIndex:
+    """Tier a fully-resident ``ivf_flat.Index``: payload moves to host
+    RAM (``host_memory.to_host``), then the placement policy pins what
+    the budget affords back onto the device."""
+    return TieredIndex(to_host(index), config)
+
+
+def _prewarm(index: TieredIndex, nq: int, k: int, n_probes: int
+             ) -> None:
+    """GL013 warm coverage: loop every grid rung
+    (``hot_capacities`` up to the budgeted top, all
+    ``stage_capacities``) through the shared scan + the coarse and
+    merge programs, so steady-state serving — including every
+    refresh-boundary capacity swap — replays compiled code."""
+    kind = _metric_kind(index.metric)
+    sqrt = index.metric in _SQRT_METRICS
+    q = jnp.zeros((nq, index.dim), jnp.float32)
+    scale = jnp.float32(index.scale)
+    pos = jnp.zeros((nq, n_probes), jnp.int32)
+    _coarse_topk(q, index.centers, n_probes, kind)
+    for hot_cap in index.hot_capacities:
+        if hot_cap > index._warm_top:
+            continue
+        data = jnp.zeros((hot_cap + 1, index.max_list, index.dim),
+                         index.lists_data.dtype)
+        norms = jnp.zeros((hot_cap + 1, index.max_list),
+                          index.lists_norms.dtype)
+        ids = jnp.full((hot_cap + 1, index.max_list), -1,
+                       index.lists_indices.dtype)
+        _probe_scan(q, data, norms, ids, pos, scale, k=k, sqrt=sqrt,
+                    kind=kind)
+    for stage_cap in index.stage_capacities:
+        data = jnp.zeros((stage_cap + 1, index.max_list, index.dim),
+                         index.lists_data.dtype)
+        norms = jnp.zeros((stage_cap + 1, index.max_list),
+                          index.lists_norms.dtype)
+        ids = jnp.full((stage_cap + 1, index.max_list), -1,
+                       index.lists_indices.dtype)
+        _probe_scan(q, data, norms, ids, pos, scale, k=k, sqrt=sqrt,
+                    kind=kind)
+    dk = jnp.zeros((nq, k), jnp.float32)
+    ik = jnp.zeros((nq, k), jnp.int32)
+    out = _merge_topk(dk, ik, dk, ik, k)
+    jax.block_until_ready(out)
+
+
+def build_plan(index: TieredIndex, queries, k: int,
+               params: Optional[SearchParams] = None,
+               warm: bool = True) -> TieredPlan:
+    """Build (or fetch from ``index.plan_cache``) the prepared tiered
+    plan for this batch shape — same cache counters and LRU bound as
+    ``plan.build_plan`` (``raft.plan.cache.*`` / ``raft.plan.build
+    .total``), so the zero-steady-state-compile assertions read one
+    taxonomy across families."""
+    from raft_tpu.neighbors import plan as plan_mod
+    if params is None:
+        params = SearchParams()
+    q = np.asarray(queries, np.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim,
+            "tiered.build_plan: queries must be (nq, dim=%d), got %s",
+            index.dim, q.shape)
+    nq = int(q.shape[0])
+    n_probes = min(int(params.n_probes), index.n_lists)
+    key = ("tiered_ivf_flat", nq, index.dim, k, n_probes,
+           _metric_kind(index.metric))
+    with spans.span("raft.plan.build", family="tiered_ivf_flat",
+                    nq=nq, k=k, n_probes=n_probes) as bsp, \
+            obs.timed("raft.plan.build", family="tiered_ivf_flat"):
+        cached = index.plan_cache.pop(key, None)
+        if cached is not None:
+            index.plan_cache[key] = cached      # LRU touch
+            obs.counter("raft.plan.cache.hits").inc()
+            bsp.set_attr("plan_cache", "hit")
+            return cached
+        obs.counter("raft.plan.cache.misses").inc()
+        obs.counter("raft.plan.build.total").inc()
+        bsp.set_attr("plan_cache", "miss")
+        t_c0 = time.perf_counter()
+        if warm:
+            _prewarm(index, nq, k, n_probes)
+        profiler.note_compile("tiered", time.perf_counter() - t_c0)
+        plan = TieredPlan(index, nq, k, n_probes, key)
+        index.plan_cache[key] = plan
+        cache_max = plan_mod._plan_cache_max()
+        if cache_max > 0:
+            while len(index.plan_cache) > cache_max:
+                index.plan_cache.pop(next(iter(index.plan_cache)))
+                obs.counter("raft.plan.cache.evictions").inc()
+        return plan
+
+
+def build_ladder(index: TieredIndex, rep_queries, k: int,
+                 params: Optional[SearchParams] = None,
+                 shapes: Tuple[int, ...] = (1, 8, 32, 128),
+                 probes_ladder: Tuple[int, ...] = (),
+                 prewarm: bool = True):
+    """The (shape × rung) tiered plan grid, in ``PlanLadder`` form —
+    what ``PlanLadder.build`` (and therefore
+    ``SearchServer.from_index``) delegates to for a
+    :class:`TieredIndex`. Degrade interplay: a lower rung probes
+    fewer lists, which also shrinks the cold fetch working set — load
+    shedding and transfer pressure back off together."""
+    import dataclasses as _dc
+
+    from raft_tpu.serve.ladder import PlanLadder
+
+    if params is None:
+        params = SearchParams()
+    q = np.asarray(rep_queries, np.float32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim,
+            "tiered.build_ladder: rep_queries must be (nq, dim=%d), "
+            "got %s", index.dim, q.shape)
+    rungs = tuple(probes_ladder) or (min(params.n_probes,
+                                         index.n_lists),)
+    plans: Dict[Tuple[int, int], TieredPlan] = {}
+    for ri, n_probes in enumerate(rungs):
+        p_r = _dc.replace(params, n_probes=n_probes)
+        for s in shapes:
+            reps = -(-s // q.shape[0])
+            q_s = np.tile(q, (reps, 1))[:s]
+            plans[(s, ri)] = build_plan(index, q_s, k, p_r,
+                                        warm=prewarm)
+    return PlanLadder(shapes=tuple(shapes), rungs=rungs, plans=plans,
+                      dim=index.dim, k=k)

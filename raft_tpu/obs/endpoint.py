@@ -206,6 +206,24 @@ def _health_body(snapshot: dict) -> dict:
     hbm_low = _gsum("raft.obs.profile.hbm.low_headroom")
     if hbm_low > 0:
         body["status"] = "degraded"
+    # tiered serving (ISSUE 19): informational placement row — the
+    # budget reacts to the SAME low-headroom signal (a refresh under a
+    # shrunk budget demotes lists instead of OOMing), so this row plus
+    # ``hbm_low_headroom`` above reads as one coherent story
+    tiered_gauges = {k.split("{")[0]: v for k, v in gauges.items()
+                     if k.startswith("raft.tiered.")}
+    if tiered_gauges:
+        body["tiered"] = {
+            "budget_bytes": tiered_gauges.get(
+                "raft.tiered.budget.bytes", 0.0),
+            "hot_lists": tiered_gauges.get("raft.tiered.hot.lists",
+                                           0.0),
+            "hot_bytes": tiered_gauges.get("raft.tiered.hot.bytes",
+                                           0.0),
+            "hit_rate": tiered_gauges.get("raft.tiered.hit_rate", 0.0),
+            "overlap_frac": tiered_gauges.get(
+                "raft.tiered.overlap.frac", 0.0),
+        }
     duty = {k: v for k, v in gauges.items()
             if k.split("{")[0] == "raft.obs.profile.duty_cycle"}
     if duty or hbm_low:

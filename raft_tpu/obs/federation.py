@@ -581,7 +581,10 @@ class MetricsFederator:
                              "raft_obs_profile_hbm_headroom_frac"),
                             ("slo_burn_rate", "raft_slo_burn_rate"),
                             ("replication_lag_records",
-                             "raft_fleet_replication_lag_records")):
+                             "raft_fleet_replication_lag_records"),
+                            ("tiered_hit_rate", "raft_tiered_hit_rate"),
+                            ("tiered_overlap_frac",
+                             "raft_tiered_overlap_frac")):
                         vals = self._extract(inst.families, prom)
                         if vals:
                             row[label] = vals

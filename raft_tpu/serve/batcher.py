@@ -202,7 +202,11 @@ class SearchServer:
             # same resolver PlanLadder.build uses — an unsupported
             # index fails identically either way, so no guard needed
             from raft_tpu.neighbors import plan as plan_mod
-            meta["family"], _ = plan_mod._resolve_builder(index)
+            from raft_tpu.neighbors.tiered import TieredIndex
+            if isinstance(index, TieredIndex):
+                meta["family"] = "tiered_ivf_flat"
+            else:
+                meta["family"], _ = plan_mod._resolve_builder(index)
             ladder = PlanLadder.build(index, rep_queries, k, params,
                                       shapes=config.batch_sizes,
                                       probes_ladder=config.probes_ladder,

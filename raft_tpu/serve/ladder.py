@@ -95,7 +95,15 @@ class PlanLadder:
         docs/performance.md). ``probes_ladder`` empty means a single
         rung at ``params.n_probes``."""
         from raft_tpu.neighbors import plan as plan_mod
+        from raft_tpu.neighbors import tiered as tiered_mod
 
+        if isinstance(index, tiered_mod.TieredIndex):
+            # the tiered family builds its own (shape × rung) grid of
+            # prepared TieredPlans — same ladder contract, pre-warmed
+            # over the hot/stage capacity rungs instead of AOT-lowered
+            return tiered_mod.build_ladder(
+                index, rep_queries, k, params, shapes=shapes,
+                probes_ladder=probes_ladder, prewarm=prewarm)
         family, _ = plan_mod._resolve_builder(index)
         if params is None:
             params = plan_mod._default_params(family)

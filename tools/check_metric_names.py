@@ -210,6 +210,29 @@ REQUIRED_NAMES = (
     "raft.obs.blackbox.bytes.total",
     "raft.obs.blackbox.segments.total",
     "raft.obs.blackbox.torn.total",
+    # tiered serving (ISSUE 19): the hot/cold split and transfer
+    # economics of the HBM-budgeted tier — probe routing, fetch
+    # bytes/seconds and the overlap credit doctor's transfer-bound
+    # verdict reads, plus the placement-policy counters and the
+    # budget/occupancy gauges /healthz reports
+    "raft.tiered.search.total",
+    "raft.tiered.probes.hot",
+    "raft.tiered.probes.cold",
+    "raft.tiered.fetch.bytes",
+    "raft.tiered.fetch.seconds",
+    "raft.tiered.overlap.seconds",
+    "raft.tiered.refresh.total",
+    "raft.tiered.promotions.total",
+    "raft.tiered.demotions.total",
+    "raft.tiered.hit_rate",
+    "raft.tiered.overlap.frac",
+    "raft.tiered.budget.bytes",
+    "raft.tiered.hot.lists",
+    "raft.tiered.hot.bytes",
+    # per-list probe mass (ISSUE 19 satellite): the hotness signal the
+    # tiered placement policy scores from
+    "raft.ivf_scan.probes.batches",
+    "raft.ivf_scan.probes.mass",
 )
 
 # serving-path SPANS the tracing layer contracts to emit (ISSUE 3):
@@ -266,6 +289,9 @@ REQUIRED_SPAN_NAMES = (
     # aggregator's own overhead is itself traced
     "raft.obs.fed.scrape",
     "raft.obs.fed.stitch",
+    # tiered serving (ISSUE 19): the tiered search root — hot/cold
+    # probe split and overlap ride as attrs on every traced request
+    "raft.tiered.search",
 )
 
 

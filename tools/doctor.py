@@ -27,6 +27,10 @@ looks host-bound; naming the storm is the diagnosis)::
                     fell off the replication stream)
     low-HBM         hbm.low_headroom tripped, or min headroom_frac
                     below LOW_HBM_FRAC
+    transfer-bound  exposed (un-overlapped) tiered cold-fetch seconds
+                    dominate device seconds in the final window — the
+                    host→HBM transfer window stopped hiding under the
+                    hot-tier scan (raise the budget, or probe less)
     shed storm      shed+deadline drops > SHED_STORM_FRAC of offered
                     work in the final window
     device-bound    duty cycle >= DEVICE_BOUND_DUTY (the accelerator is
@@ -81,6 +85,8 @@ LOW_HBM_FRAC = 0.10            # min headroom_frac considered critical
 SHED_STORM_FRAC = 0.05         # dropped / offered in the final window
 DEVICE_BOUND_DUTY = 0.60       # duty cycle: device is the bottleneck
 HOST_BOUND_DUTY = 0.35         # duty cycle: device starving
+TRANSFER_BOUND_RATIO = 0.5     # exposed fetch_s / device_s threshold
+TRANSFER_BOUND_MIN_S = 0.05    # exposed fetch floor (absolute)
 
 
 def _fam(series: str) -> str:
@@ -273,6 +279,23 @@ def verdict(deltas: Dict[str, float], gauges: Dict[str, float]
             evidence.append(f"min HBM headroom_frac "
                             f"{min(head):.3f}")
         return "low-HBM", evidence
+    fetch_s = _dsum(deltas, "raft.tiered.fetch.seconds")
+    overlap_s = _dsum(deltas, "raft.tiered.overlap.seconds")
+    device_s = _dsum(deltas, "raft.obs.profile.device.seconds")
+    exposed = max(0.0, fetch_s - overlap_s)
+    if (fetch_s > 0 and exposed >= TRANSFER_BOUND_MIN_S
+            and exposed >= TRANSFER_BOUND_RATIO * device_s):
+        fetch_mb = _dsum(deltas, "raft.tiered.fetch.bytes") / 1e6
+        evidence.append(
+            f"tiered cold fetch {fetch_s:.3f}s ({fetch_mb:.1f} MB) in "
+            f"the final window, {exposed:.3f}s exposed "
+            f"(un-overlapped) vs {device_s:.3f}s device compute")
+        evidence.append(
+            f"overlap fraction "
+            f"{(overlap_s / fetch_s) if fetch_s else 0.0:.2f} — the "
+            f"transfer window is not hiding under the hot-tier scan "
+            f"(raise the HBM budget or drop an n_probes rung)")
+        return "transfer-bound", evidence
     shed = _dsum(deltas, "raft.serve.shed.total")
     deadline = _dsum(deltas, "raft.serve.deadline.total")
     completed = _dsum(deltas, "raft.serve.completed.total")

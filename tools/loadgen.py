@@ -269,7 +269,45 @@ def run_open_loop(server, query_pool: np.ndarray, rate_qps: float,
                 writes, mutate_metrics={
                     k_: v for k_, v in diff.get("counters", {}).items()
                     if k_.startswith("raft.mutate.")})
+        tiered = tiered_report(diff)
+        if tiered is not None:
+            report["tiered"] = tiered
     return report
+
+
+def tiered_report(diff: dict) -> Optional[dict]:
+    """Tiered-serving columns out of a run's counters diff (ISSUE 19):
+    tier hit rate, the fraction of the cold-fetch wall hidden under
+    the hot-tier scan, and the achieved transfer bandwidth. None when
+    no tiered index served the run."""
+    from raft_tpu import obs
+    cnt = diff.get("counters", {})
+
+    def c(name):
+        return sum(v for k_, v in cnt.items()
+                   if k_.split("{")[0] == name)
+
+    hot = c("raft.tiered.probes.hot")
+    cold = c("raft.tiered.probes.cold")
+    if hot + cold <= 0:
+        return None
+    fetch_b = c("raft.tiered.fetch.bytes")
+    fetch_s = c("raft.tiered.fetch.seconds")
+    overlap_s = c("raft.tiered.overlap.seconds")
+    g = obs.snapshot()["gauges"]
+    return {
+        "hit_rate": round(hot / (hot + cold), 4),
+        "overlap_frac": (round(overlap_s / fetch_s, 4)
+                         if fetch_s > 0 else None),
+        "fetch_mb": round(fetch_b / 1e6, 2),
+        "fetch_mb_s": (round(fetch_b / 1e6 / fetch_s, 1)
+                       if fetch_s > 0 else None),
+        "promotions": int(c("raft.tiered.promotions.total")),
+        "demotions": int(c("raft.tiered.demotions.total")),
+        "budget_mb": round(
+            g.get("raft.tiered.budget.bytes", 0.0) / 2 ** 20, 2),
+        "hot_lists": int(g.get("raft.tiered.hot.lists", 0.0)),
+    }
 
 
 def measure_sustainable_qps(server, query_pool: np.ndarray, nq: int = 1,
@@ -290,7 +328,8 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
                        server: str = "single",
                        mutate_frac: float = 0.0,
                        chaos: bool = False,
-                       quality_sample: float = 0.0):
+                       quality_sample: float = 0.0,
+                       tiered_frac: Optional[float] = None):
     from raft_tpu import serve
     from raft_tpu.neighbors import ivf_flat
     from raft_tpu.random import make_blobs
@@ -337,6 +376,19 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
     index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
                                                    kmeans_n_iters=4))
     params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
+    if tiered_frac is not None:
+        # tiered serving demo (ISSUE 19): pin hot_frac of the list
+        # payload in device memory, stage the rest from host RAM
+        # under the hot-tier scan — the report gains a 'tiered'
+        # section (hit rate / overlap fraction / fetch MB/s)
+        from raft_tpu.neighbors import tiered
+        tindex = tiered.from_index(
+            index, tiered.TieredConfig(hot_frac=tiered_frac))
+        srv = serve.SearchServer.from_index(tindex, q[:32], k=k,
+                                            params=params, config=cfg)
+        if quality_sample > 0:
+            srv.enable_quality(x)
+        return srv, q, None
     if mutate_frac > 0:
         # mixed read/write traffic (ISSUE 9): serve a MutableIndex and
         # run a background compactor — writes land in the delta
@@ -360,7 +412,8 @@ def _build_demo_server(n: int, dim: int, n_lists: int, k: int,
 
 def _build_fleet(n: int, dim: int, n_lists: int, k: int,
                  probes_ladder, deadline_ms: float, n_replicas: int,
-                 chaos: bool = False):
+                 chaos: bool = False,
+                 tiered_frac: Optional[float] = None):
     """N single-host replicas over ONE built index behind a
     :class:`raft_tpu.fleet.FleetRouter` (the CPU fleet smoke: real
     deployments put each replica on its own host/mesh — here they
@@ -379,6 +432,14 @@ def _build_fleet(n: int, dim: int, n_lists: int, k: int,
     x, q = np.asarray(x), np.asarray(q)
     index = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=n_lists,
                                                    kmeans_n_iters=4))
+    if tiered_frac is not None:
+        # one shared TieredIndex (like the shared plan cache): every
+        # replica serves the same placement, so the per-replica
+        # federation rows show the same tiered gauges — one-index-
+        # per-replica is the real-deployment shape
+        from raft_tpu.neighbors import tiered
+        index = tiered.from_index(
+            index, tiered.TieredConfig(hot_frac=tiered_frac))
     params = ivf_flat.SearchParams(n_probes=probes_ladder[0])
     cfg = serve.ServeConfig(
         batch_sizes=(1, 8, 32), max_queue=256, max_wait_ms=2.0,
@@ -492,6 +553,16 @@ def main(argv=None) -> int:
                          "CPU-smoke caveat: in-process replicas share "
                          "ONE registry, so the summed/router ratio "
                          "reads ~N — the sum semantics made visible")
+    ap.add_argument("--tiered", type=float, default=None,
+                    metavar="HOT_FRAC",
+                    help="serve a TieredIndex pinning HOT_FRAC of the "
+                         "list payload in device memory (ISSUE 19); "
+                         "cold lists stage from host RAM under the "
+                         "hot-tier scan and the report gains a "
+                         "'tiered' section (hit rate, overlap "
+                         "fraction, fetch MB/s). Composes with "
+                         "--fleet (replicas share one placement) and "
+                         "--federate (per-replica tiered gauge rows)")
     ap.add_argument("--mutate-frac", type=float, default=0.0,
                     help="fraction of arrivals that are WRITES "
                          "(upsert/delete against a MutableIndex with a "
@@ -538,6 +609,13 @@ def main(argv=None) -> int:
                          "kill_replica's dump is read back through "
                          "tools/doctor.py in the report)")
     args = ap.parse_args(argv)
+    if args.tiered is not None and not 0.0 <= args.tiered <= 1.0:
+        ap.error("--tiered HOT_FRAC must be in [0, 1]")
+    if args.tiered is not None and (args.server == "dist"
+                                    or args.mutate_frac):
+        ap.error("--tiered rides the single-device (or --fleet) "
+                 "SearchServer path — --server dist / --mutate-frac "
+                 "compose at the library level, not in this tool")
     if args.mutate_frac and args.server == "dist":
         ap.error("--mutate-frac rides the single-device server "
                  "(DistributedSearchServer.from_mutable is the "
@@ -582,7 +660,8 @@ def main(argv=None) -> int:
         from raft_tpu import obs
         router, q, build_server = _build_fleet(
             args.n, args.dim, args.n_lists, args.k, ladder,
-            args.deadline_ms, args.fleet, chaos=bool(chaos_events))
+            args.deadline_ms, args.fleet, chaos=bool(chaos_events),
+            tiered_frac=args.tiered)
         endpoints, federator, agg = [], None, None
         if args.federate:
             # fleet observability plane (ISSUE 16): one scrape target
@@ -718,7 +797,7 @@ def main(argv=None) -> int:
         args.n, args.dim, args.n_lists, args.k, ladder,
         args.deadline_ms, server=args.server,
         mutate_frac=args.mutate_frac, chaos=bool(chaos_events),
-        quality_sample=quality_sample)
+        quality_sample=quality_sample, tiered_frac=args.tiered)
     comp = None
     if mindex is not None:
         from raft_tpu import mutate

@@ -28,6 +28,9 @@
 #        duty-cycle + HBM row — the serve bench with the continuous
 #        profiler's device_util / hbm_peak_mb keys, real PJRT
 #        allocator stats instead of the CPU live-arrays fallback
+#   tv0  tiered-serving row (ISSUE 19): hot/cold HBM-budgeted serving
+#        QPS at hot_frac 1.0/0.5/0.25 with bit-identical parity, zero
+#        steady-state compiles and the overlap fraction on hardware
 #   h1   headline bench (driver format) so the round has fresh
 #        single-device context for the dist comparison
 #   g0   full gated suite (PERF/RECALL/GAP gates end-to-end on TPU)
@@ -127,6 +130,16 @@ pr0() {  # resource-observability row (ISSUE 14): first on-hardware
   cp -f "$OUT/profile_r6.log" docs/measurements/
 }
 
+tv0() {  # tiered-serving row (ISSUE 19): QPS at hot_frac 1.0/0.5/0.25
+         # vs fully-resident, bit-identical parity, zero steady-state
+         # compiles, overlap fraction — the first on-hardware figures
+         # for the HBM-budgeted hot tier (real device_put transfer
+         # cost instead of the CPU same-memory approximation)
+  BENCH_TIERED_N=500000 python bench_suite.py tiered \
+    2>&1 | tee "$OUT/tiered_r6.log"
+  cp -f "$OUT/tiered_r6.log" docs/measurements/
+}
+
 h1() {  # headline bench rows (driver format, embedded measured_at)
   python bench.py 2>&1 | tee "$OUT/headline_r6.log"
   cp -f "$OUT/headline_r6.log" docs/measurements/
@@ -144,6 +157,7 @@ run ch0 ch0
 run q0 q0
 run fl0 fl0
 run pr0 pr0
+run tv0 tv0
 run h1 h1
 run g0 g0
 echo "[$(stamp)] == r6 campaign complete"
