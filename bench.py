@@ -438,7 +438,22 @@ def child_main():
             rows = []
             bench_suite.bench_fleet(rows, n=min(n_ivf, 100_000))
             for r in rows:
-                if "fleet_qps_x1" in r:
+                if "fleet_proc_qps_x1" in r:
+                    # the multi-process row (ISSUE 20): real daemons
+                    # behind the RPC transport, per-process compile
+                    # counters from each daemon's own registry
+                    out["fleet_proc_qps_x1"] = r["fleet_proc_qps_x1"]
+                    out["fleet_proc_qps_x2"] = r["fleet_proc_qps_x2"]
+                    out["fleet_proc_qps_x4"] = r["fleet_proc_qps_x4"]
+                    out["fleet_proc_scaling_x4"] = \
+                        r["fleet_proc_scaling_x4"]
+                    out["fleet_proc_scaling_ok"] = \
+                        r["fleet_proc_scaling_ok"]
+                    out["fleet_proc_scaling_gated"] = \
+                        r["fleet_proc_scaling_gated"]
+                    out["fleet_proc_steady_state_compiles"] = \
+                        r["fleet_proc_steady_state_compiles"]
+                elif "fleet_qps_x1" in r:
                     out["fleet_qps_x1"] = r["fleet_qps_x1"]
                     out["fleet_qps_x2"] = r["fleet_qps_x2"]
                     out["fleet_qps_x4"] = r["fleet_qps_x4"]

@@ -1664,6 +1664,162 @@ def bench_fleet(results, n=None, nlists=64):
         except Exception:
             pass
 
+    # -- multi-process row (ISSUE 20): real daemons, real processes --
+    _bench_fleet_proc(results, seconds=seconds,
+                      per_proc_clients=per_rep_clients)
+
+
+def _bench_fleet_proc(results, seconds=2.0, per_proc_clients=4):
+    """The multi-process fleet scaling row (ISSUE 20): aggregate
+    closed-loop QPS at 1/2/4 ``tools/fleetd.py`` daemons — separate
+    OS processes behind the HTTP RPC transport, routed by the same
+    FleetRouter through :class:`raft_tpu.fleet.RemoteReplica` fronts.
+    The linear-scaling ratio gate ARMS when the processes own distinct
+    accelerator devices (one chip each — the r6 stage ``fp0`` shape);
+    on shared-device CPU the processes contend for cores and the
+    ratios are reported informationally. Per-process steady-state
+    compiles are asserted from each daemon's OWN ``/metrics``
+    (``raft.plan.cache.*`` diffed across the measurement window — N
+    real registries, no shared-process shortcut).
+
+    Knobs: ``BENCH_FLEET_PROC_N`` (rows per daemon index, default
+    20k), ``BENCH_FLEET_PROC_SECONDS``, ``BENCH_FLEET_PROC_CLIENTS``,
+    ``BENCH_FLEET_PROC_STARTUP_S`` (per-spawn health timeout)."""
+    if any(str(r.get("metric", "")).startswith("fleet_proc_serve_")
+           for r in results):
+        # already measured this run (bench_fleet tail-calls this and
+        # bench_fleet_proc is its own _CASES entry — a full-suite run
+        # hits both; spawning 1+2+4 daemons twice doubles the round's
+        # slowest stage for an identical row)
+        return
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+    from raft_tpu import fleet
+    n = int(os.environ.get("BENCH_FLEET_PROC_N", 20_000))
+    seconds = float(os.environ.get("BENCH_FLEET_PROC_SECONDS",
+                                   seconds))
+    clients_per = int(os.environ.get("BENCH_FLEET_PROC_CLIENTS",
+                                     per_proc_clients))
+    startup_s = float(os.environ.get("BENCH_FLEET_PROC_STARTUP_S",
+                                     300.0))
+    d, k, nlists = 64, 32, 64
+    metric = f"fleet_proc_serve_{n//1000}kx{d}"
+    from raft_tpu.random import make_blobs
+    x, _ = make_blobs(n_samples=n, n_features=d,
+                      centers=max(2, nlists), cluster_std=2.0, seed=0)
+    q_np = np.asarray(x[:256], np.float32)
+    platform = jax.default_backend()
+
+    def scrape_compiles(urls):
+        # each daemon's OWN registry: the prometheus family names for
+        # raft.plan.cache.misses / raft.plan.build.total
+        out = {}
+        for name, url in urls.items():
+            total = 0.0
+            try:
+                with urllib.request.urlopen(url + "/metrics",
+                                            timeout=10.0) as r:
+                    text = r.read().decode("utf-8", "replace")
+            except OSError:
+                out[name] = None
+                continue
+            for line in text.splitlines():
+                if line.startswith("raft_plan_cache_misses_total") \
+                        or line.startswith(
+                            "raft_plan_build_total_total"):
+                    try:
+                        total += float(line.rsplit(" ", 1)[1])
+                    except ValueError:
+                        pass
+            out[name] = total
+        return out
+
+    def closed_loop(router, clients):
+        stop_t = time.perf_counter() + seconds
+        counts, lock = [], threading.Lock()
+
+        def client(tid):
+            i, done = tid, 0
+            while time.perf_counter() < stop_t:
+                router.search(q_np[i % 256:i % 256 + 1], timeout=60.0)
+                done += 1
+                i += clients
+            with lock:
+                counts.append(done)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    try:
+        qps, steady_compiles = {}, {}
+        for n_procs in (1, 2, 4):
+            with tempfile.TemporaryDirectory(
+                    prefix="bench_fleet_proc_") as td, \
+                    fleet.ProcessFleet(
+                        td, n_procs=n_procs, n=n, dim=d, seed=0,
+                        n_lists=nlists, k=k,
+                        n_probes=min(FLAT_PROBES, nlists),
+                        platform=platform,
+                        startup_timeout_s=startup_s) as pf:
+                router = fleet.FleetRouter(pf.replicas())
+                # warm every daemon's whole ladder before measuring
+                closed_loop(router, clients_per * n_procs)
+                before = scrape_compiles(pf.urls())
+                qps[n_procs] = closed_loop(router,
+                                           clients_per * n_procs)
+                after = scrape_compiles(pf.urls())
+                steady_compiles[n_procs] = {
+                    name: (None if before.get(name) is None
+                           or after.get(name) is None
+                           else int(after[name] - before[name]))
+                    for name in after}
+                router.close(drain_timeout_s=10.0)
+        x2 = qps[2] / max(qps[1], 1e-9)
+        x4 = qps[4] / max(qps[1], 1e-9)
+        # distinct-device processes are real capacity — the gate arms;
+        # shared-device CPU processes contend for the same cores
+        scaling_gated = (platform != "cpu"
+                         and jax.device_count() >= 4)
+        scaling_ok = (x2 >= 1.4 and x4 >= 2.0) if scaling_gated \
+            else True
+        compiles_flat = [v for per in steady_compiles.values()
+                         for v in per.values() if v is not None]
+        results.append({
+            "metric": metric,
+            "value": round(qps[4], 1), "unit": "qps_x4",
+            "fleet_proc_qps_x1": round(qps[1], 1),
+            "fleet_proc_qps_x2": round(qps[2], 1),
+            "fleet_proc_qps_x4": round(qps[4], 1),
+            "fleet_proc_scaling_x2": round(x2, 3),
+            "fleet_proc_scaling_x4": round(x4, 3),
+            "fleet_proc_scaling_gated": scaling_gated,
+            "fleet_proc_scaling_ok": scaling_ok,
+            "fleet_proc_shared_device": not scaling_gated,
+            "fleet_proc_steady_state_compiles": int(
+                sum(compiles_flat)),
+            "fleet_proc_compiles_by_process": steady_compiles,
+            "platform": platform})
+    except Exception as e:
+        results.append({"metric": metric, "error": repr(e)[:200]})
+
+
+def bench_fleet_proc(results):
+    """Standalone CLI entry for the multi-process fleet row (r6 stage
+    ``fp0``): ``python bench_suite.py fleet_proc`` measures just the
+    daemon scaling row without re-running the whole in-process fleet
+    bench. Same dedupe as the :func:`bench_fleet` tail-call — the row
+    lands exactly once however the suite is invoked."""
+    _bench_fleet_proc(results)
+
 
 def bench_brute_500k(results):
     # the IVF bench point's brute baseline, default-on so the
@@ -1887,7 +2043,7 @@ _CASES = [bench_select_k, bench_brute_500k,
           bench_ivf_pq4,
           bench_ivf_bq, bench_serve, bench_serve_sharded,
           bench_mutate, bench_chaos, bench_quality, bench_fleet,
-          bench_tiered, bench_sharded_build,
+          bench_fleet_proc, bench_tiered, bench_sharded_build,
           bench_fused_l2_nn, bench_pairwise_distance,
           bench_kmeans,
           bench_ivf_flat_int8, bench_linalg_random, bench_ball_cover,

@@ -20,6 +20,14 @@ rebuilt TPU-native):
 * :func:`~raft_tpu.fleet.rolling.rolling_restart` — the zero-downtime
   upgrade path: drain one, restart it from snapshot + WAL tail,
   rejoin, next.
+* **multi-process** (ISSUE 20) — :class:`~raft_tpu.fleet.proc.
+  ProcessFleet` spawns replicas as real OS processes
+  (``tools/fleetd.py`` daemons) behind the stdlib-HTTP RPC transport
+  (:mod:`~raft_tpu.fleet.transport`); a :class:`~raft_tpu.fleet.
+  remote.RemoteReplica` fronts each one with the exact local
+  ``Replica`` surface, and WAL records stream over the wire verbatim
+  (the log IS the wire format) for follower bootstrap + live
+  replication + in-place promotion.
 
 Quick use::
 
@@ -38,21 +46,36 @@ architecture, the bootstrap/replication walkthrough and the
 rolling-restart runbook; load-test with ``tools/loadgen.py --fleet``).
 """
 
+from raft_tpu.fleet.proc import FleetProcess, ProcessFleet, device_env
+from raft_tpu.fleet.remote import (RemoteReplica, RemoteSearchClient,
+                                   bootstrap_from_url)
 from raft_tpu.fleet.replica import Replica, ReplicaState
 from raft_tpu.fleet.replication import (Replicator, WalApplier,
                                         bootstrap_replica)
 from raft_tpu.fleet.rolling import rolling_restart
 from raft_tpu.fleet.router import (FleetConfig, FleetRouter,
                                    FleetUnavailableError)
+from raft_tpu.fleet.transport import (RemoteWalReader, ReplicaTransport,
+                                      TransportClient, serve_replica)
 
 __all__ = [
     "FleetConfig",
+    "FleetProcess",
     "FleetRouter",
     "FleetUnavailableError",
+    "ProcessFleet",
+    "RemoteReplica",
+    "RemoteSearchClient",
+    "RemoteWalReader",
     "Replica",
     "ReplicaState",
+    "ReplicaTransport",
     "Replicator",
+    "TransportClient",
     "WalApplier",
+    "bootstrap_from_url",
     "bootstrap_replica",
+    "device_env",
     "rolling_restart",
+    "serve_replica",
 ]

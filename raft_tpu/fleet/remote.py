@@ -1,0 +1,288 @@
+"""RemoteReplica: a fleet member living in another OS process.
+
+:class:`~raft_tpu.fleet.replica.Replica` already decouples the fleet's
+control plane (lifecycle states, drain-before-stop, the p2c load
+scalar) from what a "server" is — it duck-types four methods:
+``submit``, ``search``, ``load``, ``drain``, ``close``. This module
+supplies that surface over the wire
+(:class:`~raft_tpu.fleet.transport.TransportClient`) so the
+:class:`~raft_tpu.fleet.router.FleetRouter`, ``rolling_restart``, the
+metrics federator and the doctor front a *process* with zero changes
+to their logic:
+
+* :class:`RemoteSearchClient` — the SearchServer twin. ``submit``
+  returns a real ``Future`` (a small dispatch pool runs the RPC);
+  typed errors come back off the wire as the same
+  ``RejectedError``/``DeadlineExceeded``/``DispatchError`` classes, so
+  the router's suspect/retry machinery cannot tell local from remote.
+  ``load()`` snapshots are **piggybacked** on every RPC response and
+  staleness-decayed between them — steady traffic keeps the p2c signal
+  fresh for free; an idle client refreshes over ``GET /rpc/load`` only
+  when the snapshot goes stale.
+* :class:`RemoteReplica` — a :class:`Replica` subclass wrapping one;
+  the whole lifecycle (gauges, transitions, blackbox pointers,
+  ``describe()``) is inherited.
+* :func:`bootstrap_from_url` — the remote twin of
+  :func:`~raft_tpu.fleet.replication.bootstrap_replica`: fetch the
+  primary's compaction snapshot over ``GET /rpc/checkpoint`` (no
+  primary pause), replay the log over ``GET /rpc/wal/tail``, hand the
+  returned reader/applier to a stock
+  :class:`~raft_tpu.fleet.replication.Replicator` to stay fresh.
+  Bit-parity with the local path is pinned in tests — the log IS the
+  wire format, so there is nothing new to get wrong.
+
+Staleness decay: a load snapshot that is ``age`` seconds old has its
+queue-depth components decayed by ``0.5 ** (age / halflife)`` — an old
+"busy" reading should lose p2c duels less and less aggressively as it
+ages (the queue it described has almost certainly drained), while the
+sticky bits (``closed``/``draining``) never decay.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import get_logger
+from raft_tpu.fleet.replica import Replica, ReplicaState
+from raft_tpu.fleet.replication import WalApplier
+from raft_tpu.fleet.transport import RemoteWalReader, TransportClient
+from raft_tpu.obs import spans
+
+__all__ = ["RemoteSearchClient", "RemoteReplica", "bootstrap_from_url"]
+
+
+class RemoteSearchClient:
+    """``SearchServer`` duck-type over one replica daemon's RPC port.
+
+    Thread model: submit/search run on router dispatch threads and the
+    small internal pool; the cached load snapshot is the only shared
+    mutable state (GL003 contract below). The wrapped
+    :class:`TransportClient` is stateless and shared freely.
+    """
+
+    # static race contract (tools/graftlint GL003): dispatch-pool
+    # threads and router load probes meet on the snapshot cache
+    GUARDED_BY = ("_snap", "_snap_ts", "_closed", "_draining")
+
+    def __init__(self, url: str, name: str = "remote",
+                 timeout_s: float = 30.0, refresh_s: float = 3.0,
+                 load_halflife_s: float = 5.0, pool_workers: int = 4,
+                 stop_remote_on_close: bool = False,
+                 client: Optional[TransportClient] = None):
+        self.name = str(name)
+        self.client = client if client is not None \
+            else TransportClient(url, timeout_s=timeout_s)
+        self.url = self.client.url
+        self._refresh_s = float(refresh_s)
+        self._halflife_s = max(1e-3, float(load_halflife_s))
+        self._stop_remote_on_close = bool(stop_remote_on_close)
+        self._lock = threading.Lock()
+        self._snap: Optional[dict] = None
+        self._snap_ts = 0.0          # monotonic stamp of _snap
+        self._closed = False
+        self._draining = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, int(pool_workers)),
+            thread_name_prefix=f"raft-fleet-rpc-{self.name}")
+
+    # -- the piggyback ------------------------------------------------------
+    def _note_load(self, body: dict) -> None:
+        """Harvest the load snapshot every RPC response carries."""
+        snap = body.get("load") if isinstance(body, dict) else None
+        if isinstance(snap, dict) and "queued_rows" in snap:
+            with self._lock:
+                self._snap = snap
+                self._snap_ts = time.monotonic()
+
+    # -- SearchServer surface ----------------------------------------------
+    def submit(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Async search → ``Future`` resolving to ``(distances, ids)``
+        or raising the wire's typed error — shape-identical to
+        ``SearchServer.submit`` from the router's seat. The caller's
+        traceparent is captured HERE (on the submitting thread, inside
+        the router's route span) so the remote daemon's spans parent
+        into the caller's trace."""
+        trace_ctx = obs.current_traceparent()
+        with self._lock:
+            if self._closed:
+                from raft_tpu.serve.types import DispatchError
+                raise DispatchError(
+                    f"remote {self.name}: client closed")
+            pool = self._pool
+        return pool.submit(self.search, queries, k=k,
+                           deadline_ms=deadline_ms,
+                           trace_context=trace_ctx)
+
+    def search(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               trace_context: Optional[str] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """One blocking search RPC. Non-200 raises the SAME typed
+        error class a local ``SearchServer`` would have raised."""
+        if trace_context is None:
+            trace_context = obs.current_traceparent()
+        status, body = self.client.search_raw(
+            queries, k=k, deadline_ms=deadline_ms,
+            trace_context=trace_context)
+        self._note_load(body)
+        if status != 200:
+            raise self.client._typed(status, body, "search")
+        return (np.asarray(body["distances"], np.float32),
+                np.asarray(body["ids"], np.int32))
+
+    def load(self) -> dict:
+        """The batcher-shaped load snapshot, from the piggyback cache
+        when fresh, decayed as it ages, refreshed over the wire when
+        stale. Raises on an unreachable idle replica — exactly the
+        probe failure ``Replica.load()`` converts to +inf."""
+        with self._lock:
+            if self._closed:
+                return {"queued_rows": 0, "inflight_rows": 0,
+                        "shed_rate": 0.0, "closed": True,
+                        "draining": False}
+            snap, ts = self._snap, self._snap_ts
+            draining = self._draining
+        age = (time.monotonic() - ts) if snap is not None else None
+        if snap is None or age > self._refresh_s:
+            snap = self.client.load(timeout=5.0)   # raises when dead
+            self._note_load({"load": snap})
+            age = 0.0
+        decay = 0.5 ** (age / self._halflife_s)
+        out = dict(snap)
+        out["queued_rows"] = float(snap.get("queued_rows", 0)) * decay
+        out["inflight_rows"] = \
+            float(snap.get("inflight_rows", 0)) * decay
+        out["shed_rate"] = float(snap.get("shed_rate", 0.0)) * decay
+        out["remote"] = True
+        out["load_age_s"] = round(age, 3)
+        if draining:
+            out["draining"] = True
+        return out
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Drain the REMOTE batcher via RPC. False when the daemon is
+        unreachable (a dead process holds no queue to flush — the
+        caller's stop() continues to close)."""
+        with self._lock:
+            self._draining = True
+        try:
+            return self.client.drain(timeout_s=timeout_s)
+        except Exception:
+            get_logger("fleet").warning(
+                "remote %s: drain rpc failed — treating as drained "
+                "(process gone takes its queue with it)", self.name)
+            return False
+
+    def close(self) -> None:
+        """Release the dispatch pool; optionally (the ProcessFleet
+        hand-off sets ``stop_remote_on_close``) ask the daemon itself
+        to exit. Idempotent, never raises — close runs on the
+        kill()/stop() death paths."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._stop_remote_on_close:
+            try:
+                self.client.stop(timeout=5.0)
+            except Exception:   # graftlint: disable=GL006
+                # the process may already be gone — that IS the goal
+                # state of close (justified swallow)
+                pass
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self) -> "RemoteSearchClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RemoteReplica(Replica):
+    """A :class:`Replica` whose server lives in another process. The
+    entire lifecycle/routing surface is inherited — this class only
+    supplies construction sugar and the URL in ``describe()``."""
+
+    def __init__(self, name: str, url: str,
+                 state: Optional[ReplicaState] = None,
+                 server: Optional[RemoteSearchClient] = None, **kw):
+        expects(bool(url), "RemoteReplica: url must be non-empty")
+        srv = server if server is not None \
+            else RemoteSearchClient(url, name=name, **kw)
+        self.url = srv.url
+        super().__init__(name, server=srv, state=state)
+
+    @property
+    def rpc(self) -> TransportClient:
+        """The raw transport client (control verbs: promote,
+        retarget, upsert, delete) of the CURRENT server."""
+        srv = self.server
+        expects(srv is not None,
+                "RemoteReplica %s: no server attached", self.name)
+        return srv.client
+
+    def describe(self) -> dict:
+        body = super().describe()
+        body["url"] = self.url
+        return body
+
+
+def bootstrap_from_url(url: str, k: int, cache_dir: str,
+                       base_index=None, params=None, config=None,
+                       name: str = "follower",
+                       client: Optional[TransportClient] = None
+                       ) -> Tuple[object, RemoteWalReader, WalApplier]:
+    """Bootstrap a follower ``MutableIndex`` from a REMOTE primary:
+    ``GET /rpc/checkpoint`` → cached snapshot file → ``serialize.load``
+    (falling back to ``base_index`` when the primary has never
+    compacted), then replay ``GET /rpc/wal/tail`` to the tip. Returns
+    ``(mindex, reader, applier)`` exactly like the local
+    :func:`~raft_tpu.fleet.replication.bootstrap_replica` — hand the
+    reader+applier to a stock ``Replicator`` to stay fresh. Same
+    ``raft.fleet.bootstrap.*`` accounting; ``source`` attr says
+    ``checkpoint``/``base_index`` like the local path."""
+    import os
+
+    from raft_tpu.mutate import MutableIndex
+    from raft_tpu.neighbors import serialize
+    cli = client if client is not None else TransportClient(url)
+    os.makedirs(cache_dir, exist_ok=True)
+    ckpt_cache = os.path.join(cache_dir, f"{name}.ckpt.npz")
+    with obs.timed("raft.fleet.bootstrap"), \
+            spans.span("raft.fleet.bootstrap", replica=name,
+                       url=cli.url) as sp:
+        if cli.fetch_checkpoint(ckpt_cache):
+            inner = serialize.load(ckpt_cache)
+            sp.set_attr("source", "checkpoint")
+        else:
+            inner = base_index
+            sp.set_attr("source", "base_index")
+        expects(inner is not None,
+                "fleet.bootstrap_from_url: primary %r has no "
+                "checkpoint and no base_index was given — a replica "
+                "needs the index the WAL was started against", cli.url)
+        m = MutableIndex(inner, k=int(k), params=params, config=config)
+        reader = RemoteWalReader(cli)
+        applier = WalApplier(m)
+        # drain the remote tail in batches until the tip (an empty
+        # batch): the primary may be appending concurrently — the
+        # Replicator owns freshness after this returns
+        while True:
+            recs = reader.tail()
+            if not recs:
+                break
+            for rec in recs:
+                applier.apply(rec)
+        sp.set_attr("replayed", applier.applied_records)
+        sp.set_attr("seq", applier.applied_seq)
+    obs.counter("raft.fleet.bootstrap.total").inc()
+    obs.gauge("raft.fleet.replication.lag_records", replica=name).set(0)
+    return m, reader, applier

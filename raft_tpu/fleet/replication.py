@@ -246,6 +246,12 @@ class Replicator:
         one append the moment it returns.)"""
         floor = max(self._applier.applied_seq,
                     self._applier._skip_upto)
+        # a remote reader (fleet.transport.RemoteWalReader) probes the
+        # tip over its own wire — duck-typed so this tier stays
+        # transport-agnostic
+        probe_fn = getattr(self._reader, "probe_caught_up", None)
+        if probe_fn is not None:
+            return bool(probe_fn(floor))
         try:
             probe = WalReader(self.wal_path, from_seq=floor)
             return not probe.tail(max_records=1)

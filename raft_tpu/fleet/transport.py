@@ -1,0 +1,661 @@
+"""Fleet wire transport: one replica process behind stdlib HTTP/JSON.
+
+ISSUE 20 makes a replica a separate OS process. This module is the
+wire between the router's process and the replica's, built on the same
+stdlib-only ``http.server`` stack as :mod:`raft_tpu.obs.endpoint` (the
+server here IS a :class:`~raft_tpu.obs.endpoint.DebugServer` subclass,
+so every daemon also exposes ``/metrics``, ``/healthz`` and the
+``/debug/*`` planes on the same port — the federator and the doctor
+scrape it with zero changes). Three design rules:
+
+* **typed errors survive the wire** — ``POST /rpc/search`` maps
+  admission/deadline/dispatch failures to explicit status codes (429 /
+  504 / 503) and :class:`TransportClient` maps them BACK to the same
+  :class:`~raft_tpu.serve.RejectedError` /
+  :class:`~raft_tpu.serve.DeadlineExceeded` /
+  :class:`~raft_tpu.serve.DispatchError` classes, so the
+  :class:`~raft_tpu.fleet.router.FleetRouter`'s suspect/retry/shed
+  semantics are byte-identical for a remote replica. The deadline
+  budget travels IN the request body — the remote batcher enforces it,
+  not a second client-side timer.
+* **the log is the wire format** — ``GET /rpc/wal/tail?from_seq=``
+  streams the mutation WAL's records verbatim in their on-disk framing
+  (:func:`raft_tpu.mutate.wal.read_raw`: magic + length|crc|payload,
+  CRCs travel as written). A follower that fell behind a checkpoint
+  rewrite gets HTTP 410 carrying the typed
+  :class:`~raft_tpu.mutate.wal.WalGapError` fields — re-bootstrap is
+  the only correct continuation, exactly like the local reader.
+* **bootstrap without a primary pause** — ``GET /rpc/checkpoint``
+  serves the compactor's snapshot file bytes; a new follower fetches
+  checkpoint + tails the log and never makes the primary do anything.
+
+Every JSON response piggybacks the replica's ``load()`` snapshot (the
+``load`` key) so the client's p2c load signal refreshes for free on
+the data path (:class:`raft_tpu.fleet.remote.RemoteSearchClient`
+staleness-decays it between responses).
+
+Wire protocol (docs/fleet.md has the full table)::
+
+    POST /rpc/search      {queries, k?, deadline_ms?} -> {distances,
+                          ids, load, trace_id}   429/504/503 typed
+    GET  /rpc/wal/tail    ?from_seq=N&max_records=M -> WAL bytes
+                          (application/octet-stream)  410 = gap
+    GET  /rpc/checkpoint  -> snapshot bytes             404 = none yet
+    GET  /rpc/state       -> {name, role, state, wal_next_seq, ...}
+    GET  /rpc/load        -> {load}
+    POST /rpc/drain       {timeout_s?} -> {drained}
+    POST /rpc/stop        -> {stopping}        (graceful process exit)
+    POST /rpc/promote     -> {primary, next_seq, epoch}
+    POST /rpc/retarget    {primary_url} -> {retargeted}
+    POST /rpc/upsert      {rows, ids?} -> {ids}
+    POST /rpc/delete      {ids} -> {deleted}
+
+The control verbs (state/drain/stop/promote/retarget/upsert/delete)
+dispatch to a duck-typed ``control`` object the daemon installs
+(:mod:`tools.fleetd`); without one, only the data-plane routes answer.
+Binds loopback by default — front it with real infrastructure before
+exposing it beyond the host.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.mutate.wal import (WalGapError, WalRecord, decode_stream,
+                                 read_raw)
+from raft_tpu.obs.endpoint import DebugServer, _Handler
+
+__all__ = ["ReplicaTransport", "TransportClient", "RemoteWalReader",
+           "serve_replica"]
+
+
+def _typed_search_errors():
+    # lazy import: raft_tpu.serve imports raft_tpu.obs — module scope
+    # here would be fine (fleet already imports serve.types), but the
+    # handler runs on server threads where the lazy idiom keeps parity
+    # with obs.endpoint
+    from raft_tpu.serve.types import (DeadlineExceeded, DispatchError,
+                                      RejectedError)
+    return RejectedError, DeadlineExceeded, DispatchError
+
+
+class _RpcHandler(_Handler):
+    """The obs debug handler + the ``/rpc/*`` fleet data plane."""
+
+    server: "ReplicaTransport"
+
+    # -- shared helpers ----------------------------------------------------
+    def _load_snapshot(self) -> Optional[dict]:
+        srv = getattr(self.server, "searcher", None)
+        if srv is None:
+            return None
+        try:
+            return srv.load()
+        except Exception:   # graftlint: disable=GL006
+            # the piggyback is opportunistic — a server mid-teardown
+            # must not turn an otherwise-valid response into a 500
+            # (justified swallow: the caller treats a missing load key
+            # as "no refresh this response")
+            return None
+
+    def _rpc_json(self, code: int, obj: dict) -> None:
+        """JSON response with the load piggyback: EVERY rpc answer —
+        success or typed error — refreshes the caller's p2c signal."""
+        snap = self._load_snapshot()
+        if snap is not None and "load" not in obj:
+            obj = dict(obj, load=snap)
+        self._send_json(code, obj)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b""
+        return json.loads(raw or b"{}")
+
+    # -- routing -----------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        url = urlparse(self.path)
+        path = url.path.rstrip("/") or "/"
+        if not path.startswith("/rpc/"):
+            super().do_GET()
+            return
+        q = parse_qs(url.query)
+        obs.counter("raft.fleet.rpc.requests.total",
+                    route=path).inc()
+        try:
+            if path == "/rpc/state":
+                self._rpc_state()
+            elif path == "/rpc/load":
+                self._rpc_json(200, {})
+            elif path == "/rpc/wal/tail":
+                self._rpc_wal_tail(q)
+            elif path == "/rpc/checkpoint":
+                self._rpc_checkpoint()
+            else:
+                self._send_json(404, {"error": f"no route {path!r}"})
+        except BrokenPipeError:
+            pass
+
+    def do_POST(self) -> None:  # noqa: N802 (BaseHTTPRequestHandler API)
+        path = urlparse(self.path).path.rstrip("/") or "/"
+        if not path.startswith("/rpc/"):
+            super().do_POST()
+            return
+        obs.counter("raft.fleet.rpc.requests.total",
+                    route=path).inc()
+        try:
+            if path == "/rpc/search":
+                self._rpc_search()
+            elif path == "/rpc/drain":
+                self._rpc_control("drain")
+            elif path == "/rpc/stop":
+                self._rpc_control("stop")
+            elif path == "/rpc/promote":
+                self._rpc_control("promote")
+            elif path == "/rpc/retarget":
+                self._rpc_control("retarget")
+            elif path == "/rpc/upsert":
+                self._rpc_control("upsert")
+            elif path == "/rpc/delete":
+                self._rpc_control("delete")
+            else:
+                self._send_json(404, {"error": f"no POST route "
+                                               f"{path!r}"})
+        except BrokenPipeError:
+            pass
+
+    # -- data plane --------------------------------------------------------
+    def _rpc_search(self) -> None:
+        """``POST /rpc/search`` — the remote twin of
+        ``SearchServer.search``: the deadline budget rides the body,
+        typed errors ride the status code, the load snapshot rides
+        every response."""
+        RejectedError, DeadlineExceeded, _ = _typed_search_errors()
+        srv = getattr(self.server, "searcher", None)
+        if srv is None:
+            self._err("/rpc/search", "no_searcher")
+            self._send_json(404, {"error": "dispatch",
+                                  "detail": "no searcher attached"})
+            return
+        try:
+            body = self._read_body()
+            queries = np.asarray(body["queries"], np.float32)
+            k = body.get("k")
+            deadline_ms = body.get("deadline_ms")
+        except (ValueError, KeyError, TypeError) as e:
+            self._err("/rpc/search", "bad_request")
+            self._send_json(400, {"error": "bad_request",
+                                  "detail": repr(e)})
+            return
+        from raft_tpu.obs import spans as _spans
+        incoming = self.headers.get("traceparent")
+        trace_id = None
+        try:
+            # cross-process propagation in: the router's route span's
+            # traceparent parents this daemon's whole request subtree
+            with _spans.span("raft.fleet.rpc", remote_parent=incoming,
+                             route="/rpc/search") as sp:
+                trace_id = sp.trace_id or None
+                d, i = srv.search(queries, k=k,
+                                  deadline_ms=deadline_ms)
+        except RejectedError as e:
+            self._err("/rpc/search", "rejected")
+            self._rpc_json(429, {"error": "rejected",
+                                 "detail": str(e),
+                                 "trace_id": trace_id})
+            return
+        except DeadlineExceeded as e:
+            self._err("/rpc/search", "deadline")
+            self._rpc_json(504, {"error": "deadline", "detail": str(e),
+                                 "trace_id": trace_id})
+            return
+        except Exception as e:
+            # anything else is a dispatch-class failure: the caller's
+            # router marks this replica suspect and retries elsewhere
+            self._err("/rpc/search", type(e).__name__)
+            self._rpc_json(503, {"error": "dispatch",
+                                 "detail": f"{type(e).__name__}: "
+                                           f"{str(e)[:500]}",
+                                 "trace_id": trace_id})
+            return
+        self._rpc_json(200, {
+            "distances": np.asarray(d).tolist(),
+            "ids": np.asarray(i).tolist(),
+            "partial": bool(getattr(d, "partial", False)
+                            or getattr(i, "partial", False)),
+            "trace_id": trace_id})
+
+    def _rpc_wal_tail(self, q: dict) -> None:
+        """``GET /rpc/wal/tail?from_seq=N`` — the raw log slice, in
+        its own on-disk framing. 410 carries the typed gap."""
+        wal_path = getattr(self.server, "wal_path", None)
+        if not wal_path:
+            self._err("/rpc/wal/tail", "no_wal")
+            self._send_json(404, {"error": "no_wal",
+                                  "detail": "this replica serves no "
+                                            "mutation log"})
+            return
+        try:
+            from_seq = int(q.get("from_seq", ["0"])[0])
+            max_records = int(q.get("max_records", ["0"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "bad_request",
+                                  "detail": "from_seq/max_records must "
+                                            "be integers"})
+            return
+        try:
+            buf, n, last = read_raw(wal_path, from_seq=from_seq,
+                                    max_records=max_records)
+        except WalGapError as e:
+            self._err("/rpc/wal/tail", "gap")
+            self._send_json(410, {"error": "gap",
+                                  "last_seq": e.last_seq,
+                                  "first_seq": e.first_seq})
+            return
+        except OSError as e:
+            self._err("/rpc/wal/tail", "io")
+            self._send_json(503, {"error": "dispatch",
+                                  "detail": repr(e)})
+            return
+        obs.counter("raft.fleet.rpc.wal.records.total").inc(n)
+        obs.counter("raft.fleet.rpc.wal.bytes.total").inc(len(buf))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(buf)))
+        self.send_header("X-Raft-Wal-Records", str(n))
+        self.send_header("X-Raft-Wal-Last-Seq", str(last))
+        self.end_headers()
+        self.wfile.write(buf)
+
+    def _rpc_checkpoint(self) -> None:
+        """``GET /rpc/checkpoint`` — the compactor snapshot's bytes:
+        follower bootstrap without the primary pausing anything."""
+        import os
+        ckpt = getattr(self.server, "checkpoint_path", None)
+        if not ckpt or not os.path.exists(ckpt):
+            self._err("/rpc/checkpoint", "no_checkpoint")
+            self._send_json(404, {"error": "no_checkpoint",
+                                  "detail": "no compaction checkpoint "
+                                            "on disk yet"})
+            return
+        try:
+            with open(ckpt, "rb") as f:
+                body = f.read()
+        except OSError as e:
+            self._err("/rpc/checkpoint", "io")
+            self._send_json(503, {"error": "dispatch",
+                                  "detail": repr(e)})
+            return
+        obs.counter("raft.fleet.rpc.checkpoint.bytes.total"
+                    ).inc(len(body))
+        self._send(200, body, "application/octet-stream")
+
+    # -- control plane -----------------------------------------------------
+    def _rpc_state(self) -> None:
+        ctl = getattr(self.server, "control", None)
+        if ctl is not None:
+            try:
+                self._rpc_json(200, dict(ctl.state()))
+                return
+            except Exception as e:
+                self._err("/rpc/state", type(e).__name__)
+                self._send_json(503, {"error": "dispatch",
+                                      "detail": repr(e)})
+                return
+        srv = getattr(self.server, "searcher", None)
+        self._rpc_json(200, {
+            "state": "serving" if srv is not None else "down"})
+
+    def _rpc_control(self, verb: str) -> None:
+        """Dispatch a control verb to the daemon's duck-typed control
+        object; 404 without one (a transport can be data-plane only),
+        409 when the daemon refuses the transition (e.g. promoting a
+        primary)."""
+        ctl = getattr(self.server, "control", None)
+        fn = getattr(ctl, verb, None)
+        if fn is None:
+            self._err(f"/rpc/{verb}", "no_control")
+            self._send_json(404, {"error": "no_control",
+                                  "detail": f"this replica exposes no "
+                                            f"{verb!r} control"})
+            return
+        try:
+            body = self._read_body()
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": "bad_request",
+                                  "detail": repr(e)})
+            return
+        try:
+            out = fn(**body) if body else fn()
+        except (ValueError, TypeError) as e:
+            self._err(f"/rpc/{verb}", "refused")
+            self._send_json(409, {"error": "refused",
+                                  "detail": str(e)[:500]})
+            return
+        except Exception as e:
+            self._err(f"/rpc/{verb}", type(e).__name__)
+            self._send_json(503, {"error": "dispatch",
+                                  "detail": f"{type(e).__name__}: "
+                                            f"{str(e)[:500]}"})
+            return
+        self._rpc_json(200, dict(out or {}))
+
+    def _err(self, route: str, kind: str) -> None:
+        obs.counter("raft.fleet.rpc.errors.total", route=route,
+                    error=kind).inc()
+
+
+class ReplicaTransport(DebugServer):
+    """One replica daemon's HTTP server: the whole obs debug plane
+    (``/metrics``, ``/healthz``, ``/debug/*`` — inherited) plus the
+    fleet ``/rpc/*`` data/control plane. Build via
+    :func:`serve_replica`."""
+
+    def __init__(self, addr, searcher=None, wal_path: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None, control=None,
+                 **kw):
+        super().__init__(addr, searcher=searcher, **kw)
+        # swap in the rpc-aware handler (the parent pins _Handler)
+        self.RequestHandlerClass = _RpcHandler
+        # immutable after construction: the handler threads only read
+        self.wal_path = wal_path
+        self.checkpoint_path = checkpoint_path
+        self.control = control
+
+
+def serve_replica(host: str = "127.0.0.1", port: int = 0, searcher=None,
+                  wal_path: Optional[str] = None,
+                  checkpoint_path: Optional[str] = None, control=None,
+                  **kw) -> ReplicaTransport:
+    """Start a replica transport in a daemon thread → running
+    :class:`ReplicaTransport` (``.url``, ``.port``, ``.close()``).
+    ``port=0`` binds an ephemeral port (the daemon writes it to its
+    port file for the spawner's handshake)."""
+    return ReplicaTransport((host, port), searcher=searcher,
+                            wal_path=wal_path,
+                            checkpoint_path=checkpoint_path,
+                            control=control, **kw).start()
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+class TransportClient:
+    """Typed HTTP client for one replica daemon. Stateless (no lock:
+    every method builds its own request), so one client may be shared
+    by the dispatch pool, the replicator thread and the operator.
+
+    Error mapping back OFF the wire — the other half of the transport
+    contract: 429 → ``RejectedError``, 504 → ``DeadlineExceeded``,
+    410 → :class:`~raft_tpu.mutate.wal.WalGapError`, anything else
+    (incl. refused connections — a SIGKILLed process) →
+    ``DispatchError`` on the data plane / ``OSError`` on the
+    replication plane (the replicator treats those as transient and
+    keeps polling)."""
+
+    def __init__(self, url: str, timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    # -- low-level ---------------------------------------------------------
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 headers: Optional[dict] = None,
+                 timeout: Optional[float] = None
+                 ) -> Tuple[int, dict, bytes, dict]:
+        """→ (status, json_body_or_{}, raw_bytes, response_headers).
+        Network-level failures raise ``OSError`` (urllib's URLError is
+        one); HTTP error statuses are RETURNED, not raised — the
+        caller owns the typed mapping."""
+        data = None
+        hdrs = dict(headers or {})
+        if body is not None:
+            data = json.dumps(body).encode("utf-8")
+            hdrs["Content-Type"] = "application/json"
+        req = urllib.request.Request(self.url + path, data=data,
+                                     headers=hdrs, method=method)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None
+                    else self.timeout_s) as resp:
+                raw = resp.read()
+                rh = dict(resp.headers.items())
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            rh = dict(e.headers.items()) if e.headers else {}
+            status = e.code
+        ctype = rh.get("Content-Type", "")
+        parsed = {}
+        if "json" in ctype:
+            try:
+                parsed = json.loads(raw or b"{}")
+            except ValueError:
+                parsed = {}
+        return status, parsed, raw, rh
+
+    def _typed(self, status: int, body: dict, route: str):
+        """The wire → typed-error mapping (search/control planes)."""
+        RejectedError, DeadlineExceeded, DispatchError = \
+            _typed_search_errors()
+        detail = body.get("detail", "") or body.get("error", "")
+        if status == 429:
+            return RejectedError(f"rpc {route}: {detail}")
+        if status == 504:
+            return DeadlineExceeded(f"rpc {route}: {detail}")
+        if status == 410:
+            return WalGapError(int(body.get("last_seq", 0)),
+                               int(body.get("first_seq", 0)))
+        return DispatchError(f"rpc {route}: HTTP {status}: {detail}")
+
+    # -- data plane --------------------------------------------------------
+    def search_raw(self, queries, k=None, deadline_ms=None,
+                   trace_context: Optional[str] = None,
+                   timeout: Optional[float] = None
+                   ) -> Tuple[int, dict]:
+        """One search RPC → ``(status, json body)``; network failures
+        raise ``DispatchError`` (a dead process must look exactly like
+        a crashed dispatch to the router)."""
+        _, _, DispatchError = _typed_search_errors()
+        body = {"queries": np.asarray(queries,
+                                      np.float32).tolist()}
+        if k is not None:
+            body["k"] = int(k)
+        if deadline_ms is not None:
+            body["deadline_ms"] = float(deadline_ms)
+        hdrs = {}
+        if trace_context:
+            hdrs["traceparent"] = trace_context
+        try:
+            status, parsed, _raw, _rh = self._request(
+                "POST", "/rpc/search", body=body, headers=hdrs,
+                timeout=timeout)
+        except OSError as e:
+            raise DispatchError(
+                f"rpc search: {self.url} unreachable: {e!r}") from e
+        return status, parsed
+
+    def wal_tail(self, from_seq: int, max_records: int = 0,
+                 timeout: Optional[float] = None
+                 ) -> List[WalRecord]:
+        """Tail the remote log → decoded records. 410 raises the typed
+        :class:`WalGapError`; everything else non-200 (and network
+        failure) raises ``OSError`` — transient to a replicator."""
+        try:
+            status, parsed, raw, _rh = self._request(
+                "GET", f"/rpc/wal/tail?from_seq={int(from_seq)}"
+                       f"&max_records={int(max_records)}",
+                timeout=timeout)
+        except WalGapError:
+            raise
+        except OSError:
+            raise
+        if status == 410:
+            raise WalGapError(int(parsed.get("last_seq", 0)),
+                              int(parsed.get("first_seq", 0)))
+        if status != 200:
+            raise OSError(f"rpc wal/tail: HTTP {status}: "
+                          f"{parsed.get('detail', '')}")
+        return decode_stream(raw)
+
+    def fetch_checkpoint(self, dest_path: str,
+                         timeout: Optional[float] = None) -> bool:
+        """Download the primary's compaction snapshot to
+        ``dest_path`` → True; False when none exists yet (bootstrap
+        falls back to the base index). Network failure raises
+        ``OSError``."""
+        import os
+        status, parsed, raw, _rh = self._request(
+            "GET", "/rpc/checkpoint", timeout=timeout)
+        if status == 404:
+            return False
+        if status != 200:
+            raise OSError(f"rpc checkpoint: HTTP {status}: "
+                          f"{parsed.get('detail', '')}")
+        tmp = dest_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, dest_path)
+        return True
+
+    # -- control plane -----------------------------------------------------
+    def _control(self, verb: str, body: Optional[dict] = None,
+                 timeout: Optional[float] = None) -> dict:
+        _, _, DispatchError = _typed_search_errors()
+        try:
+            status, parsed, _raw, _rh = self._request(
+                "POST", f"/rpc/{verb}", body=body or {},
+                timeout=timeout)
+        except OSError as e:
+            raise DispatchError(
+                f"rpc {verb}: {self.url} unreachable: {e!r}") from e
+        if status != 200:
+            raise self._typed(status, parsed, verb)
+        return parsed
+
+    def state(self, timeout: Optional[float] = None) -> dict:
+        _, _, DispatchError = _typed_search_errors()
+        try:
+            status, parsed, _raw, _rh = self._request(
+                "GET", "/rpc/state", timeout=timeout)
+        except OSError as e:
+            raise DispatchError(
+                f"rpc state: {self.url} unreachable: {e!r}") from e
+        if status != 200:
+            raise self._typed(status, parsed, "state")
+        return parsed
+
+    def load(self, timeout: Optional[float] = None) -> dict:
+        _, _, DispatchError = _typed_search_errors()
+        try:
+            status, parsed, _raw, _rh = self._request(
+                "GET", "/rpc/load", timeout=timeout)
+        except OSError as e:
+            raise DispatchError(
+                f"rpc load: {self.url} unreachable: {e!r}") from e
+        if status != 200 or "load" not in parsed:
+            raise DispatchError(f"rpc load: HTTP {status} "
+                                f"(no load snapshot)")
+        return parsed["load"]
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        out = self._control("drain", {"timeout_s": float(timeout_s)},
+                            timeout=timeout_s + 10.0)
+        return bool(out.get("drained"))
+
+    def stop(self, timeout: Optional[float] = None) -> dict:
+        return self._control("stop", timeout=timeout)
+
+    def promote(self, timeout: Optional[float] = None) -> dict:
+        return self._control("promote", timeout=timeout)
+
+    def retarget(self, primary_url: str,
+                 timeout: Optional[float] = None) -> dict:
+        return self._control("retarget",
+                             {"primary_url": str(primary_url)},
+                             timeout=timeout)
+
+    def upsert(self, rows, ids=None,
+               timeout: Optional[float] = None) -> List[int]:
+        body = {"rows": np.asarray(rows, np.float32).tolist()}
+        if ids is not None:
+            body["ids"] = np.asarray(ids, np.int64).tolist()
+        out = self._control("upsert", body, timeout=timeout)
+        return [int(v) for v in out.get("ids", [])]
+
+    def delete(self, ids, timeout: Optional[float] = None) -> int:
+        out = self._control(
+            "delete", {"ids": np.asarray(ids, np.int64).tolist()},
+            timeout=timeout)
+        return int(out.get("deleted", 0))
+
+
+class RemoteWalReader:
+    """:class:`~raft_tpu.mutate.wal.WalReader` duck-type over
+    ``GET /rpc/wal/tail`` — the follower's end of WAL-over-the-wire
+    replication. Drop-in for :class:`~raft_tpu.fleet.replication.
+    Replicator` (same ``tail(from_seq, max_records)`` / ``position``
+    surface, same typed :class:`WalGapError` park, ``OSError`` for
+    transient network failure — the replicator keeps polling through a
+    primary restart exactly like a rotating local file)."""
+
+    def __init__(self, client: TransportClient, from_seq: int = 0,
+                 batch_records: int = 1024):
+        self.client = client
+        self.last_seq = int(from_seq)
+        self.batch_records = int(batch_records)
+
+    def tail(self, from_seq: Optional[int] = None,
+             max_records: int = 0) -> List[WalRecord]:
+        if from_seq is not None:
+            self.last_seq = int(from_seq)
+        recs = self.client.wal_tail(
+            self.last_seq,
+            max_records=max_records or self.batch_records)
+        if recs:
+            self.last_seq = int(recs[-1].seq)
+        return recs
+
+    def probe_caught_up(self, floor: int) -> bool:
+        """Read-only tip probe (does NOT advance the position) — the
+        replicator's ``caught_up()`` hook for remote logs."""
+        try:
+            return not self.client.wal_tail(int(floor), max_records=1,
+                                            timeout=5.0)
+        except (WalGapError, OSError):
+            return False
+
+    @property
+    def position(self) -> int:
+        return self.last_seq
+
+
+def wait_healthy(client: TransportClient, timeout_s: float = 120.0,
+                 poll_s: float = 0.25,
+                 want_states: Tuple[str, ...] = ("serving",)
+                 ) -> dict:
+    """Poll ``/rpc/state`` until the daemon reports one of
+    ``want_states`` → the state body. Raises ``TimeoutError`` with the
+    last failure after ``timeout_s`` — the spawner's health check."""
+    deadline = time.monotonic() + timeout_s
+    last: object = None
+    while time.monotonic() < deadline:
+        try:
+            st = client.state(timeout=5.0)
+            last = st
+            if st.get("state") in want_states:
+                return st
+        except Exception as e:
+            last = repr(e)
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"replica at {client.url} not healthy after {timeout_s:.0f}s "
+        f"(last: {last!r})")

@@ -75,7 +75,8 @@ import numpy as np
 from raft_tpu import obs
 from raft_tpu.core.error import expects
 
-__all__ = ["MutationWAL", "WalReader", "WalRecord", "WalGapError"]
+__all__ = ["MutationWAL", "WalReader", "WalRecord", "WalGapError",
+           "read_raw", "decode_stream"]
 
 _MAGIC = b"RTPUWAL2"
 _HDR = struct.Struct("<II")     # payload length, crc32
@@ -200,13 +201,21 @@ class MutationWAL:
     under its lock (mutations are already totally ordered there, and
     the log must preserve that order)."""
 
-    def __init__(self, path: str, sync: bool = True):
+    def __init__(self, path: str, sync: bool = True,
+                 start_seq: int = 1):
         self.path = path
         self.sync = bool(sync)
         self.torn_bytes = 0
         # next sequence number to assign (contiguous from 1; restored
-        # by scanning at reopen so the space never restarts)
-        self.next_seq = 1
+        # by scanning at reopen so the space never restarts).
+        # ``start_seq`` > 1 seeds a FRESH log deeper into the sequence
+        # space — the promoted-follower hand-off (fleet tier): the new
+        # primary's own log continues exactly where the applied stream
+        # ended, so a caught-up peer resumes contiguously and a behind
+        # peer gets the typed gap instead of silent divergence.
+        expects(start_seq >= 1,
+                "wal: start_seq must be >= 1, got %d", start_seq)
+        self.next_seq = int(start_seq)
         fresh = not os.path.exists(path) or os.path.getsize(path) == 0
         if fresh:
             self._f = open(path, "wb")
@@ -278,6 +287,13 @@ class MutationWAL:
     def append_delete(self, ids) -> None:
         ids = np.asarray(ids, np.int64).reshape(-1)
         self._append(_encode_delete(ids))
+
+    def append_meta(self, meta: dict) -> None:
+        """Append a meta record mid-log (epoch/id-space counters).
+        The promotion path writes one as the FIRST record of the new
+        primary's own log so a replica bootstrapping from it without
+        the checkpoint still restores the inherited counters."""
+        self._append(_encode_meta(dict(meta)))
 
     def replay(self) -> List[WalRecord]:
         """Every intact record in append order (stops at the first
@@ -433,3 +449,87 @@ class WalReader:
     def position(self) -> int:
         """Seq of the last record returned (0 = nothing yet)."""
         return self.last_seq
+
+
+# -- the log as the wire format (fleet transport, ISSUE 20) ----------------
+
+def read_raw(path: str, from_seq: int = 0, max_records: int = 0
+             ) -> Tuple[bytes, int, int]:
+    """Raw wire slice of a WAL: the on-disk bytes of every intact
+    record with ``seq > from_seq``, prefixed with the format magic —
+    the returned buffer is itself a valid WAL fragment in the exact
+    framing :func:`decode_stream` (and a future ``MutationWAL`` reopen)
+    parses. The fleet transport streams THIS over
+    ``GET /rpc/wal/tail`` — the log IS the wire format, no re-encode,
+    CRCs travel verbatim. Returns ``(buf, n_records, last_seq)``;
+    raises :class:`WalGapError` when ``from_seq`` predates the oldest
+    surviving record (folded into a checkpoint — re-bootstrap).
+    Single pass over one open file handle, so a concurrent
+    :meth:`MutationWAL.rewrite` can never interleave two file
+    generations into one response."""
+    from_seq = int(from_seq)
+    out = [_MAGIC]
+    n = 0
+    last = from_seq
+    first_seen: Optional[int] = None
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return b"".join(out), 0, last     # no log yet = empty tail
+    with f:
+        magic = f.read(len(_MAGIC))
+        expects(magic == _MAGIC,
+                "wal: %s is not a mutation WAL (bad magic)", path)
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                break
+            length, crc = _HDR.unpack(hdr)
+            if length > _MAX_RECORD or length < _SEQ.size + 1:
+                break
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break           # torn tail — ends the batch, like tail()
+            seq, _ts = _SEQ.unpack_from(payload, 0)
+            if seq <= from_seq:
+                continue
+            if first_seen is None:
+                first_seen = seq
+                if seq > from_seq + 1 and from_seq > 0:
+                    obs.counter("raft.mutate.wal.reader.gaps.total").inc()
+                    raise WalGapError(from_seq, seq)
+            out.append(hdr)
+            out.append(payload)
+            last = seq
+            n += 1
+            if max_records and n >= max_records:
+                break
+    return b"".join(out), n, last
+
+
+def decode_stream(buf: bytes) -> List[WalRecord]:
+    """Decode a :func:`read_raw` buffer (magic + framed records) back
+    into :class:`WalRecord` objects — the follower's end of the wire.
+    A torn/corrupt suffix ends the batch (same contract as ``tail()``
+    over a live file: the intact prefix is the answer, re-delivery is
+    the sender's job)."""
+    expects(buf[:len(_MAGIC)] == _MAGIC,
+            "wal: wire stream has bad magic")
+    out: List[WalRecord] = []
+    off = len(_MAGIC)
+    while off + _HDR.size <= len(buf):
+        length, crc = _HDR.unpack_from(buf, off)
+        start = off + _HDR.size
+        payload = buf[start:start + length]
+        if length > _MAX_RECORD or length < _SEQ.size + 1 \
+                or len(payload) < length or zlib.crc32(payload) != crc:
+            break
+        try:
+            out.append(_decode(payload))
+        except Exception:   # graftlint: disable=GL006
+            # undecodable-but-checksummed = version skew boundary,
+            # handled like a torn tail (justified swallow — the intact
+            # prefix must be returned, not raised away)
+            break
+        off = start + length
+    return out

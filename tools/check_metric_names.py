@@ -177,6 +177,20 @@ REQUIRED_NAMES = (
     "raft.fleet.replication.lag_records",
     "raft.fleet.replication.lag_seconds",
     "raft.fleet.rolling.total",
+    # multi-process fleet (ISSUE 20): the RPC transport's per-route
+    # traffic/error counters, the WAL/checkpoint wire volume (each
+    # daemon's OWN registry — federate to see the fleet), and the
+    # spawner-side process-lifecycle counters the failover drill
+    # asserts on
+    "raft.fleet.rpc.requests.total",
+    "raft.fleet.rpc.errors.total",
+    "raft.fleet.rpc.wal.records.total",
+    "raft.fleet.rpc.wal.bytes.total",
+    "raft.fleet.rpc.checkpoint.bytes.total",
+    "raft.fleet.proc.spawned.total",
+    "raft.fleet.proc.alive",
+    "raft.fleet.proc.killed.total",
+    "raft.fleet.proc.promotions.total",
     # resource observability (ISSUE 14): the sampled device/host split
     # counters, the duty-cycle gauge every "is the chip busy" consumer
     # reads, the HBM table + the low-headroom guardrail /healthz
@@ -292,6 +306,10 @@ REQUIRED_SPAN_NAMES = (
     # tiered serving (ISSUE 19): the tiered search root — hot/cold
     # probe split and overlap ride as attrs on every traced request
     "raft.tiered.search",
+    # multi-process fleet (ISSUE 20): the daemon-side RPC span,
+    # parented by the caller's traceparent header — one routed request
+    # stays ONE trace across process boundaries
+    "raft.fleet.rpc",
 )
 
 

@@ -90,14 +90,20 @@ def parse_chaos_spec(spec: str, default_duration_s: float = 5.0):
 
 
 def run_chaos_schedule(events, stop: threading.Event,
-                       router=None, revive_fn=None) -> threading.Thread:
+                       router=None, revive_fn=None,
+                       proc_fleet=None) -> threading.Thread:
     """Drive the fault harness on a schedule: a daemon thread enters
     each event's scope at its offset and exits it after its duration
     (or when ``stop`` is set — faults never outlive the run).
     ``kill_replica`` events need ``router`` (a
     :class:`raft_tpu.fleet.FleetRouter`); ``revive_fn()`` builds the
     replacement server the killed replica rejoins with after the
-    event's duration (None = the replica stays dead)."""
+    event's duration (None = the replica stays dead). With
+    ``proc_fleet`` (a :class:`raft_tpu.fleet.ProcessFleet`, ISSUE 20)
+    the kill is a real ``SIGKILL`` to the replica's OS process — the
+    router is told nothing and must discover the death through
+    dispatch errors (suspect → re-route), and the revival is a real
+    respawn (the router replica re-points at the new process's url)."""
     from contextlib import ExitStack, contextmanager
     from raft_tpu.testing import faults
 
@@ -113,6 +119,25 @@ def run_chaos_schedule(events, stop: threading.Event,
                 rep.set_server(revive_fn())
                 rep.mark_serving()
 
+    @contextmanager
+    def _proc_kill(idx):
+        from raft_tpu.fleet import RemoteSearchClient
+        name = f"r{int(idx)}"
+        role = proc_fleet.process(name).role
+        proc_fleet.kill(name)       # SIGKILL — the real thing
+        try:
+            yield
+        finally:
+            # respawn the slot (a promoted/primary slot restarts over
+            # its own WAL; a follower re-bootstraps over the wire) and
+            # re-point the router's replica at the NEW process
+            fp = proc_fleet.respawn(name, role=role)
+            rep = router.replica(name)
+            rep.mark_down()
+            rep.begin_bootstrap()
+            rep.set_server(RemoteSearchClient(fp.url, name=name))
+            rep.mark_serving()
+
     def _enter(stack, kind, arg, dur):
         if kind == "stall_shard":
             return stack.enter_context(
@@ -123,8 +148,11 @@ def run_chaos_schedule(events, stop: threading.Event,
             return stack.enter_context(
                 faults.fail_transfer(times=int(arg or 1)))
         if kind == "kill_replica":
+            if proc_fleet is not None:
+                return stack.enter_context(_proc_kill(int(arg or 0)))
             if router is None:
-                raise ValueError("chaos kill_replica needs --fleet")
+                raise ValueError("chaos kill_replica needs --fleet "
+                                 "or --fleet-procs")
             return stack.enter_context(_replica_kill(int(arg or 0)))
         return stack.enter_context(
             faults.delay_execute(float(arg or 10.0)))
@@ -513,6 +541,139 @@ def merge_bytes_by_rung(metrics_diff: dict) -> dict:
     return out
 
 
+def _run_fleet_procs(args, chaos_events, ladder) -> int:
+    """The ``--fleet-procs N`` run (ISSUE 20): N replica daemons as
+    real OS processes (``tools/fleetd.py``) behind RemoteReplicas and
+    one FleetRouter — same open loop, but now a ``kill_replica`` chaos
+    event is a real SIGKILL, the federation section scrapes N distinct
+    registries (the summed/router ratio finally reads ~1), and the
+    dead replica's forensics are ITS OWN process's crash-durable black
+    box, read back through tools/doctor.py."""
+    import tempfile
+
+    from raft_tpu import fleet, obs
+    from raft_tpu.random import make_blobs
+
+    workdir = tempfile.mkdtemp(prefix="raft_loadgen_procs_")
+    chaos = bool(chaos_events)
+    if args.blackbox:
+        # daemons flush their boxes on a tight cadence so even a short
+        # run's SIGKILL leaves recent frames on disk
+        os.environ.setdefault("RAFT_TPU_BLACKBOX_INTERVAL", "0.5")
+    pf = fleet.ProcessFleet(
+        workdir, n_procs=args.fleet_procs, n=args.n, dim=args.dim,
+        seed=args.seed, n_lists=args.n_lists, k=args.k,
+        n_probes=min(ladder), deadline_ms=args.deadline_ms or 5000.0,
+        blackbox=bool(args.blackbox))
+    router = fleet.FleetRouter(
+        pf.replicas(),
+        fleet.FleetConfig(max_retries=max(1, int(chaos)),
+                          suspect_ms=500.0 if chaos else 2000.0))
+    # the daemons built their index from the same (n, dim, seed,
+    # n_lists) blobs — regenerate the pool to query in-distribution
+    x, _ = make_blobs(n_samples=args.n, n_features=args.dim,
+                      centers=max(2, args.n_lists), cluster_std=2.0,
+                      seed=args.seed)
+    q = np.asarray(x, np.float32)
+    federator, agg = None, None
+    if args.federate:
+        # each process owns a REAL separate registry — federation
+        # finally sums distinct instances (contrast the in-process
+        # --fleet smoke, where every endpoint exports one registry)
+        from raft_tpu.obs import federation as _federation
+        federator = _federation.MetricsFederator(
+            pf.urls(), interval_s=0.5, fleet=router).start()
+        for fp in pf.processes():
+            federator.set_blackbox_path(
+                fp.name, os.path.join(fp.workdir, "blackbox"))
+        agg = obs.serve(federator=federator, fleet=router)
+    stop = threading.Event()
+    chaos_t = (run_chaos_schedule(chaos_events, stop, router=router,
+                                  proc_fleet=pf)
+               if chaos_events else None)
+    before = obs.snapshot()
+    try:
+        report = run_open_loop(
+            router, q, rate_qps=args.rate, duration_s=args.duration,
+            nq=args.nq, deadline_ms=args.deadline_ms or None,
+            seed=args.seed)
+    finally:
+        stop.set()
+        if chaos_t is not None:
+            chaos_t.join(timeout=60.0)
+    diff = obs.snapshot_diff(before, obs.snapshot())
+    cnt = diff.get("counters", {})
+    report["fleet"] = {
+        "replicas": args.fleet_procs,
+        "processes": pf.describe()["processes"],
+        "route_share": fleet_route_share(cnt),
+        "retries": int(sum(
+            v for k_, v in cnt.items()
+            if k_.startswith("raft.fleet.retry.total"))),
+        "unroutable": int(sum(
+            v for k_, v in cnt.items()
+            if k_.startswith("raft.fleet.unroutable.total"))),
+        "killed": int(sum(
+            v for k_, v in cnt.items()
+            if k_.startswith("raft.fleet.proc.killed.total"))),
+    }
+    if chaos_events:
+        report["chaos"] = {"schedule": args.chaos}
+    if federator is not None:
+        federator.scrape_once()
+        fed_rep = federator.report()
+        # per-process steady-state compile counters: each instance's
+        # OWN raft.plan.cache.misses — the fleet-wide zero-compile
+        # assertion reads these rows
+        misses = {}
+        for fam in federator.merged():
+            if fam.name == "raft_plan_cache_misses_total":
+                for s in fam.samples:
+                    inst = dict(s.labels).get("instance")
+                    if inst:
+                        misses[inst] = misses.get(inst, 0) \
+                            + int(s.value)
+        report["federation"] = {
+            "instances": {name: row["state"] for name, row
+                          in fed_rep["instances"].items()},
+            "stale": federator.stale_instances(),
+            "plan_cache_misses_by_instance": misses,
+            "instances_share_registry": False,
+            "scrape_overhead_frac":
+                fed_rep["scrape_overhead"]["frac"],
+        }
+    if args.blackbox and chaos_events and any(
+            e[1] == "kill_replica" for e in chaos_events):
+        # the post-mortem proof, now across a REAL process boundary:
+        # the SIGKILLed daemon's own crash-durable dump, read back
+        # through the offline doctor from its workdir
+        from tools import doctor as _doctor
+        killed = [e for e in chaos_events if e[1] == "kill_replica"]
+        name = f"r{int(killed[0][2] or 0)}"
+        dump_dir = os.path.join(workdir, name, "blackbox")
+        try:
+            diag = _doctor.diagnose_dump(dump_dir)
+            report["blackbox"] = {
+                "dir": workdir,
+                "killed_replica": {
+                    "name": name, "dump_dir": dump_dir,
+                    "dump_readable": diag["records"] > 0,
+                    "verdict": diag["verdict"],
+                },
+            }
+        except Exception as e:
+            report["blackbox"] = {"dir": workdir,
+                                  "killed_replica": {
+                                      "name": name, "error": repr(e)}}
+    print(json.dumps(report), flush=True)
+    router.close()
+    if federator is not None:
+        federator.close()
+        agg.close()
+    pf.close()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--n", type=int, default=20_000,
@@ -608,6 +769,17 @@ def main(argv=None) -> int:
                          "(flushed by Replica.kill — a --chaos "
                          "kill_replica's dump is read back through "
                          "tools/doctor.py in the report)")
+    ap.add_argument("--fleet-procs", type=int, default=0,
+                    help="serve through N replica DAEMONS — real OS "
+                         "processes running tools/fleetd.py behind "
+                         "the fleet RPC transport (ISSUE 20) — with "
+                         "RemoteReplicas under one FleetRouter. "
+                         "--chaos kill_replica:<i> sends real SIGKILL "
+                         "to the process (respawned after the event "
+                         "duration); --federate scrapes each "
+                         "process's own /metrics; --blackbox reads "
+                         "the dead process's crash-durable dump back "
+                         "through tools/doctor.py")
     args = ap.parse_args(argv)
     if args.tiered is not None and not 0.0 <= args.tiered <= 1.0:
         ap.error("--tiered HOT_FRAC must be in [0, 1]")
@@ -629,9 +801,21 @@ def main(argv=None) -> int:
     if args.fleet and args.fleet < 2:
         ap.error("--fleet needs >= 2 replicas (1 replica is just "
                  "--server single)")
-    if args.federate and not args.fleet:
+    if args.fleet_procs and args.fleet:
+        ap.error("--fleet-procs replaces --fleet (processes, not "
+                 "in-process replicas) — pick one")
+    if args.fleet_procs and args.fleet_procs < 2:
+        ap.error("--fleet-procs needs >= 2 processes (1 process is "
+                 "just --server single behind a port)")
+    if args.fleet_procs and (args.server == "dist" or args.mutate_frac
+                             or args.demo or args.tiered is not None):
+        ap.error("--fleet-procs rides the plain open loop over "
+                 "remote replicas (--server dist / --mutate-frac / "
+                 "--demo / --tiered compose at the library level, "
+                 "not in this tool)")
+    if args.federate and not (args.fleet or args.fleet_procs):
         ap.error("--federate aggregates replica endpoints — it needs "
-                 "--fleet N")
+                 "--fleet N or --fleet-procs N")
     chaos_events = (parse_chaos_spec(args.chaos, args.chaos_duration)
                     if args.chaos else None)
     if chaos_events and any(e[1] in ("kill_compactor", "fail_transfer")
@@ -640,8 +824,15 @@ def main(argv=None) -> int:
         ap.error("--chaos kill_compactor/fail_transfer need a mutable "
                  "serving path — add --mutate-frac (> 0)")
     if chaos_events and any(e[1] == "kill_replica"
-                            for e in chaos_events) and not args.fleet:
-        ap.error("--chaos kill_replica needs --fleet N")
+                            for e in chaos_events) \
+            and not (args.fleet or args.fleet_procs):
+        ap.error("--chaos kill_replica needs --fleet N or "
+                 "--fleet-procs N")
+    if args.fleet_procs and chaos_events and any(
+            e[1] != "kill_replica" for e in chaos_events):
+        ap.error("--fleet-procs chaos supports kill_replica only "
+                 "(in-process fault hooks cannot reach another "
+                 "process)")
     if chaos_events and args.demo:
         ap.error("--chaos rides the plain open-loop run (the demo's "
                  "calibration phase would skew the event offsets)")
@@ -654,6 +845,10 @@ def main(argv=None) -> int:
     if profile_sample > 0:
         from raft_tpu.obs import profiler
         profiler.enable_profiling(profile_sample)
+    if args.fleet_procs:
+        # the multi-process fleet (ISSUE 20): real daemons, real
+        # SIGKILLs, real per-process registries
+        return _run_fleet_procs(args, chaos_events, ladder)
     if args.fleet:
         # the fleet front door (ISSUE 13): N replicas, one router —
         # run_open_loop drives it unchanged (same submit() shape)

@@ -119,6 +119,17 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || fail=1
 
+# multi-process fleet (ISSUE 20): the WAL-is-the-wire-format parity
+# (read_raw bit-identity, tail-over-HTTP, 410-gap → re-bootstrap,
+# remote vs local bootstrap bit-parity through a checkpointed
+# compaction), the typed search-RPC error mapping, RemoteReplica
+# behind the stock router, and the 3-process fleetd SIGKILL-failover
+# smoke (promotion WAL ownership + per-process zero-compile).
+echo "precommit: multi-process fleet tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_proc.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || fail=1
+
 # fleet observability plane (ISSUE 16): the exposition round-trip
 # byte-stability pin, instance-label merge semantics per instrument
 # kind, traceparent propagation + cross-endpoint trace stitching, the

@@ -24,6 +24,12 @@
 #        the power-of-two-choices front door, availability through a
 #        full replica kill, one rolling restart under load — first
 #        hardware row of the millions-of-users layer
+#   fp0  multi-PROCESS fleet row (ISSUE 20): 1/2/4 fleetd daemons —
+#        each its own OS process and (chips permitting) its own
+#        device — behind the HTTP RPC transport; the linear-scaling
+#        ratio gate ARMS here (distinct devices = real capacity),
+#        with per-process zero-compile counters from each daemon's
+#        own /metrics
 #   pr0  resource-observability row (ISSUE 14): the FIRST on-hardware
 #        duty-cycle + HBM row — the serve bench with the continuous
 #        profiler's device_util / hbm_peak_mb keys, real PJRT
@@ -122,6 +128,16 @@ fl0() {  # fleet row (ISSUE 13): replica scaling + kill availability +
   cp -f "$OUT/fleet_r6.log" docs/measurements/
 }
 
+fp0() {  # multi-PROCESS fleet row (ISSUE 20): 1/2/4 fleetd daemons
+         # behind the HTTP RPC transport — the scaling ratio gate ARMS
+         # here when each process owns its own chip(s); per-process
+         # zero-compile counters scraped from each daemon's /metrics
+  BENCH_FLEET_PROC_N=200000 BENCH_FLEET_PROC_SECONDS=4 \
+    python bench_suite.py fleet_proc \
+    2>&1 | tee "$OUT/fleet_proc_r6.log"
+  cp -f "$OUT/fleet_proc_r6.log" docs/measurements/
+}
+
 pr0() {  # resource-observability row (ISSUE 14): first on-hardware
          # duty-cycle + HBM figures — device_util and hbm_peak_mb on
          # the serve + flat rows, from real PJRT allocator stats
@@ -156,6 +172,7 @@ run mu0 mu0
 run ch0 ch0
 run q0 q0
 run fl0 fl0
+run fp0 fp0
 run pr0 pr0
 run tv0 tv0
 run h1 h1
